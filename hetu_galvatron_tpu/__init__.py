@@ -1,0 +1,27 @@
+"""hetu_galvatron_tpu — TPU-native automatic hybrid-parallel training framework.
+
+A from-scratch JAX/XLA re-design of the capabilities of PKU-DAIR/Hetu-Galvatron
+(reference surveyed in SURVEY.md): a Profiler -> Search Engine -> Runtime system
+that trains Transformers with *layer-wise* hybrid parallelism — DP / ZeRO-2/3 /
+TP (+sequence parallel) / Ulysses-SP / ring-attention CP / PP (GPipe & 1F1B) /
+EP / activation checkpointing — chosen automatically per layer by a
+cost-model-driven dynamic-programming search.
+
+TPU-first design notes (vs the torch/NCCL reference):
+  - process groups        -> `jax.sharding.Mesh` views + named-axis collectives
+  - FSDP wrapping         -> parameter/optimizer PartitionSpecs on the `dp` axis
+  - Megatron TP layers    -> GSPMD-sharded einsums (XLA inserts the collectives)
+  - NCCL p2p pipeline     -> `shard_map` over the `pp` axis with `lax.ppermute`
+  - flash-attn CUDA ops   -> Pallas flash/splash attention kernels
+  - Triton kernels        -> Pallas kernels
+  - activation relocation -> `with_sharding_constraint` resharding at boundaries
+"""
+
+__version__ = "0.1.0"
+
+from hetu_galvatron_tpu.utils.strategy import (  # noqa: F401
+    DPType,
+    LayerStrategy,
+    strategy_list2config,
+    config2strategy,
+)

@@ -1,0 +1,12 @@
+from hetu_galvatron_tpu.core.args_schema import (  # noqa: F401
+    CoreArgs,
+    ModelArgs,
+    ParallelArgs,
+    TrainArgs,
+    CheckpointArgs,
+    ProfileArgs,
+    SearchArgs,
+    HardwareProfileArgs,
+    ModelProfileArgs,
+)
+from hetu_galvatron_tpu.core.arguments import load_config  # noqa: F401

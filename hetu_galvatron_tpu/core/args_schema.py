@@ -1,0 +1,301 @@
+"""Pydantic argument schemas.
+
+Capability parity with the reference's Hydra+Pydantic config stack
+(core/args_schema.py:46-52, runtime/args_schema.py:344-386,
+profiler/args_schema.py, search_engine/args_schema.py:65-75): a validated
+`CoreArgs` tree with per-domain submodels, YAML-loadable with dotted overrides
+(loader in ``core/arguments.py``). Hydra itself is not a dependency; the loader
+implements the subset Galvatron uses (compose a YAML + ``key=value`` /
+``++key=value`` overrides).
+
+TPU notes: `mixed_precision` defaults to bf16 (TPU-native), there is no NCCL
+backend/timeout knob — the distributed "backend" is the XLA runtime — and
+device-count fields describe chips in a `jax.sharding.Mesh`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Literal, Optional
+
+from pydantic import BaseModel, Field, field_validator, model_validator
+
+
+class ModelArgs(BaseModel):
+    """Architecture hyperparameters for the generic causal-LM decoder stack
+    (reference models share one decoder arch parameterized by YAML —
+    models/model_configs/*.yaml, runtime/models/builder.py:111-121)."""
+
+    model_name: str = "gpt2-small"
+    model_type: Literal["gpt", "llama", "bert", "t5", "moe"] = "gpt"
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    num_key_value_heads: Optional[int] = None  # None => MHA
+    ffn_hidden_size: Optional[int] = None  # None => 4*hidden (or 8/3 for swiglu)
+    vocab_size: int = 50257
+    max_position_embeddings: int = 1024
+    seq_length: int = 1024
+    hidden_act: Literal["gelu", "swiglu", "geglu", "relu", "silu"] = "gelu"
+    normalization: Literal["layernorm", "rmsnorm"] = "layernorm"
+    layernorm_epsilon: float = 1e-5
+    position_embedding_type: Literal["learned", "rope"] = "learned"
+    rope_theta: float = 10000.0
+    tie_word_embeddings: bool = True
+    use_flash_attn: bool = True
+    attention_dropout: float = 0.0
+    hidden_dropout: float = 0.0
+    make_vocab_size_divisible_by: int = 128
+    untie_streams: bool = False
+    # MoE
+    num_experts: int = 0  # 0 => dense model
+    moe_topk: int = 2
+    moe_ffn_hidden_size: Optional[int] = None
+    num_shared_experts: int = 0
+    moe_aux_loss_coeff: float = 1e-2
+    moe_z_loss_coeff: float = 0.0
+    moe_router_dtype: Literal["float32", "bfloat16"] = "float32"
+    moe_layer_freq: int = 1  # every k-th layer is MoE
+
+    @property
+    def kv_heads(self) -> int:
+        return self.num_key_value_heads or self.num_attention_heads
+
+    @property
+    def ffn_dim(self) -> int:
+        if self.ffn_hidden_size is not None:
+            return self.ffn_hidden_size
+        return 4 * self.hidden_size
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @property
+    def padded_vocab_size(self) -> int:
+        m = self.make_vocab_size_divisible_by
+        return ((self.vocab_size + m - 1) // m) * m
+
+    @field_validator("num_key_value_heads")
+    @classmethod
+    def _kv_default(cls, v, info):
+        return v
+
+
+class ParallelArgs(BaseModel):
+    """GLOBAL-mode uniform strategy knobs + JSON-mode pointer, mirroring
+    hybrid_parallel_config.py:18-130's two config modes."""
+
+    # strategy source: 'global' (uniform knobs below) or 'json' (searched plan)
+    config_mode: Literal["global", "json"] = "global"
+    galvatron_config_path: Optional[str] = None
+    # GLOBAL mode knobs
+    pp_deg: int = 1
+    global_tp_deg: int = 1
+    global_tp_consec: int = 1
+    global_cp_deg: int = 1
+    sdp: int = 0  # 1 => force zero3 on all layers
+    default_dp_type: Literal["ddp", "zero2", "zero3"] = "ddp"
+    global_checkpoint: int = 0
+    use_ulysses: bool = False
+    vocab_tp: int = 1
+    vocab_sp: int = 0
+    vocab_cp: int = 1
+    embed_sdp: int = 0
+    # schedule
+    pipeline_type: Literal["gpipe", "pipedream_flush"] = "gpipe"
+    chunks: int = -1  # -1 => auto from global bsz (hybrid_parallel_config.py:359)
+    # data
+    global_train_batch_size: int = 8
+    # precision
+    mixed_precision: Literal["fp32", "bf16", "fp16"] = "bf16"
+    # world
+    num_devices: int = 1  # chips in the mesh (driver/test override)
+    dp_axis_on_dcn: bool = True  # outermost dp/pp on DCN for multi-host pods
+
+    @model_validator(mode="after")
+    def _check(self):
+        if self.config_mode == "json" and not self.galvatron_config_path:
+            raise ValueError("config_mode=json requires galvatron_config_path")
+        return self
+
+
+class TrainArgs(BaseModel):
+    lr: float = 1e-4
+    min_lr: float = 1e-5
+    weight_decay: float = 0.01
+    adam_beta1: float = 0.9
+    adam_beta2: float = 0.95
+    adam_eps: float = 1e-8
+    clip_grad: float = 1.0
+    train_iters: int = 20
+    lr_decay_style: Literal["constant", "linear", "cosine", "inverse-square-root", "WSD"] = (
+        "cosine"
+    )
+    lr_warmup_iters: int = 0
+    lr_decay_iters: Optional[int] = None
+    lr_wsd_decay_iters: int = 0
+    seed: int = 1234
+    eval_interval: int = 0
+    eval_iters: int = 0
+    check_loss: bool = False
+    deterministic_mode: bool = False
+
+
+class CheckpointArgs(BaseModel):
+    save: Optional[str] = None
+    load: Optional[str] = None
+    save_interval: int = 0
+    load_format: Literal["galvatron", "hf"] = "galvatron"
+    async_save: bool = False
+    distributed_checkpoint: bool = True
+
+
+class DataArgs(BaseModel):
+    dataset: Literal["random", "indexed"] = "random"
+    data_path: List[str] = Field(default_factory=list)
+    split: str = "969,30,1"
+    tokenizer_type: str = "none"
+    tokenizer_path: Optional[str] = None
+    num_workers: int = 0
+    reset_position_ids: bool = False
+    reset_attention_mask: bool = False
+    eod_mask_loss: bool = False
+
+
+class ProfileArgs(BaseModel):
+    """Runtime-profiler switches (reference profile flags on the train run)."""
+
+    profile: int = 0
+    profile_type: Literal["memory", "computation"] = "computation"
+    profile_forward: int = 0
+    save_profiled_memory: int = 0
+    profiler_dir: str = "configs"
+    profile_iters: int = 5
+    profile_warmup: int = 2
+
+
+class LoggingArgs(BaseModel):
+    log_interval: int = 1
+    tensorboard_dir: Optional[str] = None
+    wandb_project: Optional[str] = None
+    log_level: str = "info"
+
+
+class RerunArgs(BaseModel):
+    """Fault-detection state machine knobs (reference rerun_state_machine.py)."""
+
+    enable: bool = False
+    mode: Literal[
+        "disabled", "validate_results", "report_stats"
+    ] = "disabled"
+    error_injection_rate: float = 0.0
+    error_injection_type: Literal[
+        "transient_error", "persistent_error", "correct_result"
+    ] = "transient_error"
+    check_for_nan: bool = True
+    check_for_spike: bool = True
+    spike_factor: float = 10.0
+
+
+class SearchArgs(BaseModel):
+    """Search-engine knobs (reference search_engine/args_schema.py:65-75)."""
+
+    num_nodes: int = 1
+    num_devices_per_node: int = 8
+    memory_constraint: float = 16.0  # GB of HBM budget per chip
+    min_bsz: int = 8
+    max_bsz: int = 64
+    bsz_scale: int = 8
+    settle_bsz: int = -1  # >0 => search exactly this global bsz
+    settle_chunks: int = -1
+    search_space: Literal["full", "dp+tp", "dp+pp", "3d", "dp", "tp", "pp", "sdp"] = "full"
+    disable_dp: int = 0
+    disable_tp: int = 0
+    disable_pp: int = 0
+    disable_sdp: int = 0
+    disable_ckpt: int = 0
+    disable_tp_consec: int = 1  # non-consecutive tp rarely wins on ICI
+    disable_cp: int = 1
+    disable_ulysses: int = 0
+    disable_vtp: int = 0
+    max_tp_deg: int = 8
+    max_pp_deg: int = 8
+    default_dp_type: Literal["ddp", "zero2", "zero3"] = "ddp"
+    fine_grained_mode: int = 1
+    sequence_parallel_mode: Literal["megatron", "ulysses"] = "megatron"
+    pipeline_type: Literal["gpipe", "pipedream_flush"] = "pipedream_flush"
+    mixed_precision: Literal["bf16", "fp32"] = "bf16"
+    use_cpp_core: bool = True
+    parallel_search: bool = False
+    log_dir: str = "logs"
+    output_config_path: Optional[str] = None
+    # profiled-data locations
+    time_profiling_path: Optional[str] = None
+    memory_profiling_path: Optional[str] = None
+    allreduce_bandwidth_config_path: Optional[str] = None
+    p2p_bandwidth_config_path: Optional[str] = None
+    overlap_coe_path: Optional[str] = None
+    sp_time_path: Optional[str] = None
+    sequence_length: Optional[int] = None
+    costmodel_coe: float = 1.0
+
+
+class ModelProfileArgs(BaseModel):
+    """Model-profiler sweep description (reference profiler/args_schema.py)."""
+
+    profile_type: Literal["computation", "memory"] = "computation"
+    profile_mode: Literal["static", "batch", "sequence"] = "static"
+    profile_batch_size: int = 1
+    profile_min_batch_size: int = 1
+    profile_max_batch_size: int = 8
+    profile_batch_size_step: int = 1
+    profile_seq_length_list: List[int] = Field(default_factory=lambda: [1024])
+    profile_min_seq_length: int = 1024
+    profile_max_seq_length: int = 8192
+    profile_seq_length_step: int = 1024
+    layernum_min: int = 2
+    layernum_max: int = 4
+    max_tp_deg: int = 8
+    profile_dp_type: Literal["ddp", "zero2", "zero3"] = "ddp"
+    mixed_precision: Literal["bf16", "fp32"] = "bf16"
+    use_flash_attn: bool = True
+    output_dir: str = "configs"
+    extra_args_str: str = ""
+
+
+class HardwareProfileArgs(BaseModel):
+    """Hardware-profiler knobs: ICI/DCN collective microbenchmarks replacing the
+    reference's NCCL benchmarks (profile_hardware/*, hardware_profiler.py)."""
+
+    num_nodes: int = 1
+    num_devices_per_node: int = 8
+    max_pp_deg: int = 8
+    max_tp_deg: int = 8
+    start_mb: int = 1
+    end_mb: int = 512
+    scale: int = 2
+    warmup_iters: int = 5
+    profile_iters: int = 20
+    avg_or_min_or_first: Literal["avg", "min", "first"] = "avg"
+    output_dir: str = "hardware_configs"
+    backend: Literal["auto", "tpu", "cpu"] = "auto"
+
+
+class CoreArgs(BaseModel):
+    """Top-level validated argument tree (reference core/args_schema.py:46)."""
+
+    mode: Literal["train_dist", "search", "model_profiler", "profile_hardware"] = (
+        "train_dist"
+    )
+    model: ModelArgs = Field(default_factory=ModelArgs)
+    parallel: ParallelArgs = Field(default_factory=ParallelArgs)
+    train: TrainArgs = Field(default_factory=TrainArgs)
+    ckpt: CheckpointArgs = Field(default_factory=CheckpointArgs)
+    data: DataArgs = Field(default_factory=DataArgs)
+    profile: ProfileArgs = Field(default_factory=ProfileArgs)
+    logging: LoggingArgs = Field(default_factory=LoggingArgs)
+    rerun: RerunArgs = Field(default_factory=RerunArgs)
+    search: SearchArgs = Field(default_factory=SearchArgs)
+    model_profiler: ModelProfileArgs = Field(default_factory=ModelProfileArgs)
+    hardware_profiler: HardwareProfileArgs = Field(default_factory=HardwareProfileArgs)
+    extra: Dict[str, Any] = Field(default_factory=dict)

@@ -1,0 +1,9 @@
+from hetu_galvatron_tpu.utils.strategy import (  # noqa: F401
+    DPType,
+    LayerStrategy,
+    EmbeddingLMHeadStrategy,
+    strategy_list2config,
+    config2strategy,
+    form_strategy,
+    print_strategies,
+)

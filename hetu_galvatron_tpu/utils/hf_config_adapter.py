@@ -1,0 +1,112 @@
+"""HuggingFace config → ModelArgs adapter.
+
+Capability parity with the reference's hf_config_adapter
+(utils/hf_config_adapter.py:196-393): populate our :class:`ModelArgs` from a HF
+`AutoConfig` (or a plain dict of HF-style keys), auto-detecting norm type,
+activation, rope, and GQA for llama/gpt2/qwen2/mistral/mixtral families, and
+expose `model_layer_configs`/`model_name` helpers for the profiler and search
+engine.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from hetu_galvatron_tpu.core.args_schema import CoreArgs, ModelArgs
+
+# HF key → ModelArgs key, tried in order per field.
+_FIELD_MAP = {
+    "hidden_size": ["hidden_size", "n_embd", "d_model"],
+    "num_hidden_layers": ["num_hidden_layers", "n_layer", "num_layers"],
+    "num_attention_heads": ["num_attention_heads", "n_head", "num_heads"],
+    "num_key_value_heads": ["num_key_value_heads", "num_kv_heads"],
+    "ffn_hidden_size": ["intermediate_size", "n_inner", "ffn_dim", "d_ff"],
+    "vocab_size": ["vocab_size"],
+    "max_position_embeddings": ["max_position_embeddings", "n_positions", "n_ctx"],
+    "layernorm_epsilon": ["rms_norm_eps", "layer_norm_epsilon", "layer_norm_eps"],
+    "rope_theta": ["rope_theta"],
+    "tie_word_embeddings": ["tie_word_embeddings"],
+    "num_experts": ["num_local_experts", "num_experts"],
+    "moe_topk": ["num_experts_per_tok"],
+}
+
+_ROPE_FAMILIES = {"llama", "qwen2", "mistral", "mixtral", "qwen", "gemma"}
+_RMS_FAMILIES = {"llama", "qwen2", "mistral", "mixtral", "qwen", "gemma", "t5"}
+_SWIGLU_FAMILIES = {"llama", "qwen2", "mistral", "mixtral", "qwen"}
+
+
+def _cfg_to_dict(config: Any) -> Dict[str, Any]:
+    if isinstance(config, dict):
+        return config
+    if hasattr(config, "to_dict"):
+        return config.to_dict()
+    return vars(config)
+
+
+def populate_model_args_from_hf(
+    config: Any, base: Optional[ModelArgs] = None
+) -> ModelArgs:
+    """Build ModelArgs from a HF config object/dict, auto-detecting family."""
+    d = _cfg_to_dict(config)
+    family = str(d.get("model_type", "gpt2")).lower()
+    values: Dict[str, Any] = dict(base.model_dump() if base else {})
+    for ours, theirs in _FIELD_MAP.items():
+        for key in theirs:
+            if key in d and d[key] is not None:
+                values[ours] = d[key]
+                break
+    values["model_name"] = d.get("_name_or_path", family) or family
+    values["model_type"] = "moe" if values.get("num_experts", 0) else (
+        "llama" if family in _ROPE_FAMILIES else "gpt"
+    )
+    values["normalization"] = "rmsnorm" if family in _RMS_FAMILIES else "layernorm"
+    values["hidden_act"] = "swiglu" if family in _SWIGLU_FAMILIES else "gelu"
+    values["position_embedding_type"] = (
+        "rope" if family in _ROPE_FAMILIES else "learned"
+    )
+    return ModelArgs.model_validate(values)
+
+
+def resolve_model_config(args: CoreArgs, hf_path: Optional[str] = None) -> CoreArgs:
+    """Resolve final ModelArgs: YAML-provided fields win; if ``hf_path`` (or
+    args.extra['hf_model_path']) is set, pull architecture from HF AutoConfig.
+    Mirrors reference resolve_model_config (hf_config_adapter.py:285)."""
+    path = hf_path or args.extra.get("hf_model_path")
+    if path:
+        from transformers import AutoConfig
+
+        hf_cfg = AutoConfig.from_pretrained(path)
+        args = args.model_copy(
+            update={"model": populate_model_args_from_hf(hf_cfg, base=args.model)}
+        )
+    if args.model.seq_length > args.model.max_position_embeddings:
+        args.model.max_position_embeddings = args.model.seq_length
+    return args
+
+
+def model_layer_configs(model_args: ModelArgs) -> List[Dict[str, Any]]:
+    """Per-layertype dicts consumed by profiler + search engine
+    (reference hf_config_adapter.py:384). Dense models have one layertype; MoE
+    models alternate dense/MoE according to moe_layer_freq."""
+    base = {
+        "hidden_size": model_args.hidden_size,
+        "seq_len": model_args.seq_length,
+        "num_attention_heads": model_args.num_attention_heads,
+        "num_key_value_heads": model_args.kv_heads,
+        "ffn_hidden_size": model_args.ffn_dim,
+        "vocab_size": model_args.padded_vocab_size,
+        "layer_num": model_args.num_hidden_layers,
+    }
+    if not model_args.num_experts:
+        return [base]
+    moe = dict(base)
+    moe.update(
+        num_experts=model_args.num_experts,
+        moe_topk=model_args.moe_topk,
+        moe_ffn_hidden_size=model_args.moe_ffn_hidden_size or model_args.ffn_dim,
+    )
+    return [base, moe]
+
+
+def model_name(model_args: ModelArgs) -> str:
+    return model_args.model_name.replace("/", "_")

@@ -1,0 +1,298 @@
+"""Per-layer parallel strategy representation and (de)serialization.
+
+Capability parity with the reference's strategy spine
+(galvatron/utils/strategy_utils.py:1-352): dataclasses describing one layer's
+parallel plan, an enum of data-parallel flavours, and converters between a list
+of per-layer strategies and the on-disk ``galvatron_config_*.json`` interchange
+format (same keys: pp_deg / tp_sizes_enc / tp_consecutive_flags / dp_types_enc /
+use_sp / cp_sizes_enc / ep_sizes_enc / checkpoint / global_bsz / chunks /
+pp_division / pipeline_type / default_dp_type / vtp / vsp / embed_sdp), so
+strategy JSONs remain the interchange artifact between search engine and
+runtime, as in the reference (consumed at
+galvatron/core/runtime/hybrid_parallel_config.py:50-101).
+
+TPU note: a strategy here never names ranks or process groups. It is a purely
+logical description; ``runtime/mesh.py`` lowers it to a `jax.sharding.Mesh`
+view + `PartitionSpec`s.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field, replace
+from enum import IntEnum
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+
+class DPType(IntEnum):
+    """Data-parallel flavour for one layer.
+
+    Mirrors the reference's ddp/zero2/zero3 choices (runtime/parallel.py:119-123):
+      DDP   — parameters replicated across dp; gradients all-reduced (psum).
+      ZERO2 — optimizer state + gradients sharded across dp (psum_scatter grads).
+      ZERO3 — parameters fully sharded across dp; XLA all-gathers on use.
+    """
+
+    DDP = 0
+    ZERO2 = 1
+    ZERO3 = 2
+
+    @staticmethod
+    def from_name(name: str) -> "DPType":
+        return {"ddp": DPType.DDP, "zero2": DPType.ZERO2, "zero3": DPType.ZERO3}[
+            name.lower()
+        ]
+
+    @property
+    def short(self) -> str:
+        return {DPType.DDP: "ddp", DPType.ZERO2: "zero2", DPType.ZERO3: "zero3"}[self]
+
+
+@dataclass(frozen=True)
+class LayerStrategy:
+    """Parallel plan for a single transformer layer.
+
+    world-per-stage invariant: tp_size * cp_size * dp_size == world_size // pp_deg
+    (cp and sp are mutually exclusive with each other in the reference; when
+    ``sp`` is set the tp degree is reinterpreted as the Ulysses sequence-parallel
+    degree — hybrid_parallel_config.py:262-267).
+    """
+
+    pp_deg: int = 1
+    tp_size: int = 1
+    dp_size: int = 1
+    cp_size: int = 1
+    sp: bool = False  # Ulysses: all_to_all head-scatter attention on the tp axis
+    tp_consecutive: bool = True  # tp over adjacent devices (ICI-local) or strided
+    dp_type: DPType = DPType.DDP
+    checkpoint: bool = False  # activation rematerialization for this layer
+    # MoE only:
+    ep_size: int = 1  # expert-parallel degree (experts sharded over dp*tp grid)
+    etp_size: int = 1  # tensor-parallel degree inside each expert
+
+    @property
+    def degrees(self) -> int:
+        return self.tp_size * self.cp_size * self.dp_size
+
+    def world_size(self) -> int:
+        return self.pp_deg * self.degrees
+
+    def key(self) -> Tuple:
+        """Hashable identity used for strategy dedup in the search engine."""
+        return (
+            self.pp_deg,
+            self.tp_size,
+            self.dp_size,
+            self.cp_size,
+            int(self.sp),
+            int(self.tp_consecutive),
+            int(self.dp_type),
+            int(self.checkpoint),
+            self.ep_size,
+            self.etp_size,
+        )
+
+    def with_checkpoint(self, flag: bool) -> "LayerStrategy":
+        return replace(self, checkpoint=flag)
+
+    def validate(self, world_size: int) -> None:
+        if self.world_size() != world_size:
+            raise ValueError(
+                f"strategy {form_strategy(self)}: pp*tp*cp*dp="
+                f"{self.world_size()} != world_size {world_size}"
+            )
+        for n, v in (("pp_deg", self.pp_deg), ("tp_size", self.tp_size),
+                     ("cp_size", self.cp_size), ("dp_size", self.dp_size),
+                     ("ep_size", self.ep_size), ("etp_size", self.etp_size)):
+            if v < 1 or (v & (v - 1)) != 0:
+                raise ValueError(f"{n}={v} must be a positive power of two")
+        if self.sp and self.cp_size > 1:
+            raise ValueError("Ulysses sp and ring-attention cp are exclusive per layer")
+
+
+@dataclass(frozen=True)
+class EmbeddingLMHeadStrategy:
+    """Strategy for the embedding + LM head ("vocab") layers, searched
+    independently of the decoder layers (reference args_schema.py:36-39,
+    parallel_state.py:183-305)."""
+
+    vtp: int = 1  # vocab tensor-parallel degree
+    vsp: bool = False  # shard the sequence at embedding/head (vocab sp)
+    vcp: int = 1  # vocab context-parallel degree
+    embed_sdp: bool = False  # ZeRO-3 the embedding/head instead of default dp type
+
+    def key(self) -> Tuple:
+        return (self.vtp, int(self.vsp), self.vcp, int(self.embed_sdp))
+
+
+# ---------------------------------------------------------------------------
+# strategy list <-> JSON interchange
+# ---------------------------------------------------------------------------
+
+
+def _enc(values: Sequence[Any]) -> str:
+    return ",".join(str(int(v)) for v in values)
+
+
+def _dec(s: str) -> List[int]:
+    return [int(x) for x in str(s).split(",") if x != ""]
+
+
+def strategy_list2config(
+    strategies: Sequence[LayerStrategy],
+    *,
+    global_bsz: int,
+    chunks: int,
+    pipeline_type: str = "pipedream_flush",
+    default_dp_type: str = "ddp",
+    vocab: Optional[EmbeddingLMHeadStrategy] = None,
+    pp_division: Optional[Sequence[int]] = None,
+) -> Dict[str, Any]:
+    """Serialize per-layer strategies to the interchange dict.
+
+    ``dp_types_enc`` keeps the reference encoding: 0 means "use
+    ``default_dp_type``", 1 means "force ZeRO-3 for this layer".
+    """
+    if not strategies:
+        raise ValueError("empty strategy list")
+    pp_deg = strategies[0].pp_deg
+    default_dp = DPType.from_name(default_dp_type)
+    dp_types = []
+    for s in strategies:
+        if s.pp_deg != pp_deg:
+            raise ValueError("all layers must share one pp_deg")
+        dp_types.append(1 if s.dp_type == DPType.ZERO3 and default_dp != DPType.ZERO3
+                        else (0 if s.dp_type == default_dp else int(s.dp_type == DPType.ZERO3)))
+    vocab = vocab or EmbeddingLMHeadStrategy()
+    cfg: Dict[str, Any] = {
+        "pp_deg": pp_deg,
+        "tp_sizes_enc": _enc([s.tp_size for s in strategies]),
+        "tp_consecutive_flags": _enc([s.tp_consecutive for s in strategies]),
+        "dp_types_enc": _enc(dp_types),
+        "use_sp": _enc([s.sp for s in strategies]),
+        "cp_sizes_enc": _enc([s.cp_size for s in strategies]),
+        "ep_sizes_enc": _enc([s.ep_size for s in strategies]),
+        "etp_sizes_enc": _enc([s.etp_size for s in strategies]),
+        "checkpoint": _enc([s.checkpoint for s in strategies]),
+        "global_bsz": int(global_bsz),
+        "chunks": int(chunks),
+        "pp_division": _enc(pp_division) if pp_division is not None
+        else _enc([len(strategies) // max(pp_deg, 1)] * pp_deg),
+        "pipeline_type": pipeline_type,
+        "default_dp_type": default_dp.short,
+        "vtp": vocab.vtp,
+        "vsp": int(vocab.vsp),
+        "vcp": vocab.vcp,
+        "embed_sdp": int(vocab.embed_sdp),
+    }
+    return cfg
+
+
+def config2strategy(
+    cfg: Dict[str, Any], world_size: Optional[int] = None
+) -> Tuple[List[LayerStrategy], EmbeddingLMHeadStrategy, Dict[str, Any]]:
+    """Parse the interchange dict back into per-layer strategies.
+
+    Returns (layer strategies, vocab strategy, extras) where extras carries the
+    non-per-layer fields (global_bsz, chunks, pipeline_type, pp_division).
+    Missing optional vectors (cp/ep) default to all-ones, matching the
+    reference's tolerance of older config files.
+    """
+    pp_deg = int(cfg["pp_deg"])
+    tps = _dec(cfg["tp_sizes_enc"])
+    n = len(tps)
+
+    def vec(key: str, default: int) -> List[int]:
+        return _dec(cfg[key]) if key in cfg else [default] * n
+
+    cons = vec("tp_consecutive_flags", 1)
+    dpt = vec("dp_types_enc", 0)
+    sps = vec("use_sp", 0)
+    cps = vec("cp_sizes_enc", 1)
+    eps = vec("ep_sizes_enc", 1)
+    etps = vec("etp_sizes_enc", 1)
+    ckpt = vec("checkpoint", 0)
+    default_dp = DPType.from_name(cfg.get("default_dp_type", "ddp"))
+    strategies = []
+    for i in range(n):
+        dp_type = DPType.ZERO3 if dpt[i] == 1 else default_dp
+        dp_size = 0
+        if world_size is not None:
+            denom = pp_deg * tps[i] * cps[i]
+            if world_size % denom != 0:
+                raise ValueError(
+                    f"layer {i}: world_size {world_size} not divisible by "
+                    f"pp*tp*cp = {denom}"
+                )
+            dp_size = world_size // denom
+        strategies.append(
+            LayerStrategy(
+                pp_deg=pp_deg,
+                tp_size=tps[i],
+                dp_size=max(dp_size, 1),
+                cp_size=cps[i],
+                sp=bool(sps[i]),
+                tp_consecutive=bool(cons[i]),
+                dp_type=dp_type,
+                checkpoint=bool(ckpt[i]),
+                ep_size=eps[i],
+                etp_size=etps[i],
+            )
+        )
+    vocab = EmbeddingLMHeadStrategy(
+        vtp=int(cfg.get("vtp", 1)),
+        vsp=bool(int(cfg.get("vsp", 0))),
+        vcp=int(cfg.get("vcp", 1)),
+        embed_sdp=bool(int(cfg.get("embed_sdp", 0))),
+    )
+    extras = {
+        "global_bsz": int(cfg.get("global_bsz", 0)),
+        "chunks": int(cfg.get("chunks", 1)),
+        "pipeline_type": cfg.get("pipeline_type", "pipedream_flush"),
+        "pp_division": _dec(cfg["pp_division"]) if "pp_division" in cfg else None,
+        "default_dp_type": default_dp.short,
+    }
+    return strategies, vocab, extras
+
+
+def save_strategy_config(path: str, cfg: Dict[str, Any]) -> None:
+    with open(path, "w") as f:
+        json.dump(cfg, f, indent=4)
+
+
+def load_strategy_config(path: str) -> Dict[str, Any]:
+    with open(path) as f:
+        return json.load(f)
+
+
+# ---------------------------------------------------------------------------
+# pretty printing (reference: form_strategy / print_strategies)
+# ---------------------------------------------------------------------------
+
+
+def form_strategy(s: LayerStrategy) -> str:
+    bits = [f"pp{s.pp_deg}", f"tp{s.tp_size}", f"dp{s.dp_size}({s.dp_type.short})"]
+    if s.cp_size > 1:
+        bits.append(f"cp{s.cp_size}")
+    if s.sp:
+        bits.append("ulysses")
+    if s.ep_size > 1:
+        bits.append(f"ep{s.ep_size}xetp{s.etp_size}")
+    if s.checkpoint:
+        bits.append("ckpt")
+    if not s.tp_consecutive:
+        bits.append("nonconsec")
+    return "-".join(bits)
+
+
+def print_strategies(strategies: Sequence[LayerStrategy]) -> str:
+    """Compress a per-layer list into 'strategy*count' runs for logging."""
+    out: List[str] = []
+    run_start = 0
+    for i in range(1, len(strategies) + 1):
+        if i == len(strategies) or strategies[i].key() != strategies[run_start].key():
+            count = i - run_start
+            txt = form_strategy(strategies[run_start])
+            out.append(f"{txt}*{count}" if count > 1 else txt)
+            run_start = i
+    return ", ".join(out)

@@ -1,0 +1,34 @@
+"""Test harness: force a virtual 8-device CPU platform before JAX initializes.
+
+The reference needs >=8 real GPUs + NCCL for its distributed tier
+(tests/conftest.py:81-185 spawns ranked subprocesses). On JAX we instead run
+all "distributed" tests in-process on a virtual CPU mesh via
+``--xla_force_host_platform_device_count`` (SURVEY.md §4), so the full parallel
+test matrix runs on CI with no accelerator.
+"""
+
+import os
+
+# Must be set before jax is imported anywhere.
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def cpu_devices():
+    devs = jax.devices("cpu")
+    assert len(devs) >= 8, f"expected >=8 virtual cpu devices, got {len(devs)}"
+    return devs[:8]
+
+
+@pytest.fixture(scope="session")
+def mesh8(cpu_devices):
+    """A flat 8-device mesh most parallel tests start from."""
+    import numpy as np
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(cpu_devices).reshape(8), ("devices",))

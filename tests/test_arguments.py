@@ -1,0 +1,62 @@
+"""Config spine tests (parity with reference tests/test_arguments.py:
+YAML + override round-trips through the validated schema)."""
+
+import pytest
+
+from hetu_galvatron_tpu.core.arguments import load_config, parse_overrides, args_from_cli
+
+pytestmark = pytest.mark.utils
+
+
+def test_defaults():
+    args = load_config()
+    assert args.model.hidden_size == 768
+    assert args.parallel.mixed_precision == "bf16"
+    assert args.mode == "train_dist"
+
+
+def test_yaml_and_overrides(tmp_path):
+    cfg = tmp_path / "m.yaml"
+    cfg.write_text(
+        "model:\n  hidden_size: 1024\n  num_hidden_layers: 4\n"
+        "parallel:\n  global_tp_deg: 2\n"
+    )
+    args = load_config(str(cfg), ["model.hidden_size=2048", "++parallel.pp_deg=2"])
+    assert args.model.hidden_size == 2048  # override wins over yaml
+    assert args.model.num_hidden_layers == 4
+    assert args.parallel.global_tp_deg == 2
+    assert args.parallel.pp_deg == 2
+
+
+def test_include_composition(tmp_path):
+    (tmp_path / "base.yaml").write_text("model:\n  vocab_size: 32000\n  hidden_size: 64\n")
+    child = tmp_path / "child.yaml"
+    child.write_text("include: base.yaml\nmodel:\n  hidden_size: 128\n")
+    args = load_config(str(child))
+    assert args.model.vocab_size == 32000
+    assert args.model.hidden_size == 128
+
+
+def test_override_types():
+    t = parse_overrides(["a.b=8", "a.c=true", "a.d=1e-4", "a.e=hello"])
+    assert t == {"a": {"b": 8, "c": True, "d": 1e-4, "e": "hello"}}
+
+
+def test_invalid_value_rejected():
+    with pytest.raises(Exception):
+        load_config({"parallel": {"mixed_precision": "fp64"}})
+
+
+def test_cli_convention(tmp_path):
+    cfg = tmp_path / "c.yaml"
+    cfg.write_text("model:\n  hidden_size: 256\n")
+    args = args_from_cli([str(cfg), "train.lr=0.5"], mode="train_dist")
+    assert args.model.hidden_size == 256 and args.train.lr == 0.5
+
+
+def test_derived_model_fields():
+    args = load_config({"model": {"hidden_size": 512, "num_attention_heads": 8,
+                                  "vocab_size": 50257}})
+    assert args.model.head_dim == 64
+    assert args.model.padded_vocab_size % 128 == 0
+    assert args.model.kv_heads == 8

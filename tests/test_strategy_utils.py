@@ -1,0 +1,91 @@
+"""Strategy spine tests (parity with reference tests for strategy_utils:
+dataclass invariants + strategy list <-> JSON round trip)."""
+
+import pytest
+
+from hetu_galvatron_tpu.utils.strategy import (
+    DPType,
+    EmbeddingLMHeadStrategy,
+    LayerStrategy,
+    config2strategy,
+    form_strategy,
+    print_strategies,
+    strategy_list2config,
+)
+
+pytestmark = pytest.mark.utils
+
+
+def test_validate_world_size():
+    s = LayerStrategy(pp_deg=2, tp_size=2, dp_size=2)
+    s.validate(8)
+    with pytest.raises(ValueError):
+        s.validate(16)
+    with pytest.raises(ValueError):
+        LayerStrategy(tp_size=3, dp_size=1).validate(3)
+
+
+def test_sp_cp_exclusive():
+    with pytest.raises(ValueError):
+        LayerStrategy(tp_size=2, cp_size=2, sp=True, dp_size=1).validate(4)
+
+
+def test_round_trip():
+    layers = [
+        LayerStrategy(pp_deg=2, tp_size=2, dp_size=2, dp_type=DPType.ZERO3,
+                      checkpoint=True),
+        LayerStrategy(pp_deg=2, tp_size=4, dp_size=1, dp_type=DPType.ZERO2),
+        LayerStrategy(pp_deg=2, tp_size=1, dp_size=2, cp_size=2, dp_type=DPType.ZERO2),
+        LayerStrategy(pp_deg=2, tp_size=2, dp_size=2, sp=True, dp_type=DPType.ZERO2),
+    ]
+    vocab = EmbeddingLMHeadStrategy(vtp=2, vsp=True, embed_sdp=True)
+    cfg = strategy_list2config(
+        layers, global_bsz=16, chunks=4, default_dp_type="zero2", vocab=vocab
+    )
+    assert cfg["pp_deg"] == 2
+    assert cfg["tp_sizes_enc"] == "2,4,1,2"
+    assert cfg["dp_types_enc"] == "1,0,0,0"
+    assert cfg["use_sp"] == "0,0,0,1"
+    assert cfg["cp_sizes_enc"] == "1,1,2,1"
+    assert cfg["checkpoint"] == "1,0,0,0"
+    assert cfg["vtp"] == 2 and cfg["vsp"] == 1 and cfg["embed_sdp"] == 1
+
+    back, vback, extras = config2strategy(cfg, world_size=8)
+    assert [s.key() for s in back] == [s.key() for s in layers]
+    assert vback == vocab
+    assert extras["global_bsz"] == 16 and extras["chunks"] == 4
+
+
+def test_reference_format_json_parses():
+    # A reference-shaped config (BASELINE.md row: searched llama2-7b 8-dev plan)
+    cfg = {
+        "pp_deg": 1,
+        "tp_sizes_enc": ",".join(["1"] * 32),
+        "tp_consecutive_flags": ",".join(["1"] * 32),
+        "dp_types_enc": ",".join(["1"] * 32),
+        "use_sp": ",".join(["0"] * 32),
+        "checkpoint": ",".join(["1"] * 20 + ["0"] * 12),
+        "global_bsz": 16,
+        "chunks": 1,
+        "pp_division": "32",
+        "pipeline_type": "pipedream_flush",
+        "default_dp_type": "zero2",
+        "vtp": 2,
+        "vsp": 1,
+        "embed_sdp": 1,
+    }
+    layers, vocab, extras = config2strategy(cfg, world_size=8)
+    assert len(layers) == 32
+    assert all(s.dp_type == DPType.ZERO3 for s in layers)  # dp_types_enc==1
+    assert sum(s.checkpoint for s in layers) == 20
+    assert layers[0].dp_size == 8
+    assert vocab.vtp == 2 and vocab.vsp
+    assert extras["pipeline_type"] == "pipedream_flush"
+
+
+def test_pretty_print():
+    s = LayerStrategy(pp_deg=2, tp_size=2, dp_size=2, dp_type=DPType.ZERO3,
+                      checkpoint=True)
+    assert "tp2" in form_strategy(s) and "ckpt" in form_strategy(s)
+    txt = print_strategies([s, s, s.with_checkpoint(False)])
+    assert "*2" in txt
