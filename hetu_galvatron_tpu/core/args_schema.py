@@ -17,7 +17,7 @@ from __future__ import annotations
 
 from typing import Any, Dict, List, Literal, Optional
 
-from pydantic import BaseModel, Field, field_validator, model_validator
+from pydantic import BaseModel, Field, model_validator
 
 
 class ModelArgs(BaseModel):
@@ -75,10 +75,9 @@ class ModelArgs(BaseModel):
         m = self.make_vocab_size_divisible_by
         return ((self.vocab_size + m - 1) // m) * m
 
-    @field_validator("num_key_value_heads")
-    @classmethod
-    def _kv_default(cls, v, info):
-        return v
+    # bias flags (HF adapter detects these per family, e.g. qwen2 qkv bias)
+    add_bias_linear: bool = True
+    add_qkv_bias: bool = False
 
 
 class ParallelArgs(BaseModel):

@@ -115,7 +115,7 @@ def args_from_cli(argv: Sequence[str], mode: str) -> CoreArgs:
     cfg_path: Optional[str] = None
     overrides: List[str] = []
     for a in argv:
-        if cfg_path is None and (a.endswith(".yaml") or a.endswith(".yml")):
+        if cfg_path is None and "=" not in a and (a.endswith(".yaml") or a.endswith(".yml")):
             cfg_path = a
         else:
             overrides.append(a)

@@ -64,6 +64,19 @@ def populate_model_args_from_hf(
     values["position_embedding_type"] = (
         "rope" if family in _ROPE_FAMILIES else "learned"
     )
+    # bias detection (reference hf_config_adapter.py:196-290 reads
+    # attention_bias / mlp_bias / family defaults)
+    bias_free = _ROPE_FAMILIES | {"t5"}  # llama-likes and t5 default to no biases
+    if "attention_bias" in d:
+        values["add_qkv_bias"] = bool(d["attention_bias"])
+    elif family in {"qwen", "qwen2"}:
+        values["add_qkv_bias"] = True  # qwen2 has qkv bias, no mlp bias
+    else:
+        values["add_qkv_bias"] = family not in bias_free
+    if "mlp_bias" in d:
+        values["add_bias_linear"] = bool(d["mlp_bias"])
+    else:
+        values["add_bias_linear"] = family not in bias_free
     return ModelArgs.model_validate(values)
 
 
@@ -99,12 +112,21 @@ def model_layer_configs(model_args: ModelArgs) -> List[Dict[str, Any]]:
     }
     if not model_args.num_experts:
         return [base]
+    # dense/MoE alternation: every moe_layer_freq-th layer is MoE, so layer_num
+    # is split between the two layertypes (never double-counted).
+    freq = max(model_args.moe_layer_freq, 1)
+    n = model_args.num_hidden_layers
+    n_moe = n // freq
     moe = dict(base)
     moe.update(
+        layer_num=n_moe,
         num_experts=model_args.num_experts,
         moe_topk=model_args.moe_topk,
         moe_ffn_hidden_size=model_args.moe_ffn_hidden_size or model_args.ffn_dim,
     )
+    if n - n_moe == 0:
+        return [moe]
+    base["layer_num"] = n - n_moe
     return [base, moe]
 
 

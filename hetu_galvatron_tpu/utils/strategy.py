@@ -5,8 +5,9 @@ Capability parity with the reference's strategy spine
 parallel plan, an enum of data-parallel flavours, and converters between a list
 of per-layer strategies and the on-disk ``galvatron_config_*.json`` interchange
 format (same keys: pp_deg / tp_sizes_enc / tp_consecutive_flags / dp_types_enc /
-use_sp / cp_sizes_enc / ep_sizes_enc / checkpoint / global_bsz / chunks /
-pp_division / pipeline_type / default_dp_type / vtp / vsp / embed_sdp), so
+use_sp / cp_sizes_enc / ep_sizes_enc / tp_of_ep_sizes_enc / checkpoint /
+global_bsz / chunks / pp_division / pipeline_type / default_dp_type / vtp /
+vsp / embed_sdp; the legacy ``etp_sizes_enc`` spelling is accepted on read), so
 strategy JSONs remain the interchange artifact between search engine and
 runtime, as in the reference (consumed at
 galvatron/core/runtime/hybrid_parallel_config.py:50-101).
@@ -130,6 +131,14 @@ class EmbeddingLMHeadStrategy:
 # ---------------------------------------------------------------------------
 
 
+def default_pp_division(num_layers: int, pp_deg: int) -> List[int]:
+    """Even stage split with the remainder folded into the last stage, matching
+    the reference default (avg*(pp-1) + rest) so sum == num_layers always."""
+    pp_deg = max(pp_deg, 1)
+    avg = num_layers // pp_deg
+    return [avg] * (pp_deg - 1) + [num_layers - avg * (pp_deg - 1)]
+
+
 def _enc(values: Sequence[Any]) -> str:
     return ",".join(str(int(v)) for v in values)
 
@@ -151,18 +160,28 @@ def strategy_list2config(
     """Serialize per-layer strategies to the interchange dict.
 
     ``dp_types_enc`` keeps the reference encoding: 0 means "use
-    ``default_dp_type``", 1 means "force ZeRO-3 for this layer".
+    ``default_dp_type``", 1 means "force ZeRO-3 for this layer". The one-bit
+    format can only carry {default, ZERO3}; any other per-layer dp_type would
+    be silently coerced on round-trip, so it raises instead.
     """
     if not strategies:
         raise ValueError("empty strategy list")
     pp_deg = strategies[0].pp_deg
     default_dp = DPType.from_name(default_dp_type)
     dp_types = []
-    for s in strategies:
+    for i, s in enumerate(strategies):
         if s.pp_deg != pp_deg:
             raise ValueError("all layers must share one pp_deg")
-        dp_types.append(1 if s.dp_type == DPType.ZERO3 and default_dp != DPType.ZERO3
-                        else (0 if s.dp_type == default_dp else int(s.dp_type == DPType.ZERO3)))
+        if s.dp_type == default_dp:
+            dp_types.append(0)
+        elif s.dp_type == DPType.ZERO3:
+            dp_types.append(1)
+        else:
+            raise ValueError(
+                f"layer {i}: dp_type {s.dp_type.short} is not representable in "
+                f"dp_types_enc with default_dp_type={default_dp.short} "
+                f"(only the default type or zero3 can be encoded)"
+            )
     vocab = vocab or EmbeddingLMHeadStrategy()
     cfg: Dict[str, Any] = {
         "pp_deg": pp_deg,
@@ -172,12 +191,12 @@ def strategy_list2config(
         "use_sp": _enc([s.sp for s in strategies]),
         "cp_sizes_enc": _enc([s.cp_size for s in strategies]),
         "ep_sizes_enc": _enc([s.ep_size for s in strategies]),
-        "etp_sizes_enc": _enc([s.etp_size for s in strategies]),
+        "tp_of_ep_sizes_enc": _enc([s.etp_size for s in strategies]),
         "checkpoint": _enc([s.checkpoint for s in strategies]),
         "global_bsz": int(global_bsz),
         "chunks": int(chunks),
         "pp_division": _enc(pp_division) if pp_division is not None
-        else _enc([len(strategies) // max(pp_deg, 1)] * pp_deg),
+        else _enc(default_pp_division(len(strategies), pp_deg)),
         "pipeline_type": pipeline_type,
         "default_dp_type": default_dp.short,
         "vtp": vocab.vtp,
@@ -210,7 +229,10 @@ def config2strategy(
     sps = vec("use_sp", 0)
     cps = vec("cp_sizes_enc", 1)
     eps = vec("ep_sizes_enc", 1)
-    etps = vec("etp_sizes_enc", 1)
+    # reference runtime key is tp_of_ep_sizes_enc; accept the legacy
+    # etp_sizes_enc spelling written by early versions of this repo too
+    etps = (_dec(cfg["tp_of_ep_sizes_enc"]) if "tp_of_ep_sizes_enc" in cfg
+            else vec("etp_sizes_enc", 1))
     ckpt = vec("checkpoint", 0)
     default_dp = DPType.from_name(cfg.get("default_dp_type", "ddp"))
     strategies = []
@@ -225,20 +247,21 @@ def config2strategy(
                     f"pp*tp*cp = {denom}"
                 )
             dp_size = world_size // denom
-        strategies.append(
-            LayerStrategy(
-                pp_deg=pp_deg,
-                tp_size=tps[i],
-                dp_size=max(dp_size, 1),
-                cp_size=cps[i],
-                sp=bool(sps[i]),
-                tp_consecutive=bool(cons[i]),
-                dp_type=dp_type,
-                checkpoint=bool(ckpt[i]),
-                ep_size=eps[i],
-                etp_size=etps[i],
-            )
+        s = LayerStrategy(
+            pp_deg=pp_deg,
+            tp_size=tps[i],
+            dp_size=max(dp_size, 1),
+            cp_size=cps[i],
+            sp=bool(sps[i]),
+            tp_consecutive=bool(cons[i]),
+            dp_type=dp_type,
+            checkpoint=bool(ckpt[i]),
+            ep_size=eps[i],
+            etp_size=etps[i],
         )
+        if world_size is not None:
+            s.validate(world_size)
+        strategies.append(s)
     vocab = EmbeddingLMHeadStrategy(
         vtp=int(cfg.get("vtp", 1)),
         vsp=bool(int(cfg.get("vsp", 0))),

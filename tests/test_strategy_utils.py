@@ -89,3 +89,45 @@ def test_pretty_print():
     assert "tp2" in form_strategy(s) and "ckpt" in form_strategy(s)
     txt = print_strategies([s, s, s.with_checkpoint(False)])
     assert "*2" in txt
+
+
+def test_unrepresentable_dp_type_raises():
+    # ZERO2 layer under default ddp cannot be carried by the 1-bit encoding
+    layers = [LayerStrategy(tp_size=1, dp_size=8, dp_type=DPType.ZERO2)]
+    with pytest.raises(ValueError, match="not representable"):
+        strategy_list2config(layers, global_bsz=8, chunks=1, default_dp_type="ddp")
+
+
+def test_default_pp_division_remainder():
+    from hetu_galvatron_tpu.utils.strategy import default_pp_division
+
+    assert default_pp_division(30, 4) == [7, 7, 7, 9]
+    assert default_pp_division(32, 4) == [8, 8, 8, 8]
+    assert default_pp_division(5, 1) == [5]
+    layers = [LayerStrategy(pp_deg=4, tp_size=1, dp_size=2) for _ in range(30)]
+    cfg = strategy_list2config(layers, global_bsz=8, chunks=1)
+    assert sum(int(x) for x in cfg["pp_division"].split(",")) == 30
+
+
+def test_tp_of_ep_key_roundtrip():
+    layers = [LayerStrategy(tp_size=2, dp_size=4, ep_size=4, etp_size=2)]
+    cfg = strategy_list2config(layers, global_bsz=8, chunks=1)
+    assert "tp_of_ep_sizes_enc" in cfg and "etp_sizes_enc" not in cfg
+    back, _, _ = config2strategy(cfg, world_size=8)
+    assert back[0].etp_size == 2
+    # legacy spelling still readable
+    legacy = dict(cfg)
+    legacy["etp_sizes_enc"] = legacy.pop("tp_of_ep_sizes_enc")
+    back2, _, _ = config2strategy(legacy, world_size=8)
+    assert back2[0].etp_size == 2
+
+
+def test_config2strategy_validates_world_size():
+    cfg = {
+        "pp_deg": 1,
+        "tp_sizes_enc": "16",  # tp 16 > world 8
+        "global_bsz": 8,
+        "chunks": 1,
+    }
+    with pytest.raises(ValueError):
+        config2strategy(cfg, world_size=8)

@@ -1,0 +1,127 @@
+"""HF config adapter tests (parity with reference adapter behavior:
+family detection of norm/act/rope/bias + layertype splitting for MoE)."""
+
+import pytest
+
+from hetu_galvatron_tpu.core.args_schema import ModelArgs
+from hetu_galvatron_tpu.utils.hf_config_adapter import (
+    model_layer_configs,
+    model_name,
+    populate_model_args_from_hf,
+)
+
+pytestmark = pytest.mark.utils
+
+
+LLAMA_CFG = {
+    "model_type": "llama",
+    "_name_or_path": "meta-llama/Llama-2-7b-hf",
+    "hidden_size": 4096,
+    "num_hidden_layers": 32,
+    "num_attention_heads": 32,
+    "num_key_value_heads": 32,
+    "intermediate_size": 11008,
+    "vocab_size": 32000,
+    "max_position_embeddings": 4096,
+    "rms_norm_eps": 1e-5,
+    "rope_theta": 10000.0,
+    "tie_word_embeddings": False,
+    "attention_bias": False,
+}
+
+GPT2_CFG = {
+    "model_type": "gpt2",
+    "n_embd": 768,
+    "n_layer": 12,
+    "n_head": 12,
+    "vocab_size": 50257,
+    "n_positions": 1024,
+    "layer_norm_epsilon": 1e-5,
+}
+
+QWEN2_CFG = {
+    "model_type": "qwen2",
+    "hidden_size": 3584,
+    "num_hidden_layers": 28,
+    "num_attention_heads": 28,
+    "num_key_value_heads": 4,
+    "intermediate_size": 18944,
+    "vocab_size": 152064,
+    "max_position_embeddings": 32768,
+    "rms_norm_eps": 1e-6,
+    "tie_word_embeddings": False,
+}
+
+MIXTRAL_CFG = {
+    "model_type": "mixtral",
+    "hidden_size": 4096,
+    "num_hidden_layers": 32,
+    "num_attention_heads": 32,
+    "num_key_value_heads": 8,
+    "intermediate_size": 14336,
+    "vocab_size": 32000,
+    "num_local_experts": 8,
+    "num_experts_per_tok": 2,
+}
+
+
+def test_llama_family_detection():
+    m = populate_model_args_from_hf(LLAMA_CFG)
+    assert m.model_type == "llama"
+    assert m.normalization == "rmsnorm"
+    assert m.hidden_act == "swiglu"
+    assert m.position_embedding_type == "rope"
+    assert m.hidden_size == 4096 and m.ffn_dim == 11008
+    assert not m.tie_word_embeddings
+    assert not m.add_qkv_bias and not m.add_bias_linear
+
+
+def test_gpt2_family_detection():
+    m = populate_model_args_from_hf(GPT2_CFG)
+    assert m.model_type == "gpt"
+    assert m.normalization == "layernorm"
+    assert m.hidden_act == "gelu"
+    assert m.position_embedding_type == "learned"
+    assert m.hidden_size == 768 and m.num_hidden_layers == 12
+    assert m.max_position_embeddings == 1024
+    assert m.add_qkv_bias and m.add_bias_linear  # gpt2 has all biases
+
+
+def test_qwen2_bias_detection():
+    m = populate_model_args_from_hf(QWEN2_CFG)
+    assert m.add_qkv_bias  # qwen2: qkv bias on
+    assert not m.add_bias_linear  # but no mlp bias
+    assert m.kv_heads == 4  # GQA
+
+
+def test_moe_detection_and_layer_split():
+    m = populate_model_args_from_hf(MIXTRAL_CFG)
+    assert m.model_type == "moe"
+    assert m.num_experts == 8 and m.moe_topk == 2
+    cfgs = model_layer_configs(m)
+    # every layer of mixtral is MoE (moe_layer_freq=1) => single MoE layertype
+    assert len(cfgs) == 1
+    assert cfgs[0]["layer_num"] == 32
+    assert cfgs[0]["num_experts"] == 8
+
+
+def test_moe_alternating_layer_split():
+    m = ModelArgs(num_hidden_layers=24, num_experts=16, moe_layer_freq=2)
+    cfgs = model_layer_configs(m)
+    assert len(cfgs) == 2
+    dense, moe = cfgs
+    assert dense["layer_num"] + moe["layer_num"] == 24
+    assert moe["layer_num"] == 12 and "num_experts" in moe
+
+
+def test_dense_layer_configs():
+    m = ModelArgs()
+    cfgs = model_layer_configs(m)
+    assert len(cfgs) == 1
+    assert cfgs[0]["layer_num"] == m.num_hidden_layers
+    assert cfgs[0]["vocab_size"] == m.padded_vocab_size
+
+
+def test_model_name_sanitized():
+    m = populate_model_args_from_hf(LLAMA_CFG)
+    assert "/" not in model_name(m)
