@@ -1,0 +1,142 @@
+"""Generic causal-LM assembly: arch list -> params/axes pytrees -> forward.
+
+Capability parity with the reference's model builder
+(runtime/models/builder.py:42-121 ``build_causal_lm_arch`` /
+``build_sequential_from_arch`` + MODULE_REGISTRY, modules.py): every supported
+model family (gpt2/llama/qwen/mistral/mixtral) is one generic decoder stack
+parameterized by :class:`ModelArgs`.
+
+TPU design: the "model" is data, not objects — ``init_causal_lm`` returns a
+nested params dict plus a parallel tree of logical-axis names; ``forward``
+is a pure function. Per-layer heterogeneity (different sharding, remat flag,
+attention impl per layer) enters through ``layer_overrides`` rather than
+module wrappers, so one traced program covers any searched strategy.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from hetu_galvatron_tpu.core.args_schema import ModelArgs
+from hetu_galvatron_tpu.models import modules as M
+
+Params = Dict[str, Any]
+
+# Registry of arch-entry -> (init, apply); mirrors the reference
+# MODULE_REGISTRY (builder.py:41) keyed by the same role names.
+MODULE_REGISTRY: Dict[str, Tuple[Callable, Callable]] = {
+    "embed": (M.init_embedding, M.apply_embedding),
+    "decoder": (M.init_decoder_layer, M.apply_decoder_layer),
+    "prenorm": (M.init_norm, M.apply_norm),
+    "head": (M.init_lm_head, M.apply_lm_head),
+}
+
+
+def build_causal_lm_arch(cfg: ModelArgs) -> List[str]:
+    """Arch role list (reference build_causal_lm_arch builder.py:111-121)."""
+    return ["embed"] + ["decoder"] * cfg.num_hidden_layers + ["prenorm", "head"]
+
+
+def init_causal_lm(key: jax.Array, cfg: ModelArgs) -> Tuple[Params, Params]:
+    """Returns (params, logical_axes) with layers as a per-layer tuple so the
+    axes tree mirrors params exactly (required for tree-mapped shardings)."""
+    n = cfg.num_hidden_layers
+    keys = jax.random.split(key, n + 2)
+    embed_p, embed_a = M.init_embedding(keys[0], cfg)
+    layers = [M.init_decoder_layer(keys[1 + i], cfg) for i in range(n)]
+    prenorm_p, prenorm_a = M.init_norm(cfg)
+    head_p, head_a = M.init_lm_head(keys[n + 1], cfg)
+    params = {
+        "embed": embed_p,
+        "layers": tuple(lp for lp, _ in layers),
+        "prenorm": prenorm_p,
+        "head": head_p,
+    }
+    axes = {
+        "embed": embed_a,
+        "layers": tuple(la for _, la in layers),
+        "prenorm": prenorm_a,
+        "head": head_a,
+    }
+    return params, axes
+
+
+def forward_causal_lm(
+    params: Params,
+    tokens: jax.Array,
+    cfg: ModelArgs,
+    *,
+    compute_dtype=jnp.bfloat16,
+    remat_flags: Optional[Sequence[bool]] = None,
+    layer_overrides: Optional[Dict[int, Dict[str, Any]]] = None,
+    logits_fp32: bool = True,
+) -> jax.Array:
+    """tokens [B, S] -> logits [B, S, V].
+
+    ``remat_flags[i]`` turns on `jax.checkpoint` for layer i (the reference's
+    per-layer checkpoint_flags_enc, parallel.py:213-243). ``layer_overrides``
+    maps layer index -> kwargs for :func:`modules.apply_decoder_layer`
+    (e.g. a different ``sdpa_fn`` for Ulysses/ring layers).
+    """
+    S = tokens.shape[1]
+    rope = None
+    if cfg.position_embedding_type == "rope":
+        rope = M.rope_cos_sin(S, cfg.head_dim, cfg.rope_theta)
+    x = M.apply_embedding(params["embed"], tokens, cfg, compute_dtype=compute_dtype)
+    for i, lp in enumerate(params["layers"]):
+        kwargs: Dict[str, Any] = dict(rope=rope, compute_dtype=compute_dtype)
+        if layer_overrides and i in layer_overrides:
+            kwargs.update(layer_overrides[i])
+        fn = lambda p, h, kw=kwargs: M.apply_decoder_layer(p, h, cfg, **kw)
+        if remat_flags is not None and remat_flags[i]:
+            fn = jax.checkpoint(fn)
+        x = fn(lp, x)
+    x = M.apply_norm(params["prenorm"], x, cfg)
+    logits = M.apply_lm_head(
+        params["head"], x, cfg,
+        wte=params["embed"]["wte"], compute_dtype=compute_dtype,
+    )
+    return logits if logits_fp32 else logits.astype(compute_dtype)
+
+
+def causal_lm_loss(
+    params: Params,
+    batch: Dict[str, jax.Array],
+    cfg: ModelArgs,
+    *,
+    compute_dtype=jnp.bfloat16,
+    remat_flags: Optional[Sequence[bool]] = None,
+    layer_overrides: Optional[Dict[int, Dict[str, Any]]] = None,
+) -> jax.Array:
+    """batch: tokens [B,S], labels [B,S], optional loss_mask [B,S] -> scalar.
+
+    Equivalent role to the reference's loss closure from the dataloader
+    (dataloader.py:558 _loss_func + train_dist.py forward_backward wiring).
+    """
+    logits = forward_causal_lm(
+        params, batch["tokens"], cfg,
+        compute_dtype=compute_dtype, remat_flags=remat_flags,
+        layer_overrides=layer_overrides,
+    )
+    return M.cross_entropy_loss(logits, batch["labels"], batch.get("loss_mask"))
+
+
+def param_count(params: Params) -> int:
+    return sum(p.size for p in jax.tree.leaves(params))
+
+
+def model_flops_per_token(cfg: ModelArgs, seq_len: Optional[int] = None) -> float:
+    """Approximate training FLOPs per token (6*N params + attention term),
+    used by the MFU computation in bench/profilers."""
+    s = seq_len or cfg.seq_length
+    h, f, v = cfg.hidden_size, cfg.ffn_dim, cfg.padded_vocab_size
+    nq, nkv, hd = cfg.num_attention_heads, cfg.kv_heads, cfg.head_dim
+    per_layer = 2 * h * (nq + 2 * nkv) * hd  # qkv
+    per_layer += 2 * nq * hd * h  # proj
+    per_layer += 2 * h * f * (3 if M._is_gated(cfg.hidden_act) else 2)  # mlp
+    attn = 2 * 2 * s * nq * hd  # qk^T + pv per token
+    dense = cfg.num_hidden_layers * (per_layer + attn) + 2 * h * v
+    return 3.0 * dense  # fwd + bwd(2x)

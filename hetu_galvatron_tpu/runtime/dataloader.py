@@ -1,0 +1,87 @@
+"""Synthetic + host-side data pipeline.
+
+Capability parity with the reference dataloader layer (runtime/dataloader.py:
+462-567 ``get_train_valid_test_data_iterators`` / ``get_batch`` / ``_loss_func``
+and the random profiling dataset): deterministic synthetic token streams for
+profiling/benchmarks and a batch iterator that yields numpy arrays ready for
+``jax.device_put`` with a dp-sharded layout.
+
+The mmap indexed Megatron dataset (+C++ index builder) is a later component
+(SURVEY C13); this module defines the iterator contract it will plug into.
+
+TPU note: the reference broadcasts batches within TP groups and zigzag-slices
+for CP on each rank (utils.py:194-295). Under GSPMD there is one logical batch:
+`jax.make_array_from_process_local_data` (or device_put with a NamedSharding)
+places the dp-shard on each chip; TP/CP slicing happens inside the jitted
+program via shardings, not in the loader.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, Optional
+
+import numpy as np
+
+from hetu_galvatron_tpu.core.args_schema import CoreArgs, DataArgs, ModelArgs
+
+
+class RandomTokenDataset:
+    """Deterministic random tokens (reference's random dataset used by
+    profiling runs and correctness tests, dataloader.py:462-524)."""
+
+    def __init__(self, vocab_size: int, seq_length: int, size: int = 1024,
+                 seed: int = 1234):
+        self.vocab_size = vocab_size
+        self.seq_length = seq_length
+        self.size = size
+        rng = np.random.RandomState(seed)
+        # +1 token so input/label shift stays inside the sample
+        self._data = rng.randint(
+            0, vocab_size, (size, seq_length + 1), dtype=np.int32)
+
+    def __len__(self) -> int:
+        return self.size
+
+    def __getitem__(self, idx: int) -> np.ndarray:
+        return self._data[idx % self.size]
+
+
+def make_batch(samples: np.ndarray) -> Dict[str, np.ndarray]:
+    """[B, S+1] tokens -> {tokens, labels, loss_mask} (the reference's
+    get_batch shift, dataloader.py:525-557)."""
+    return {
+        "tokens": samples[:, :-1].astype(np.int32),
+        "labels": samples[:, 1:].astype(np.int32),
+        "loss_mask": np.ones_like(samples[:, 1:], dtype=np.float32),
+    }
+
+
+def synthetic_batches(
+    model: ModelArgs,
+    global_batch_size: int,
+    *,
+    size: int = 1024,
+    seed: int = 1234,
+) -> Iterator[Dict[str, np.ndarray]]:
+    """Infinite iterator of global batches of synthetic data."""
+    ds = RandomTokenDataset(model.padded_vocab_size, model.seq_length,
+                            size=size, seed=seed)
+    i = 0
+    while True:
+        idx = [(i * global_batch_size + j) % len(ds)
+               for j in range(global_batch_size)]
+        yield make_batch(np.stack([ds[j] for j in idx]))
+        i += 1
+
+
+def get_data_iterator(
+    args: CoreArgs, *, global_batch_size: Optional[int] = None
+) -> Iterator[Dict[str, np.ndarray]]:
+    """Entry point mirroring get_train_valid_test_data_iterators
+    (dataloader.py:462)."""
+    gbs = global_batch_size or args.parallel.global_train_batch_size
+    data: DataArgs = args.data
+    if data.dataset == "random":
+        return synthetic_batches(args.model, gbs, seed=args.train.seed)
+    raise NotImplementedError(
+        "indexed datasets land with the C++ index builder (SURVEY C13)")

@@ -117,6 +117,8 @@ def model_layer_configs(model_args: ModelArgs) -> List[Dict[str, Any]]:
     freq = max(model_args.moe_layer_freq, 1)
     n = model_args.num_hidden_layers
     n_moe = n // freq
+    if n_moe == 0:
+        return [base]
     moe = dict(base)
     moe.update(
         layer_num=n_moe,

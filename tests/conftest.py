@@ -17,6 +17,11 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 import jax  # noqa: E402
 import pytest  # noqa: E402
 
+# The axon TPU plugin's sitecustomize register() rewrites jax_platforms to
+# "axon,cpu" at import, overriding the env var — force it back so tests never
+# initialize (or hang on) the tunneled TPU backend.
+jax.config.update("jax_platforms", "cpu")
+
 
 @pytest.fixture(scope="session")
 def cpu_devices():
