@@ -1,0 +1,104 @@
+"""Benchmark: GPT-2 125M training step on one chip -> tokens/sec + MFU.
+
+BASELINE.md milestone 1 (GPT-2 125M fwd+bwd) measured as a full jitted
+train step (fwd + bwd + Adam), bf16 compute. Prints ONE JSON line:
+  {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
+vs_baseline is measured MFU / the BASELINE.json north-star 40% MFU target.
+
+Env knobs: BENCH_PLATFORM=cpu forces the virtual-CPU path (smoke testing);
+BENCH_BSZ / BENCH_SEQ / BENCH_ITERS override shapes.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+# chip -> peak bf16 FLOP/s (public TPU specs)
+PEAK_FLOPS = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,  # v5e
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,  # v5p
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,  # v6e (Trillium)
+    "TPU v6e": 918e12,
+    "cpu": 1e12,  # nominal, smoke only
+}
+
+
+def main():
+    if os.environ.get("BENCH_PLATFORM") == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+    import jax.numpy as jnp
+
+    from hetu_galvatron_tpu.core.args_schema import ModelArgs, TrainArgs
+    from hetu_galvatron_tpu.models.builder import (
+        init_causal_lm,
+        model_flops_per_token,
+        param_count,
+    )
+    from hetu_galvatron_tpu.runtime.dataloader import make_batch
+    from hetu_galvatron_tpu.runtime.optimizer import make_optimizer
+    from hetu_galvatron_tpu.runtime.trainer import make_loss_fn, make_train_step
+
+    dev = jax.devices()[0]
+    kind = dev.device_kind
+    peak = next((v for k, v in PEAK_FLOPS.items() if kind.startswith(k)),
+                PEAK_FLOPS.get(kind, 197e12))
+    if dev.platform == "cpu":
+        peak = PEAK_FLOPS["cpu"]
+
+    seq = int(os.environ.get("BENCH_SEQ", 1024))
+    bsz = int(os.environ.get("BENCH_BSZ", 8))
+    iters = int(os.environ.get("BENCH_ITERS", 10))
+    cfg = ModelArgs(model_name="gpt2-small", seq_length=seq,
+                    max_position_embeddings=max(seq, 1024))
+
+    params, _ = init_causal_lm(jax.random.key(0), cfg)
+    tx = make_optimizer(TrainArgs(lr=1e-4, lr_decay_style="constant"))
+    loss_fn = make_loss_fn(cfg, compute_dtype=jnp.bfloat16)
+    step = jax.jit(make_train_step(loss_fn, tx), donate_argnums=(0, 1))
+
+    params = jax.device_put(params, dev)
+    opt = jax.jit(tx.init)(params)
+    data = np.random.RandomState(0).randint(0, cfg.padded_vocab_size,
+                                            (bsz, seq + 1))
+    batch = jax.device_put(jax.tree.map(jnp.asarray, make_batch(data)), dev)
+
+    for _ in range(3):  # warmup + compile
+        params, opt, metrics = step(params, opt, batch)
+    jax.block_until_ready(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, opt, metrics = step(params, opt, batch)
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = bsz * seq * iters / dt
+    flops_tok = model_flops_per_token(cfg, seq)
+    mfu = tokens_per_sec * flops_tok / peak * 100.0
+    out = {
+        "metric": "gpt2_125m_train_mfu",
+        "value": round(mfu, 2),
+        "unit": "% MFU",
+        "vs_baseline": round(mfu / 40.0, 4),
+        "tokens_per_sec": round(tokens_per_sec, 1),
+        "step_ms": round(dt / iters * 1000, 2),
+        "params": param_count(params),
+        "device": kind,
+        "bsz": bsz,
+        "seq": seq,
+        "loss": round(float(metrics["loss"]), 4),
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

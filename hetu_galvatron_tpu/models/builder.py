@@ -72,6 +72,7 @@ def forward_causal_lm(
     compute_dtype=jnp.bfloat16,
     remat_flags: Optional[Sequence[bool]] = None,
     layer_overrides: Optional[Dict[int, Dict[str, Any]]] = None,
+    boundary_fn: Optional[Callable[[int, jax.Array], jax.Array]] = None,
     logits_fp32: bool = True,
 ) -> jax.Array:
     """tokens [B, S] -> logits [B, S, V].
@@ -79,7 +80,11 @@ def forward_causal_lm(
     ``remat_flags[i]`` turns on `jax.checkpoint` for layer i (the reference's
     per-layer checkpoint_flags_enc, parallel.py:213-243). ``layer_overrides``
     maps layer index -> kwargs for :func:`modules.apply_decoder_layer`
-    (e.g. a different ``sdpa_fn`` for Ulysses/ring layers).
+    (e.g. a different ``sdpa_fn`` for Ulysses/ring layers). ``boundary_fn(i,
+    x)`` is applied to the hidden state before layer i and once after the last
+    layer (i == num layers) — the SPMD layer uses it to place
+    `with_sharding_constraint` resharding at layer boundaries, replacing the
+    reference's relocation wrappers (runtime/parallel.py:272-304).
     """
     S = tokens.shape[1]
     rope = None
@@ -87,6 +92,8 @@ def forward_causal_lm(
         rope = M.rope_cos_sin(S, cfg.head_dim, cfg.rope_theta)
     x = M.apply_embedding(params["embed"], tokens, cfg, compute_dtype=compute_dtype)
     for i, lp in enumerate(params["layers"]):
+        if boundary_fn is not None:
+            x = boundary_fn(i, x)
         kwargs: Dict[str, Any] = dict(rope=rope, compute_dtype=compute_dtype)
         if layer_overrides and i in layer_overrides:
             kwargs.update(layer_overrides[i])
@@ -94,6 +101,8 @@ def forward_causal_lm(
         if remat_flags is not None and remat_flags[i]:
             fn = jax.checkpoint(fn)
         x = fn(lp, x)
+    if boundary_fn is not None:
+        x = boundary_fn(len(params["layers"]), x)
     x = M.apply_norm(params["prenorm"], x, cfg)
     logits = M.apply_lm_head(
         params["head"], x, cfg,
@@ -110,6 +119,7 @@ def causal_lm_loss(
     compute_dtype=jnp.bfloat16,
     remat_flags: Optional[Sequence[bool]] = None,
     layer_overrides: Optional[Dict[int, Dict[str, Any]]] = None,
+    boundary_fn: Optional[Callable[[int, jax.Array], jax.Array]] = None,
 ) -> jax.Array:
     """batch: tokens [B,S], labels [B,S], optional loss_mask [B,S] -> scalar.
 
@@ -119,7 +129,7 @@ def causal_lm_loss(
     logits = forward_causal_lm(
         params, batch["tokens"], cfg,
         compute_dtype=compute_dtype, remat_flags=remat_flags,
-        layer_overrides=layer_overrides,
+        layer_overrides=layer_overrides, boundary_fn=boundary_fn,
     )
     return M.cross_entropy_loss(logits, batch["labels"], batch.get("loss_mask"))
 

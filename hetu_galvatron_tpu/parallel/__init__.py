@@ -1,0 +1,9 @@
+from hetu_galvatron_tpu.parallel.spmd import (  # noqa: F401
+    batch_sharding,
+    layer_shardings,
+    make_boundary_fn,
+    make_spmd_train_step,
+    opt_state_specs,
+    param_specs,
+    shard_params,
+)

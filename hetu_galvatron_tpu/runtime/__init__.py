@@ -1,0 +1,12 @@
+from hetu_galvatron_tpu.runtime.hybrid_config import (  # noqa: F401
+    HybridParallelConfig,
+    get_chunks,
+    get_hybrid_parallel_config,
+)
+from hetu_galvatron_tpu.runtime.mesh import (  # noqa: F401
+    LayerSharding,
+    build_mesh,
+    lower_strategy,
+    lower_vocab_strategy,
+    stage_axes,
+)

@@ -1,0 +1,143 @@
+"""Hybrid-parallel configuration: GLOBAL/JSON modes -> per-layer strategies.
+
+Capability parity with the reference's config expansion
+(runtime/hybrid_parallel_config.py:18-130 ``get_hybrid_parallel_configs_api``,
+:229-369 ``hp_config_whole_model`` + ``get_chunks``): GLOBAL mode replicates
+the uniform CLI knobs across all layers; JSON mode loads a searched
+``galvatron_config_*.json`` plan and overrides global_bsz / chunks / pp_deg /
+vocab degrees from it; the whole-model expansion attaches vocab-strategy rows
+for the embedding / final-norm / LM-head; ``chunks == -1`` auto-computes the
+microbatch count.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import math
+
+from hetu_galvatron_tpu.core.args_schema import CoreArgs
+from hetu_galvatron_tpu.utils.strategy import (
+    DPType,
+    EmbeddingLMHeadStrategy,
+    LayerStrategy,
+    config2strategy,
+    default_pp_division,
+    load_strategy_config,
+)
+
+
+@dataclass
+class HybridParallelConfig:
+    """Resolved plan for the whole model (the reference's
+    hybrid_parallel_configs dict, hybrid_parallel_config.py:120-139)."""
+
+    layers: List[LayerStrategy]  # one per decoder layer
+    vocab: EmbeddingLMHeadStrategy
+    pp_deg: int
+    pp_division: List[int]  # decoder layers per stage, sums to len(layers)
+    chunks: int
+    global_bsz: int
+    pipeline_type: str
+    default_dp_type: DPType
+    world_size: int
+
+    @property
+    def pp_stage_of_layer(self) -> List[int]:
+        """Decoder layer index -> pipeline stage (reference pp_ranks_enc)."""
+        out = []
+        for stage, n in enumerate(self.pp_division):
+            out.extend([stage] * n)
+        return out
+
+    def describe(self) -> str:
+        from hetu_galvatron_tpu.utils.strategy import print_strategies
+
+        return (f"pp{self.pp_deg} chunks{self.chunks} bsz{self.global_bsz} "
+                f"[{print_strategies(self.layers)}] vocab(vtp{self.vocab.vtp}"
+                f"{' vsp' if self.vocab.vsp else ''})")
+
+
+def get_chunks(args: CoreArgs, world_size: int) -> int:
+    """chunks==-1 auto-compute (reference get_chunks,
+    hybrid_parallel_config.py:359-368): no pipeline -> 1; else aim for
+    microbatches of ~4 samples per max-dp rank."""
+    chunks = args.parallel.chunks
+    if chunks != -1:
+        return max(chunks, 1)
+    pp = args.parallel.pp_deg
+    if pp <= 1:
+        return 1
+    max_dp = world_size // pp
+    local_bsz = args.parallel.global_train_batch_size / max(max_dp, 1)
+    return max(int(math.ceil(local_bsz / 4)), 1)
+
+
+def get_hybrid_parallel_config(
+    args: CoreArgs, world_size: int
+) -> HybridParallelConfig:
+    """GLOBAL or JSON mode -> HybridParallelConfig (reference
+    get_hybrid_parallel_configs_api, hybrid_parallel_config.py:18-130)."""
+    par = args.parallel
+    n_layers = args.model.num_hidden_layers
+    use_json = par.config_mode == "json" or (
+        par.galvatron_config_path not in (None, "", "None"))
+
+    if use_json:
+        cfg = load_strategy_config(par.galvatron_config_path)
+        layers, vocab, extras = config2strategy(cfg, world_size=world_size)
+        if len(layers) != n_layers:
+            raise ValueError(
+                f"plan has {len(layers)} layers, model has {n_layers}")
+        pp_deg = layers[0].pp_deg
+        global_bsz = extras["global_bsz"] or par.global_train_batch_size
+        chunks = extras["chunks"] or 1
+        pipeline_type = extras["pipeline_type"]
+        default_dp = DPType.from_name(extras["default_dp_type"])
+        pp_division = extras["pp_division"] or default_pp_division(
+            n_layers, pp_deg)
+    else:
+        pp_deg = par.pp_deg
+        if world_size % pp_deg:
+            raise ValueError(f"world {world_size} % pp {pp_deg} != 0")
+        stage = world_size // pp_deg
+        tp = max(par.global_tp_deg, 1)
+        cp = max(par.global_cp_deg, 1)
+        if stage % (tp * cp):
+            raise ValueError(
+                f"stage world {stage} not divisible by tp{tp}*cp{cp}")
+        default_dp = DPType.from_name(par.default_dp_type)
+        dp_type = DPType.ZERO3 if par.sdp else default_dp
+        base = LayerStrategy(
+            pp_deg=pp_deg, tp_size=tp, cp_size=cp, dp_size=stage // (tp * cp),
+            sp=par.use_ulysses, tp_consecutive=bool(par.global_tp_consec),
+            dp_type=dp_type, checkpoint=bool(par.global_checkpoint),
+        )
+        layers = [base] * n_layers
+        vocab = EmbeddingLMHeadStrategy(
+            vtp=par.vocab_tp,
+            vsp=bool(par.vocab_sp) or par.use_ulysses,  # ulysses forces vsp
+            vcp=par.vocab_cp,
+            embed_sdp=bool(par.embed_sdp),
+        )
+        global_bsz = par.global_train_batch_size
+        pipeline_type = par.pipeline_type
+        pp_division = default_pp_division(n_layers, pp_deg)
+        chunks = get_chunks(args, world_size)
+
+    if sum(pp_division) != n_layers:
+        raise ValueError(f"pp_division {pp_division} != layer count {n_layers}")
+    min_tp = min(min(s.tp_size for s in layers), vocab.vtp)
+    min_cp = min(min(s.cp_size for s in layers), vocab.vcp)
+    grain = world_size // pp_deg // min_tp // min_cp
+    if global_bsz % max(grain, 1):
+        raise ValueError(
+            f"global_bsz {global_bsz} must be a multiple of "
+            f"world//pp//min_tp//min_cp = {grain}")
+    return HybridParallelConfig(
+        layers=list(layers), vocab=vocab, pp_deg=pp_deg,
+        pp_division=list(pp_division), chunks=chunks, global_bsz=global_bsz,
+        pipeline_type=pipeline_type, default_dp_type=default_dp,
+        world_size=world_size,
+    )

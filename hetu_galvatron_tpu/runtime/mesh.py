@@ -1,0 +1,205 @@
+"""Device mesh construction + per-layer strategy -> GSPMD sharding lowering.
+
+Capability parity with the reference's comm-group machinery
+(runtime/comm_groups.py:266-442 ``gen_comm_groups`` and
+runtime/parallel_state.py): where the reference builds NCCL process groups per
+layer from the strategy vectors, we lower each :class:`LayerStrategy` to
+`PartitionSpec`s over ONE global mesh — XLA materializes the collectives.
+
+TPU-first design — the **binary-factorized mesh**: the per-stage world of
+``W = 2^k`` chips becomes ``k`` binary mesh axes ``d0..d{k-1}`` (plus a ``pp``
+axis when pp_deg > 1). A layer with (tp=4, dp=2) on W=8 shards its weights
+over the two innermost axes ``(d1, d2)`` and its batch over ``d0``; the next
+layer with (tp=2, dp=4) uses ``(d2,)`` and ``(d0, d1)``. Because both shardings
+live on the same mesh, GSPMD inserts exactly the boundary reshard the
+reference implements by hand (split/all-gather "relocation",
+runtime/parallel.py:272-304) — heterogeneous per-layer parallelism becomes a
+sharding annotation problem instead of a process-group bookkeeping problem.
+
+Axis order follows the reference's rank-coordinate order 'pp-dp-cp-tp'
+(comm_groups.py:39-116): tp innermost = adjacent chips = ICI-local, dp
+outermost = ready to ride DCN on multi-pod (SURVEY §2.2).
+
+Logical param axes (see models/modules.py init_*) map per layer:
+  "qkv"/"mlp"/"heads"  -> the layer's tp axes  (Megatron TP; () under Ulysses)
+  "vocab"              -> the vocab layer's vtp axes
+  "embed" (2D+ params) -> dp axes under ZeRO-3, else replicated
+  anything else        -> replicated
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from hetu_galvatron_tpu.utils.strategy import (
+    DPType,
+    EmbeddingLMHeadStrategy,
+    LayerStrategy,
+)
+
+# logical param-axis names sharded by tensor parallelism
+_TP_LOGICAL = ("qkv", "mlp", "heads", "vocab")
+
+
+def _log2(n: int) -> int:
+    k = n.bit_length() - 1
+    if n <= 0 or (1 << k) != n:
+        raise ValueError(f"{n} is not a positive power of two")
+    return k
+
+
+def build_mesh(
+    world_size: int,
+    pp_deg: int = 1,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """One global mesh: ('pp', 'd0', ..., 'd{k-1}') with binary d-axes.
+
+    ``devices`` defaults to jax.devices(). Device order: pp outermost (stage
+    boundaries cross the slowest links), then d0..dk with dk fastest-varying
+    (tp-adjacent chips are ICI neighbours, the reference's "consecutive"
+    locality, comm_groups.py:96-100).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if len(devices) < world_size:
+        raise ValueError(f"need {world_size} devices, have {len(devices)}")
+    devices = devices[:world_size]
+    if world_size % pp_deg:
+        raise ValueError(f"world {world_size} not divisible by pp {pp_deg}")
+    # only the per-stage world must be 2^k (it becomes the binary d-axes);
+    # pp is a plain leading axis and may be any size (pp=3 on 24 chips is fine)
+    stage = world_size // pp_deg
+    k = _log2(stage)
+    shape = (pp_deg,) + (2,) * k
+    names = ("pp",) + tuple(f"d{i}" for i in range(k))
+    return Mesh(np.asarray(devices).reshape(shape), names)
+
+
+def stage_axes(mesh: Mesh) -> Tuple[str, ...]:
+    """The binary intra-stage axes, outermost first."""
+    return tuple(n for n in mesh.axis_names if n != "pp")
+
+
+@dataclass(frozen=True)
+class LayerSharding:
+    """A layer's strategy lowered onto the mesh: which binary axes carry
+    dp / cp / tp, plus the dp flavour and remat flag.
+
+    Replaces the reference's per-layer group tuple (tp_group, dp_group,
+    cp_group, ... from gen_comm_groups) with named-axis assignments.
+    """
+
+    dp_axes: Tuple[str, ...]
+    cp_axes: Tuple[str, ...]
+    tp_axes: Tuple[str, ...]
+    ulysses: bool = False  # tp axes carry sequence (a2a attention), not weights
+    dp_type: DPType = DPType.DDP
+    checkpoint: bool = False
+
+    # -- param / optimizer-state specs ------------------------------------
+
+    def _weight_axes(self) -> Tuple[str, ...]:
+        return () if self.ulysses else self.tp_axes
+
+    def param_spec(self, logical_axes: Tuple[str, ...],
+                   zero3_override: Optional[bool] = None) -> P:
+        """PartitionSpec for a param with the given logical axis names."""
+        zero3 = (self.dp_type == DPType.ZERO3
+                 if zero3_override is None else zero3_override)
+        shard_embed = zero3 and len(logical_axes) >= 2
+        dims = []
+        for name in logical_axes:
+            if name in _TP_LOGICAL:
+                dims.append(self._weight_axes() or None)
+            elif name == "embed" and shard_embed:
+                dims.append(self.dp_axes or None)
+            else:
+                dims.append(None)
+        return P(*dims)
+
+    def opt_spec(self, logical_axes: Tuple[str, ...]) -> P:
+        """Optimizer-moment spec: ZeRO-2 shards moments over dp even when
+        params are replicated (reference SHARD_GRAD_OP, parallel.py:121)."""
+        zero3 = self.dp_type in (DPType.ZERO2, DPType.ZERO3)
+        return self.param_spec(logical_axes, zero3_override=zero3)
+
+    # -- activation specs --------------------------------------------------
+
+    def act_spec(self) -> P:
+        """[B, S, H] hidden-state spec at this layer's boundary:
+        batch over dp, sequence over cp (ring) or tp (Megatron-SP/Ulysses),
+        hidden replicated."""
+        seq = self.cp_axes if self.cp_axes else (self.tp_axes or None)
+        return P(self.dp_axes or None, seq or None, None)
+
+    def batch_spec(self) -> P:
+        """[B, S] token/label spec."""
+        seq = self.cp_axes or None
+        return P(self.dp_axes or None, seq)
+
+    def heads_spec(self) -> P:
+        """[B, S, N, D] q/k/v spec inside attention: heads over tp
+        (Megatron TP and Ulysses both compute attention heads-sharded;
+        Ulysses reaches it via all-to-all from the seq-sharded layout —
+        reference DistributedAttention, attention_impl.py:278-417)."""
+        return P(self.dp_axes or None, self.cp_axes or None,
+                 self.tp_axes or None, None)
+
+    def named(self, spec: P, mesh: Mesh) -> NamedSharding:
+        return NamedSharding(mesh, spec)
+
+
+def lower_strategy(s: LayerStrategy, mesh: Mesh) -> LayerSharding:
+    """Assign the mesh's binary axes to (dp, cp, tp) for one layer.
+
+    Consecutive tp (the default) takes the innermost axes; non-consecutive
+    tp takes the outermost (the reference's strided groups,
+    comm_groups.py:119-203).
+    """
+    axes = stage_axes(mesh)
+    stage = 1 << len(axes)
+    need = s.tp_size * s.cp_size * s.dp_size
+    if need != stage:
+        raise ValueError(
+            f"strategy tp{s.tp_size}*cp{s.cp_size}*dp{s.dp_size} = {need} "
+            f"!= stage world {stage}")
+    ktp, kcp = _log2(s.tp_size), _log2(s.cp_size)
+    kdp = _log2(s.dp_size)
+    if s.tp_consecutive:
+        dp_axes = axes[:kdp]
+        cp_axes = axes[kdp:kdp + kcp]
+        tp_axes = axes[kdp + kcp:]
+    else:
+        tp_axes = axes[:ktp]
+        cp_axes = axes[ktp:ktp + kcp]
+        dp_axes = axes[ktp + kcp:]
+    return LayerSharding(
+        dp_axes=dp_axes, cp_axes=cp_axes, tp_axes=tp_axes,
+        ulysses=s.sp, dp_type=s.dp_type, checkpoint=s.checkpoint,
+    )
+
+
+def lower_vocab_strategy(
+    v: EmbeddingLMHeadStrategy, mesh: Mesh, default_dp_type: DPType
+) -> LayerSharding:
+    """Embedding/LM-head sharding from the vocab strategy (reference
+    hp_config_whole_model embedding rows, hybrid_parallel_config.py:276-293):
+    tp=vtp (or sequence if vsp), cp=vcp, dp the rest; embed_sdp forces
+    ZeRO-3."""
+    stage = 1 << len(stage_axes(mesh))
+    dp = stage // (v.vtp * v.vcp)
+    s = LayerStrategy(
+        pp_deg=mesh.shape.get("pp", 1),
+        tp_size=v.vtp,
+        cp_size=v.vcp,
+        dp_size=dp,
+        sp=v.vsp,
+        dp_type=DPType.ZERO3 if v.embed_sdp else default_dp_type,
+    )
+    return lower_strategy(s, mesh)

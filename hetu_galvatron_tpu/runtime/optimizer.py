@@ -98,29 +98,3 @@ def global_grad_norm(grads: Any) -> jax.Array:
     leaves = [jnp.sum(jnp.square(g.astype(jnp.float32)))
               for g in jax.tree.leaves(grads)]
     return jnp.sqrt(jnp.sum(jnp.stack(leaves)))
-
-
-class TrainState:
-    """Minimal functional train-state bundle (params, opt_state, step).
-
-    Not a flax TrainState on purpose: a plain pytree-of-arrays keeps the
-    sharding story uniform (every leaf gets a PartitionSpec from the mesh
-    layer, including optimizer moments for ZeRO-2).
-    """
-
-    def __init__(self, params, opt_state, step):
-        self.params = params
-        self.opt_state = opt_state
-        self.step = step
-
-    def as_tuple(self):
-        return self.params, self.opt_state, self.step
-
-
-def init_train_state(params: Any, tx: optax.GradientTransformation):
-    return params, tx.init(params), jnp.zeros((), jnp.int32)
-
-
-def apply_updates(params, opt_state, grads, tx):
-    updates, new_opt_state = tx.update(grads, opt_state, params)
-    return optax.apply_updates(params, updates), new_opt_state

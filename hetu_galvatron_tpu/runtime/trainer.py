@@ -59,20 +59,30 @@ def make_train_step(
         if chunks <= 1:
             loss, grads = grad_fn(params, batch)
         else:
-            def microbatch(carry, mb):
-                acc = carry
-                l, g = grad_fn(params, mb)
-                acc = jax.tree.map(
-                    lambda a, b: a + b.astype(jnp.float32) / chunks, acc, g)
-                return acc, l
-
-            zeros = jax.tree.map(
-                lambda p: jnp.zeros(p.shape, jnp.float32), params)
             mbs = jax.tree.map(
                 lambda x: x.reshape((chunks, x.shape[0] // chunks) + x.shape[1:]),
                 batch)
-            grads, losses = jax.lax.scan(microbatch, zeros, mbs)
-            loss = jnp.mean(losses)
+            # token-weighted accumulation: each microbatch's masked-mean loss
+            # is weighted by its share of valid tokens so chunks>1 matches
+            # chunks=1 exactly even under non-uniform loss masks
+            if "loss_mask" in batch:
+                counts = jnp.sum(mbs["loss_mask"].astype(jnp.float32),
+                                 axis=tuple(range(1, batch["loss_mask"].ndim + 1)))
+                weights = counts / jnp.maximum(jnp.sum(counts), 1.0)
+            else:
+                weights = jnp.full((chunks,), 1.0 / chunks, jnp.float32)
+
+            def microbatch(acc, xs):
+                mb, w = xs
+                l, g = grad_fn(params, mb)
+                acc = jax.tree.map(
+                    lambda a, b: a + w * b.astype(jnp.float32), acc, g)
+                return acc, w * l
+
+            zeros = jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), params)
+            grads, wlosses = jax.lax.scan(microbatch, zeros, (mbs, weights))
+            loss = jnp.sum(wlosses)
         gnorm = global_grad_norm(grads)
         updates, new_opt = tx.update(grads, opt_state, params)
         new_params = optax.apply_updates(params, updates)
@@ -107,13 +117,15 @@ def train_loop(
         chunks = max(args.parallel.chunks, 1)
         train_step = jax.jit(make_train_step(loss_fn, tx, chunks=chunks))
     opt_state = tx.init(params)
-    losses = []
+    device_losses = []
     put = device_put or (lambda b: jax.tree.map(jnp.asarray, b))
     for it in range(args.train.train_iters):
         batch = put(next(data_iter))
         params, opt_state, metrics = train_step(params, opt_state, batch)
-        loss = float(metrics["loss"])
-        losses.append(loss)
+        # keep losses on device — a float() here would block async dispatch
+        # and serialize host batch-prep against device compute
+        device_losses.append(metrics["loss"])
         for h in hooks:
             h(it, metrics)
+    losses = [float(l) for l in device_losses]
     return params, opt_state, losses

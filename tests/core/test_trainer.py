@@ -121,3 +121,29 @@ def test_get_data_iterator_random():
     args = CoreArgs(model=TINY.model_dump())
     b = next(get_data_iterator(args, global_batch_size=4))
     assert b["tokens"].shape == (4, TINY.seq_length)
+
+
+def test_microbatch_nonuniform_loss_mask_matches():
+    """chunks>1 must equal chunks=1 even when microbatches carry very
+    different numbers of valid tokens (token-weighted accumulation)."""
+    params, _ = init_causal_lm(jax.random.key(0), TINY)
+    from hetu_galvatron_tpu.runtime.trainer import make_loss_fn
+    loss_fn = make_loss_fn(TINY, compute_dtype=jnp.float32)
+    t = TrainArgs(lr=1e-2, clip_grad=0.0, weight_decay=0.0,
+                  lr_decay_style="constant", lr_warmup_iters=0)
+    tx = make_optimizer(t)
+    step1 = jax.jit(make_train_step(loss_fn, tx, chunks=1))
+    step4 = jax.jit(make_train_step(loss_fn, tx, chunks=4))
+    batch = make_batch(
+        np.random.RandomState(0).randint(0, 64, (8, 9)).astype(np.int32))
+    mask = np.ones((8, 8), np.float32)
+    mask[:2] = 0.0          # first microbatch fully masked
+    mask[2, 4:] = 0.0       # second partially masked
+    batch["loss_mask"] = mask
+    batch = jax.tree.map(jnp.asarray, batch)
+    opt = tx.init(params)
+    p1, _, m1 = step1(params, opt, batch)
+    p4, _, m4 = step4(params, opt, batch)
+    assert abs(float(m1["loss"]) - float(m4["loss"])) < 1e-5
+    for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p4)):
+        np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-5)
