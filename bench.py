@@ -49,10 +49,14 @@ def main():
 
     dev = jax.devices()[0]
     kind = dev.device_kind
-    peak = next((v for k, v in PEAK_FLOPS.items() if kind.startswith(k)),
-                PEAK_FLOPS.get(kind, 197e12))
+    peak = next((v for k, v in PEAK_FLOPS.items() if kind.startswith(k)), None)
     if dev.platform == "cpu":
         peak = PEAK_FLOPS["cpu"]
+    peak_assumed = peak is None
+    if peak_assumed:
+        print(f"warning: unknown device kind {kind!r}; assuming v5e peak "
+              "(197 TFLOP/s) — MFU may be wrong", file=sys.stderr)
+        peak = 197e12
 
     seq = int(os.environ.get("BENCH_SEQ", 1024))
     bsz = int(os.environ.get("BENCH_BSZ", 8))
@@ -93,6 +97,8 @@ def main():
         "step_ms": round(dt / iters * 1000, 2),
         "params": param_count(params),
         "device": kind,
+        "peak_flops": peak,
+        "peak_assumed": peak_assumed,
         "bsz": bsz,
         "seq": seq,
         "loss": round(float(metrics["loss"]), 4),

@@ -92,7 +92,14 @@ def get_hybrid_parallel_config(
                 f"plan has {len(layers)} layers, model has {n_layers}")
         pp_deg = layers[0].pp_deg
         global_bsz = extras["global_bsz"] or par.global_train_batch_size
-        chunks = extras["chunks"] or 1
+        chunks = extras["chunks"]
+        if chunks <= 0:  # -1/0 in a plan means auto-compute, same as GLOBAL
+            if pp_deg <= 1:
+                chunks = 1
+            else:
+                max_dp = world_size // pp_deg
+                chunks = max(
+                    int(math.ceil(global_bsz / max(max_dp, 1) / 4)), 1)
         pipeline_type = extras["pipeline_type"]
         default_dp = DPType.from_name(extras["default_dp_type"])
         pp_division = extras["pp_division"] or default_pp_division(

@@ -35,7 +35,7 @@ from typing import Optional, Sequence, Tuple
 import numpy as np
 
 import jax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from hetu_galvatron_tpu.utils.strategy import (
     DPType,
@@ -143,16 +143,6 @@ class LayerSharding:
         seq = self.cp_axes or None
         return P(self.dp_axes or None, seq)
 
-    def heads_spec(self) -> P:
-        """[B, S, N, D] q/k/v spec inside attention: heads over tp
-        (Megatron TP and Ulysses both compute attention heads-sharded;
-        Ulysses reaches it via all-to-all from the seq-sharded layout —
-        reference DistributedAttention, attention_impl.py:278-417)."""
-        return P(self.dp_axes or None, self.cp_axes or None,
-                 self.tp_axes or None, None)
-
-    def named(self, spec: P, mesh: Mesh) -> NamedSharding:
-        return NamedSharding(mesh, spec)
 
 
 def lower_strategy(s: LayerStrategy, mesh: Mesh) -> LayerSharding:

@@ -59,6 +59,11 @@ def make_train_step(
         if chunks <= 1:
             loss, grads = grad_fn(params, batch)
         else:
+            bsz = batch["tokens"].shape[0]
+            if bsz % chunks:
+                raise ValueError(
+                    f"batch size {bsz} is not divisible by chunks={chunks}; "
+                    f"adjust global_train_batch_size or chunks")
             mbs = jax.tree.map(
                 lambda x: x.reshape((chunks, x.shape[0] // chunks) + x.shape[1:]),
                 batch)
