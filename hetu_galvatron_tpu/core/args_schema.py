@@ -211,14 +211,22 @@ class SearchArgs(BaseModel):
     disable_dp: int = 0
     disable_tp: int = 0
     disable_pp: int = 0
-    disable_sdp: int = 0
+    disable_sdp: int = 0  # alias: disable_fsdp (zero3)
     disable_ckpt: int = 0
     disable_tp_consec: int = 1  # non-consecutive tp rarely wins on ICI
     disable_cp: int = 1
-    disable_ulysses: int = 0
+    disable_ulysses: int = 0  # alias: disable_sp
     disable_vtp: int = 0
+    disable_vsp: int = 0
     max_tp_deg: int = 8
     max_pp_deg: int = 8
+    max_sp_deg: int = 8
+    max_cp_deg: int = 8
+    sequence_parallel: bool = True  # Megatron-SP assumed on with TP
+    global_memory_buffer: bool = True
+    async_grad_reduce: bool = True
+    time_profile_mode: Literal["static", "batch", "sequence"] = "static"
+    memory_profile_mode: Literal["static", "batch", "sequence"] = "static"
     default_dp_type: Literal["ddp", "zero2", "zero3"] = "ddp"
     fine_grained_mode: int = 1
     sequence_parallel_mode: Literal["megatron", "ulysses"] = "megatron"

@@ -1,0 +1,8 @@
+from hetu_galvatron_tpu.core.cost_model.cost import (  # noqa: F401
+    CostContext,
+    embed_memory_cost,
+    embed_time_cost,
+    layer_memory_cost,
+    layer_time_cost,
+    pipeline_time_cost,
+)

@@ -1,0 +1,442 @@
+"""Analytical time/memory cost models for the strategy search.
+
+Capability parity with the reference cost models
+(core/cost_model/components/layer_cost.py:9-328 TimeCostModelBase /
+MemoryCostModelBase, embedding_lmhead_cost.py:9-313, cost_model_handler.py:16
+pipeline_costmodel). The arithmetic is kept semantically identical — the
+golden-value search regression (tests/search_engine/
+test_parallelsim_optimization.py) depends on it — but the structure is
+plain functions over one flat :class:`CostContext` instead of the reference's
+five arg-dataclasses merged through SimpleNamespaces.
+
+Units: memory in MB, profiled times in ms, returned times in seconds.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from hetu_galvatron_tpu.core.search_engine.strategies import SearchStrategy
+from hetu_galvatron_tpu.utils.strategy import DPType
+
+Fit = Union[float, np.ndarray, Tuple[float, float]]
+
+
+def _linear(x, popt):
+    return popt[0] * x + popt[1]
+
+
+def _lookup_latency(select: Dict[Any, float], message_mb: float) -> float:
+    """Measured table hit, else the fitted linear extrapolation (reference
+    layer_cost.py:143-148)."""
+    if message_mb in select:
+        return select[message_mb]
+    return _linear(message_mb, select["popt"])
+
+
+@dataclass
+class CostContext:
+    """Everything one layertype's cost evaluation needs: model shape,
+    profiled model costs, and hardware latency tables (reference ModelArgs /
+    TrainArgs / ParallelArgs / ProfileModelArgs / ProfileHardwareArgs,
+    cost_model_args.py)."""
+
+    # model
+    parameter_size: float = 48.0  # MB per layer
+    seq_length: int = 1024
+    hidden_size: int = 4096
+    layer_num: int = 16
+    # train
+    mixed_precision: bool = True
+    async_grad_reduce: bool = True
+    pytorch_context_mem: float = 1024.0
+    # parallel
+    sequence_parallel: bool = True
+    pipeline_type: str = "gpipe"
+    # profiled model costs
+    forward_computation_time: Fit = 1.0  # ms/sample (or linear fit popt)
+    other_time_profiled: Fit = 0.0
+    tp_activation_per_bsz_dict: Dict[Any, float] = field(default_factory=dict)
+    other_memory_pp_off: Dict[str, Dict[int, float]] = field(default_factory=dict)
+    other_memory_pp_on: Dict[str, Dict[str, Dict[int, float]]] = field(
+        default_factory=dict)
+    # profiled hardware
+    bct_fct_coe: float = 2.0
+    extra_overhead: float = 0.0
+    comm_coe_dict: Dict[str, float] = field(default_factory=dict)  # ms/MB
+    dp_overlap_coe: float = 1.3
+    bct_overlap_coe: float = 1.3
+    p2p_comm_coe_dict: Optional[Dict[int, float]] = None
+    costmodel_coe: float = 1.0
+    allgather_latency: Dict[int, Dict[Any, float]] = field(default_factory=dict)
+    all2all_latency: Dict[int, Dict[Any, float]] = field(default_factory=dict)
+    allreduce_latency: Dict[int, Dict[Any, float]] = field(default_factory=dict)
+
+
+def _zero_ratios(chunks: int, mixed_precision: bool, async_grad_reduce: bool):
+    """(zero2_ratio, zero3_ratio) closures over the shard degree d
+    (reference layer_cost.py:289-300; the +0.003 is the reference's
+    flat all-gather bookkeeping overhead)."""
+    if chunks == 1:
+        z2 = (lambda d: 7 / 8 * (1 / d + 0.003) + 1 / 8) if mixed_precision \
+            else (lambda d: 3 / 4 * (1 / d + 0.003) + 1 / 4)
+        z3 = lambda d: 1 / d + 0.003
+    elif async_grad_reduce:
+        z2 = (lambda d: 6 / 8 * (1 / d + 0.003) + 2 / 8) if mixed_precision \
+            else (lambda d: 2 / 4 * (1 / d + 0.003) + 2 / 4)
+        z3 = (lambda d: 7 / 8 * (1 / d + 0.003) + 1 / 8) if mixed_precision \
+            else (lambda d: 3 / 4 * (1 / d + 0.003) + 1 / 4)
+    else:
+        # sync grad reduce with microbatching keeps an fp32 grad copy (x5/4)
+        z2 = (lambda d: (7 / 8 * (1 / d + 0.003) + 1 / 8) * 5 / 4) \
+            if mixed_precision else (lambda d: 3 / 4 * (1 / d + 0.003) + 1 / 4)
+        z3 = lambda d: (1 / d + 0.003) * 5 / 4
+    return z2, z3
+
+
+# ---------------------------------------------------------------------------
+# decoder-layer time
+# ---------------------------------------------------------------------------
+
+
+def layer_time_cost(
+    s: SearchStrategy, ctx: CostContext, gbsz: int, chunks: int
+) -> Tuple[float, float]:
+    """Per-layer time in seconds: (with grad sync, without). Mirrors
+    TimeCostModelBase end-to-end (layer_cost.py:88-213)."""
+    lbsz = gbsz // chunks // s.dp
+    param_mb = ctx.parameter_size / s.tp
+    n = ctx.layer_num
+
+    # computation (layer_cost.py:88-103)
+    fct_in = ctx.forward_computation_time
+    if isinstance(fct_in, (np.ndarray, tuple, list)):
+        fct = _linear(lbsz / s.tp_sp, fct_in) * n
+    else:
+        fct = fct_in * lbsz / s.tp_sp * n
+    bct = fct * ctx.bct_fct_coe
+    if s.checkpoint:
+        bct += fct
+
+    # dp gradient sync (layer_cost.py:105-116)
+    dp_message = 2 * (s.sdp - 1) * (param_mb / s.sdp) * n
+    if ctx.mixed_precision:
+        dp_message /= 2
+    fsdp_allgather = dp_message * 0.5
+    dc_key = f"{s.sdp}_0" if s.tp != 1 else f"{s.sdp}_1"
+    dc = ctx.comm_coe_dict[dc_key]
+    dc_overlap = dc * ctx.dp_overlap_coe
+
+    # tp/sp collectives (layer_cost.py:119-150)
+    if s.tp_sp == 1:
+        tp_time = 0.0
+    else:
+        if s.tp == 1:  # Ulysses: 2 a2a fwd + 2 bwd per layer
+            comm_num = 4 * n
+            select = ctx.all2all_latency[s.sp]
+        else:  # Megatron TP+SP: 3 ag-equivalents fwd + 3 bwd per layer
+            comm_num = 6 * n
+            select = ctx.allgather_latency[s.tp]
+        if s.checkpoint:
+            comm_num *= 1.5
+        message_mb = (lbsz * ctx.seq_length * ctx.hidden_size *
+                      (2 if ctx.mixed_precision else 4) / 1024 / 1024)
+        tp_time = _lookup_latency(select, message_mb) * comm_num
+
+    # pp p2p (layer_cost.py:152-159)
+    p2p_coe = None
+    p2p_message = 0.0
+    if s.pp > 1 and ctx.p2p_comm_coe_dict is not None:
+        p2p_coe = ctx.p2p_comm_coe_dict[s.pp]
+        p2p_message = (s.pp * 2 * lbsz * ctx.seq_length * ctx.hidden_size *
+                       4 / 1024 / 1024)
+        if ctx.mixed_precision:
+            p2p_message /= 2
+
+    def overlap(dp_msg: float) -> Tuple[float, float]:
+        """Backward-compute/dp-comm overlap split (layer_cost.py:161-178)."""
+        dp_t = dp_msg * dc_overlap
+        bct_t = bct * ctx.bct_overlap_coe
+        if dp_t > bct_t:
+            return bct_t, (dp_msg - bct_t / dc_overlap) * dc
+        if dp_t < bct_t:
+            return dp_t, bct - dp_t / ctx.bct_overlap_coe
+        return bct_t, 0.0
+
+    def result(no_sync: bool) -> float:
+        factor = 0 if no_sync else 1
+        if s.tp_sp == 1 and s.dp > 1:
+            ov, rest = overlap(dp_message * factor)
+            r = fct + ov + rest + ctx.extra_overhead
+        elif s.dp == 1 and s.tp_sp > 1:
+            r = fct + bct + tp_time
+        elif s.dp == 1 and s.tp_sp == 1:
+            r = fct + bct
+        else:
+            ov, rest = overlap(dp_message * factor)
+            r = fct + ov + rest + tp_time + ctx.extra_overhead
+        if s.dp_type == DPType.ZERO3:
+            r += fsdp_allgather * dc
+        if s.pp > 1 and p2p_coe is not None:
+            r += p2p_message * p2p_coe
+        return r * 0.001 * ctx.costmodel_coe / n
+
+    return result(False), result(True)
+
+
+# ---------------------------------------------------------------------------
+# decoder-layer memory
+# ---------------------------------------------------------------------------
+
+
+def layer_memory_cost(
+    s: SearchStrategy,
+    ctx: CostContext,
+    gbsz: int,
+    chunks: int,
+    stage_idx: int = 0,
+    pipeline_type: Optional[str] = None,
+) -> float:
+    """Per-layer memory in MB: model states + activations
+    (MemoryCostModelBase, layer_cost.py:261-328)."""
+    pipeline_type = pipeline_type or ctx.pipeline_type
+    lbsz = gbsz // chunks // s.dp
+    if s.pp == 1:
+        cumulative = 1
+    else:
+        if chunks < s.pp:
+            raise ValueError(f"chunks {chunks} < pp {s.pp}")
+        cumulative = (s.pp - stage_idx if pipeline_type == "pipedream_flush"
+                      else chunks)
+    cum_lbsz = cumulative * lbsz
+
+    z2, z3 = _zero_ratios(chunks, ctx.mixed_precision, ctx.async_grad_reduce)
+    param_mem = ctx.parameter_size / s.tp
+    model_states = 4 * param_mem
+    if s.dp_type == DPType.ZERO3:
+        model_states *= z3(s.sdp)
+    elif s.dp_type == DPType.ZERO2:
+        model_states *= z2(s.sdp)
+
+    act = ctx.tp_activation_per_bsz_dict
+    if s.checkpoint:
+        activation = act["checkpoint"] * cum_lbsz
+        if s.sp > 1 or (s.tp > 1 and ctx.sequence_parallel):
+            activation /= s.tp_sp
+    else:
+        activation = act[s.tp_sp] * cum_lbsz
+    return model_states + activation
+
+
+# ---------------------------------------------------------------------------
+# embedding / LM-head time
+# ---------------------------------------------------------------------------
+
+
+def embed_time_cost(
+    s: SearchStrategy,
+    ctx: CostContext,
+    gbsz: int,
+    chunks: int,
+    seq_len_list: Sequence[int],
+) -> Tuple[List[float], List[float]]:
+    """Per-pipeline-stage vocab-layer times in seconds (with, without grad
+    sync); only first/last stages are nonzero (EmbeddingLMHeadTimeCostModel,
+    embedding_lmhead_cost.py:59-184)."""
+    lbsz = gbsz // chunks // s.dp
+    pp = s.pp
+
+    fct = [0.0] * pp
+    ot = ctx.other_time_profiled
+    if isinstance(ot, (np.ndarray, tuple, list)):
+        fct_time = _linear(lbsz / s.tp_sp / s.cp, ot)
+    else:
+        fct_time = ot * lbsz / s.tp_sp / s.cp
+    if pp == 1:
+        fct[0] = fct_time
+    else:
+        fct[0] = fct_time / 2
+        fct[-1] = fct_time / 2
+
+    key = f"{s.sdp}_0" if s.tp != 1 else f"{s.sdp}_1"
+    dp_coe = ctx.comm_coe_dict[key] * (s.sdp - 1) / s.sdp
+    factor = 0.5 if ctx.mixed_precision else 1.0
+    dp_message = [0.0] * pp
+    if pp == 1:
+        dp_message[0] = ctx.other_memory_pp_off["model_states"][s.tp] / 4 * factor
+    else:
+        dp_message[0] = (ctx.other_memory_pp_on["first_stage"]["model_states"]
+                         [s.tp] / 4 * factor)
+        dp_message[-1] = (ctx.other_memory_pp_on["last_stage"]["model_states"]
+                          [s.tp] / 4 * factor)
+    if s.dp_type == DPType.ZERO3:
+        fwd_factor, bwd_factor = 0.5, 1.0
+    else:
+        fwd_factor, bwd_factor = 0.0, 0.5
+
+    tp_sp_time = [0.0] * pp
+    per_seq = []
+    for seq in seq_len_list:
+        if s.tp_sp == 1 or s.tp == 1:
+            per_seq.append(0.0)
+        else:
+            message_mb = (lbsz * seq * ctx.hidden_size *
+                          (2 if ctx.mixed_precision else 4) / 1024 / 1024)
+            if not ctx.sequence_parallel:
+                raise ValueError("sequence_parallel required when tp > 1")
+            per_seq.append(
+                _lookup_latency(ctx.allgather_latency[s.tp], message_mb))
+    if pp == 1:
+        tp_sp_time[0] = per_seq[0] + per_seq[-1]
+    else:
+        tp_sp_time[0] = per_seq[0]
+        tp_sp_time[-1] = per_seq[-1]
+
+    def overlap_time(f_comm, f_comp, b_comm, b_comp, tp_t):
+        """Compute/comm overlap (embedding_lmhead_cost.py:155-166)."""
+        f_comp = f_comp * ctx.dp_overlap_coe
+        b_comp = b_comp * ctx.dp_overlap_coe
+        fwd = (f_comm + (f_comp - f_comm) / ctx.dp_overlap_coe
+               if f_comp > f_comm else f_comm)
+        bwd = (b_comm + (b_comp - b_comm) / ctx.dp_overlap_coe
+               if b_comp > b_comm else b_comm)
+        return fwd + bwd + tp_t
+
+    ms = 0.001
+    cost = [0.0] * pp
+    cost_no_sync = [0.0] * pp
+    for idx in ([0] if pp == 1 else [0, pp - 1]):
+        cost[idx] = ms * overlap_time(
+            dp_message[idx] * dp_coe * fwd_factor, fct[idx],
+            dp_message[idx] * dp_coe * bwd_factor,
+            fct[idx] * ctx.bct_fct_coe, tp_sp_time[idx])
+        cost_no_sync[idx] = ms * overlap_time(
+            dp_message[idx] * dp_coe * fwd_factor, fct[idx],
+            dp_message[idx] * dp_coe * (bwd_factor - 0.5),
+            fct[idx] * ctx.bct_fct_coe, tp_sp_time[idx])
+    return cost, cost_no_sync
+
+
+# ---------------------------------------------------------------------------
+# embedding / LM-head memory
+# ---------------------------------------------------------------------------
+
+
+def embed_memory_cost(
+    s: SearchStrategy,
+    ctx: CostContext,
+    gbsz: int,
+    chunks: int,
+    pipeline_type: Optional[str] = None,
+) -> List[float]:
+    """Per-stage vocab-layer memory in MB (EmbeddingLMHeadMemoryCostModel,
+    embedding_lmhead_cost.py:187-313)."""
+    pipeline_type = pipeline_type or ctx.pipeline_type
+    lbsz = gbsz // chunks // s.dp
+    pp = s.pp
+    z2, z3 = _zero_ratios(chunks, ctx.mixed_precision, ctx.async_grad_reduce)
+    if s.dp_type == DPType.ZERO3:
+        scale = z3(s.sdp)
+    elif s.dp_type == DPType.ZERO2:
+        scale = z2(s.sdp)
+    else:
+        scale = 1.0
+
+    model_states = [0.0] * pp
+    if pp == 1:
+        model_states[0] = ctx.other_memory_pp_off["model_states"][s.tp] * scale
+    else:
+        model_states[0] = (ctx.other_memory_pp_on["first_stage"]
+                           ["model_states"][s.tp] * scale)
+        model_states[-1] = (ctx.other_memory_pp_on["last_stage"]
+                            ["model_states"][s.tp] * scale)
+
+    activation = [0.0] * pp
+    if pp == 1:
+        activation[0] = (ctx.other_memory_pp_off["activation"][s.tp_sp] * lbsz)
+    else:
+        if chunks < pp:
+            raise ValueError(f"chunks {chunks} < pp {pp}")
+        if pipeline_type == "pipedream_flush":
+            cum_first, cum_last = pp, 1
+        else:
+            cum_first, cum_last = chunks, chunks
+        activation[0] = (ctx.other_memory_pp_on["first_stage"]["activation"]
+                         [s.tp_sp] * cum_first * lbsz)
+        activation[-1] = (ctx.other_memory_pp_on["last_stage"]["activation"]
+                          [s.tp_sp] * cum_last * lbsz)
+
+    return [m + a + ctx.pytorch_context_mem
+            for m, a in zip(model_states, activation)]
+
+
+# ---------------------------------------------------------------------------
+# pipeline schedule cost
+# ---------------------------------------------------------------------------
+
+
+def pipeline_time_cost(
+    layer_num_list: Sequence[int],
+    contexts: Sequence[CostContext],
+    strategy_list: Sequence[SearchStrategy],
+    partition: Sequence[int],
+    chunks: int,
+    gbsz: int,
+    pp_size: int,
+    other_time_cost: Sequence[float],
+) -> float:
+    """End-to-end pipeline time for a concrete per-layer plan (reference
+    pipeline_costmodel, cost_model_handler.py:16-99): per-stage sums of
+    per-layer costs, a warmup/cooldown bubble estimate, and the straggling
+    gradient-reduce tail."""
+    total = sum(layer_num_list)
+    assert len(strategy_list) == total
+    layertype_of = []
+    for t, n in enumerate(layer_num_list):
+        layertype_of.extend([t] * n)
+
+    uniq = list(set(strategy_list))
+    sync_cost: Dict[Tuple[int, SearchStrategy], float] = {}
+    nosync_cost: Dict[Tuple[int, SearchStrategy], float] = {}
+    for t in range(len(layer_num_list)):
+        for s in uniq:
+            w, wo = layer_time_cost(s, contexts[t], gbsz, chunks)
+            sync_cost[(t, s)] = w
+            nosync_cost[(t, s)] = wo
+
+    per_layer_sync = [sync_cost[(layertype_of[i], strategy_list[i])]
+                      for i in range(total)]
+    per_layer_nosync = [nosync_cost[(layertype_of[i], strategy_list[i])]
+                        for i in range(total)]
+
+    def stage_sums(vals):
+        out, start = [], 0
+        for n in partition:
+            out.append(float(np.sum(vals[start:start + n])))
+            start += n
+        return out
+
+    stage_sync = stage_sums(per_layer_sync)
+    stage_compute = stage_sums(per_layer_nosync)
+    assert len(other_time_cost) == len(stage_compute)
+    stage_compute = [c + o for c, o in zip(stage_compute, other_time_cost)]
+
+    result = float(np.sum(stage_compute)) + stage_compute[-1] * (chunks - 1)
+    # warmup/cooldown bubbles partially overlap (handler.py:82-85)
+    result = max(
+        result,
+        max(min(pp_size - 1, chunks - 1) * stage_compute[0] * 1 / 3,
+            float(np.sum(stage_compute[1:])) * 1 / 3)
+        + max(min(pp_size - 1, chunks - 1) * stage_compute[0] * 2 / 3,
+              float(np.sum(stage_compute[1:])) * 2 / 3)
+        + stage_compute[0] * max(0, chunks + 1 - pp_size))
+
+    stage_reduce = list(stage_sync)
+    for i in range(pp_size):
+        stage_reduce[i] -= float(np.sum(stage_compute[:i + 1]))
+    reduce_tail = max(stage_reduce)
+    result += reduce_tail if reduce_tail > 0 else 0.0
+    return result

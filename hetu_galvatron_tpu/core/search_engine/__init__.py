@@ -1,0 +1,10 @@
+from hetu_galvatron_tpu.core.search_engine.engine import (  # noqa: F401
+    SearchEngine,
+    TaskResult,
+)
+from hetu_galvatron_tpu.core.search_engine.strategies import (  # noqa: F401
+    SearchSpaceLimits,
+    SearchStrategy,
+    enumerate_strategies,
+    pp_division_even,
+)

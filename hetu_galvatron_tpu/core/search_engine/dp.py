@@ -1,0 +1,127 @@
+"""Per-stage knapsack DP over (layer, memory budget, strategy).
+
+Capability parity with the reference DP machinery
+(core/search_engine/dynamic_programming.py:12-115 DPAlg + csrc/dp_core.cpp):
+the C++ core is compiled lazily with g++ and bound via ctypes (this image has
+no pybind11, matching the reference's lazy dataset-helper build pattern,
+runtime/initialize.py:163-187); a vectorized NumPy implementation is the
+fallback and the cross-check.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+_CSRC = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "..", "..", "..", "csrc")
+_LIB: Optional[ctypes.CDLL] = None
+_LIB_TRIED = False
+
+
+def _load_cpp_core() -> Optional[ctypes.CDLL]:
+    """Lazily build + load csrc/libdp_core.so; None if the toolchain is
+    unavailable."""
+    global _LIB, _LIB_TRIED
+    if _LIB_TRIED:
+        return _LIB
+    _LIB_TRIED = True
+    so = os.path.join(_CSRC, "libdp_core.so")
+    src = os.path.join(_CSRC, "dp_core.cpp")
+    try:
+        if (not os.path.exists(so)
+                or os.path.getmtime(so) < os.path.getmtime(src)):
+            subprocess.run(["make", "-C", _CSRC], check=True,
+                           capture_output=True)
+        lib = ctypes.CDLL(so)
+        lib.dp_solve.restype = ctypes.c_int
+        lib.dp_solve.argtypes = [
+            ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+            ctypes.c_int, ctypes.c_double,
+            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+            ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_int),
+        ]
+        _LIB = lib
+    except (subprocess.CalledProcessError, OSError) as e:  # no toolchain
+        print(f"dp core: C++ build unavailable ({e}); using numpy fallback")
+        _LIB = None
+    return _LIB
+
+
+def dp_solve(
+    mem_cost: np.ndarray,      # [layers, strategies] int MB
+    intra_cost: np.ndarray,    # [layers, strategies] seconds
+    inter_cost: np.ndarray,    # [layers, strategies, strategies]
+    max_mem: int,
+    other_mem: int,
+    other_time: float,
+    use_cpp_core: bool = True,
+) -> Tuple[float, Optional[list], int]:
+    """Minimize sum of intra+inter costs subject to the per-stage memory
+    budget. Returns (total_cost, per-layer strategy indices | None, remaining
+    memory). Semantics match the reference C++ core (dp_core.cpp:24-121):
+    the vocab-layer memory shrinks the budget and its time adds to the total.
+    """
+    layers, strat = intra_cost.shape
+    budget = max_mem + 1  # budgets 0..max_mem inclusive
+    v = np.ascontiguousarray(mem_cost, np.int32)
+    intra = np.ascontiguousarray(intra_cost, np.float64)
+    inter = np.ascontiguousarray(inter_cost, np.float64)
+
+    if use_cpp_core and (lib := _load_cpp_core()) is not None:
+        mark = np.empty((layers, budget, strat), np.int32)
+        f = np.zeros((budget, strat), np.float64)
+        res = np.empty((layers,), np.int32)
+        total = ctypes.c_double()
+        remain = ctypes.c_int()
+        rc = lib.dp_solve(layers, budget, strat, v, inter, intra,
+                          int(other_mem), float(other_time),
+                          mark, f, res, ctypes.byref(total),
+                          ctypes.byref(remain))
+        if rc != 0:
+            return np.inf, None, -1
+        return float(total.value), [int(x) for x in res], int(remain.value)
+
+    # numpy fallback: same recurrence, vectorized over the memory axis
+    f = np.zeros((budget, strat), np.float64)
+    mark = np.full((layers, budget, strat), -1, np.int32)
+    for i in range(layers):
+        new_f = np.full((budget, strat), np.inf, np.float64)
+        for s in range(strat):
+            need = int(v[i, s])
+            if need > max_mem:
+                continue
+            # candidates[m, si] = f[m - need, si] + inter[i, si, s]
+            cand = f[:budget - need, :] + inter[i, :, s][None, :]
+            best_si = np.argmin(cand, axis=1)
+            rows = np.arange(budget - need)
+            new_f[need:, s] = cand[rows, best_si] + intra[i, s]
+            mark[i, need:, s] = best_si
+        f = new_f
+
+    b = max_mem - other_mem
+    if b < 0:
+        return np.inf, None, -1
+    next_index = int(np.argmin(f[b]))
+    total = f[b, next_index]
+    if not total < np.inf:
+        return np.inf, None, -1
+    total += other_time
+    next_v = b
+    res = [-1] * layers
+    res[layers - 1] = next_index
+    for i in range(layers - 1, 0, -1):
+        cur = next_index
+        next_index = int(mark[i, next_v, next_index])
+        next_v -= int(v[i, cur])
+        res[i - 1] = next_index
+    return float(total), res, next_v - int(v[0, next_index])

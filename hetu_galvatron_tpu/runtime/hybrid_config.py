@@ -59,19 +59,23 @@ class HybridParallelConfig:
                 f"{' vsp' if self.vocab.vsp else ''})")
 
 
-def get_chunks(args: CoreArgs, world_size: int) -> int:
-    """chunks==-1 auto-compute (reference get_chunks,
-    hybrid_parallel_config.py:359-368): no pipeline -> 1; else aim for
-    microbatches of ~4 samples per max-dp rank."""
-    chunks = args.parallel.chunks
+def resolve_chunks(chunks: int, pp_deg: int, global_bsz: int,
+                   world_size: int) -> int:
+    """Shared chunks resolution for GLOBAL and JSON paths (reference
+    get_chunks, hybrid_parallel_config.py:359-368): only -1 auto-computes
+    (aiming for microbatches of ~4 samples per max-dp rank); 0 clamps to 1."""
     if chunks != -1:
         return max(chunks, 1)
-    pp = args.parallel.pp_deg
-    if pp <= 1:
+    if pp_deg <= 1:
         return 1
-    max_dp = world_size // pp
-    local_bsz = args.parallel.global_train_batch_size / max(max_dp, 1)
+    max_dp = world_size // pp_deg
+    local_bsz = global_bsz / max(max_dp, 1)
     return max(int(math.ceil(local_bsz / 4)), 1)
+
+
+def get_chunks(args: CoreArgs, world_size: int) -> int:
+    return resolve_chunks(args.parallel.chunks, args.parallel.pp_deg,
+                          args.parallel.global_train_batch_size, world_size)
 
 
 def get_hybrid_parallel_config(
@@ -92,14 +96,8 @@ def get_hybrid_parallel_config(
                 f"plan has {len(layers)} layers, model has {n_layers}")
         pp_deg = layers[0].pp_deg
         global_bsz = extras["global_bsz"] or par.global_train_batch_size
-        chunks = extras["chunks"]
-        if chunks <= 0:  # -1/0 in a plan means auto-compute, same as GLOBAL
-            if pp_deg <= 1:
-                chunks = 1
-            else:
-                max_dp = world_size // pp_deg
-                chunks = max(
-                    int(math.ceil(global_bsz / max(max_dp, 1) / 4)), 1)
+        chunks = resolve_chunks(extras["chunks"], pp_deg, global_bsz,
+                                world_size)
         pipeline_type = extras["pipeline_type"]
         default_dp = DPType.from_name(extras["default_dp_type"])
         pp_division = extras["pp_division"] or default_pp_division(

@@ -127,6 +127,7 @@ class PipelineEngine:
         self._fwd_jits = [self._make_fwd(st) for st in self.stages]
         self._bwd_jits = [self._make_bwd(st) for st in self.stages]
         self._update_jits = [self._make_update(st) for st in self.stages]
+        self._transpose_jit = jax.jit(jnp.transpose)
         self._gnorm_jit = jax.jit(
             lambda g: sum(jnp.sum(jnp.square(x.astype(jnp.float32)))
                           for x in jax.tree.leaves(g)))
@@ -239,8 +240,10 @@ class PipelineEngine:
                 fn = jax.checkpoint(fn)
             x = fn(lp, x)
         if not st.has_head:
+            # a stage may carry zero decoder layers (embed-only stage 0)
+            sh = st.shardings[-1] if st.shardings else st.vocab
             return jax.lax.with_sharding_constraint(
-                x, NamedSharding(st.mesh, st.shardings[-1].act_spec()))
+                x, NamedSharding(st.mesh, sh.act_spec()))
         x = jax.lax.with_sharding_constraint(
             x, NamedSharding(st.mesh, st.vocab.act_spec()))
         x = M.apply_norm(sp["prenorm"], x, cfg)
@@ -251,24 +254,27 @@ class PipelineEngine:
             preferred_element_type=jnp.float32)
         return M.cross_entropy_loss(logits, labels, loss_mask)
 
-    def _make_fwd(self, st: _Stage) -> Callable:
+    def _make_fwd(self, st: _Stage) -> Optional[Callable]:
         if st.has_head:
-            def f(sp, x, labels, mask):
-                return self._stage_apply(st, sp, x, labels, mask)
-        else:
-            def f(sp, x):
-                return self._stage_apply(st, sp, x)
+            return None  # head fwd is fused into its value_and_grad backward
+
+        def f(sp, x):
+            return self._stage_apply(st, sp, x)
         return jax.jit(f)
 
     def _make_bwd(self, st: _Stage) -> Callable:
-        """(dparams, dx) by recomputing the stage forward (per-stage remat)."""
+        """(dparams, dx) by recomputing the stage forward (per-stage remat).
+        The head stage returns the (unweighted) loss alongside grads so the
+        forward never runs separately just for the metric."""
         if st.has_head:
             def g(sp, x, labels, mask, seed):
                 def lf(sp_, x_):
                     return self._stage_apply(st, sp_, x_, labels, mask)
-                (dp, dx) = jax.grad(
-                    lambda sp_, x_: seed * lf(sp_, x_), argnums=(0, 1))(sp, x)
-                return dp, dx
+                loss, (dp, dx) = jax.value_and_grad(
+                    lambda sp_, x_: lf(sp_, x_), argnums=(0, 1))(sp, x)
+                dp = jax.tree.map(lambda t: seed * t, dp)
+                dx = seed * dx
+                return dp, dx, loss
             return jax.jit(g)
 
         def g(sp, x, dy):
@@ -327,8 +333,9 @@ class PipelineEngine:
         return jax.device_put(y, NamedSharding(st.mesh, spec))
 
     def _fwd_microbatch(self, stage_params, mb, ctx):
-        """Run one microbatch through all stages; returns loss and records
-        per-stage inputs for the backward."""
+        """Run one microbatch up to the head stage's input; the head's
+        forward happens fused with its backward (value_and_grad), so the
+        loss costs no extra pass."""
         x = self._put_stage0(mb)
         inputs = []
         for s in range(self.pp):
@@ -336,8 +343,7 @@ class PipelineEngine:
             if s == self.pp - 1:
                 lbl, msk = self._put_last(mb)
                 ctx["labels"].append((lbl, msk))
-                loss = self._fwd_jits[s](stage_params[s], x, lbl, msk)
-                ctx["losses"].append(loss)
+                ctx["losses"].append(None)  # filled by the backward
             else:
                 y = self._fwd_jits[s](stage_params[s], x)
                 x = self._transfer(y, s + 1)
@@ -348,8 +354,9 @@ class PipelineEngine:
         inputs = ctx["inputs"][m]
         lbl, msk = ctx["labels"][m]
         seed = jnp.asarray(w, jnp.float32)
-        dp, dx = self._bwd_jits[-1](stage_params[-1], inputs[-1], lbl, msk,
-                                    seed)
+        dp, dx, loss = self._bwd_jits[-1](stage_params[-1], inputs[-1], lbl,
+                                          msk, seed)
+        ctx["losses"][m] = loss
         grad_acc[-1] = _tree_add(grad_acc[-1], dp)
         for s in range(self.pp - 2, -1, -1):
             dy = jax.device_put(
@@ -397,19 +404,21 @@ class PipelineEngine:
                     self._fwd_microbatch(stage_params, mbs[next_fwd], ctx)
                     next_fwd += 1
 
-        # tied-embedding grad sum across first/last stages (pipeline.py:1042)
+        # tied-embedding grad sum across first/last stages (pipeline.py:1042);
+        # transposes run jitted on the owning submesh and the sum crosses
+        # stages as a device-to-device sharded transfer (ICI on TPU)
         if self.cfg.tie_word_embeddings and self.pp > 1:
             g_wte = grad_acc[0]["embed"]["wte"]
             g_head = grad_acc[-1]["head"]["whead"]
             g_head_t = jax.device_put(
-                jnp.asarray(jax.device_get(g_head)).T,
+                self._transpose_jit(g_head),
                 NamedSharding(self.stages[0].mesh,
                               self.stages[0].vocab.param_spec(
                                   ("vocab", "embed"))))
             total = g_wte + g_head_t
             grad_acc[0]["embed"]["wte"] = total
             grad_acc[-1]["head"]["whead"] = jax.device_put(
-                jnp.asarray(jax.device_get(total)).T,
+                self._transpose_jit(total),
                 NamedSharding(self.stages[-1].mesh,
                               self.stages[-1].vocab.param_spec(
                                   ("embed", "vocab"))))

@@ -1,0 +1,122 @@
+"""Golden-value regression of the full search flow, mirroring the reference's
+tests/search_engine/test_parallelsim_optimization.py:10-50: same synthetic
+profiling fixtures (tests/fixtures/*.json), same expected throughput and plan
+for the llama-search task (seq 8192, settle_bsz 64, 36 GB, zero2 default,
+pipedream_flush) in fine-grained and coarse modes."""
+
+import glob
+import json
+import os
+
+import pytest
+
+from hetu_galvatron_tpu.core.args_schema import SearchArgs
+from hetu_galvatron_tpu.core.search_engine.engine import SearchEngine
+from hetu_galvatron_tpu.utils.strategy import DPType, config2strategy
+
+pytestmark = pytest.mark.search_engine
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "..", "fixtures")
+
+GOLDEN_FINE = 2.6485091403918064
+GOLDEN_COARSE = 2.5246283459057333
+
+
+def _make_engine(tmp_path, *, settle_chunks, fine_grained):
+    args = SearchArgs(
+        num_nodes=1, num_devices_per_node=8, memory_constraint=36,
+        settle_bsz=64, settle_chunks=settle_chunks,
+        default_dp_type="zero2", pipeline_type="pipedream_flush",
+        fine_grained_mode=fine_grained, sequence_parallel=True,
+        async_grad_reduce=False, mixed_precision="bf16",
+        time_profile_mode="sequence", memory_profile_mode="sequence",
+        time_profiling_path=os.path.join(
+            FIXTURES, "computation_profiling_bf16_llama2-7b_all.json"),
+        memory_profiling_path=os.path.join(
+            FIXTURES, "memory_profiling_bf16_llama2-7b_all.json"),
+        allreduce_bandwidth_config_path=os.path.join(
+            FIXTURES, "allreduce_bandwidth_1nodes_8gpus_per_node.json"),
+        p2p_bandwidth_config_path=os.path.join(
+            FIXTURES, "p2p_bandwidth_1nodes_8gpus_per_node.json"),
+        overlap_coe_path=os.path.join(FIXTURES, "overlap_coefficient.json"),
+        sp_time_path=os.path.join(
+            FIXTURES, "sp_time_1nodes_8gpus_per_node.json"),
+        output_config_path=str(tmp_path),
+    )
+    eng = SearchEngine(args)
+    eng.set_model_info(
+        [{"hidden_size": 4096, "seq_len": 8192, "layer_num": 28}],
+        "llama2-7b")
+    eng.initialize()
+    return eng
+
+
+def _simple_strings(cfg):
+    """Render the plan the way the reference golden test does
+    (to_simple_string: pp-tpsp[*]-dp[f][-c])."""
+    layers, _, _ = config2strategy(cfg, world_size=8)
+    out = []
+    for s in layers:
+        txt = f"{s.pp_deg}-"
+        txt += f"{s.tp_size}*-" if s.tp_size != 1 else f"{s.tp_size}-"
+        txt += f"{s.dp_size}f" if s.dp_type == DPType.ZERO3 else f"{s.dp_size}"
+        if s.checkpoint:
+            txt += "-c"
+        if s.sp:
+            txt += "-sp"
+        out.append(txt)
+    return out
+
+
+def test_fine_grained_golden(tmp_path):
+    eng = _make_engine(tmp_path, settle_chunks=32, fine_grained=1)
+    throughput = eng.optimize()
+    assert abs(throughput - GOLDEN_FINE) < 1e-6, throughput
+
+    files = glob.glob(os.path.join(str(tmp_path), "*.json"))
+    assert len(files) == 1
+    assert os.path.basename(files[0]).startswith("galvatron_config_")
+    cfg = json.load(open(files[0]))
+    for key in ["pp_deg", "tp_sizes_enc", "tp_consecutive_flags",
+                "dp_types_enc", "use_sp", "checkpoint", "global_bsz",
+                "chunks", "pp_division", "pipeline_type", "default_dp_type",
+                "vtp", "vsp"]:
+        assert key in cfg, key
+    assert cfg["pp_deg"] == 1
+    assert cfg["global_bsz"] == 64
+    assert cfg["chunks"] == 32
+    assert cfg["pp_division"] == "28"
+    assert cfg["pipeline_type"] == "pipedream_flush"
+    assert cfg["default_dp_type"] == "zero2"
+    assert cfg["vtp"] == 8
+    assert cfg["vsp"] == 0
+    assert cfg["embed_sdp"] == 0
+
+    got = _simple_strings(cfg)
+    expect = (["1-4*-2f-c"] * 14) + (["1-4*-2f"] * 12) + (["1-4*-2"] * 2)
+    assert got == expect, got
+
+
+def test_coarse_golden(tmp_path):
+    eng = _make_engine(tmp_path, settle_chunks=8, fine_grained=0)
+    throughput = eng.optimize()
+    assert abs(throughput - GOLDEN_COARSE) < 1e-6, throughput
+
+    files = glob.glob(os.path.join(str(tmp_path), "*.json"))
+    assert len(files) == 1
+    cfg = json.load(open(files[0]))
+    assert cfg["pp_deg"] == 1
+    assert cfg["chunks"] == 8
+    assert cfg["vtp"] == 1
+    assert cfg["vsp"] == 0
+    assert cfg["embed_sdp"] == 1
+    got = _simple_strings(cfg)
+    assert got == ["1-1-8f-c"] * 28, got
+
+
+def test_numpy_fallback_matches_cpp(tmp_path):
+    """The pure-python DP must agree with the C++ core exactly."""
+    eng = _make_engine(tmp_path, settle_chunks=32, fine_grained=1)
+    eng.args.use_cpp_core = False
+    throughput = eng.optimize()
+    assert abs(throughput - GOLDEN_FINE) < 1e-6, throughput

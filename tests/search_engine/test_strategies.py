@@ -1,0 +1,63 @@
+"""Strategy enumeration/filtering units (reference test_generate_strategies)."""
+
+import pytest
+
+from hetu_galvatron_tpu.core.search_engine.strategies import (
+    SearchSpaceLimits,
+    SearchStrategy,
+    enumerate_strategies,
+    pp_division_even,
+)
+from hetu_galvatron_tpu.utils.strategy import DPType
+
+pytestmark = pytest.mark.search_engine
+
+
+def test_enumeration_power_of_two_and_world():
+    layer, vocab = enumerate_strategies(8, 28, SearchSpaceLimits())
+    assert layer and vocab
+    for s in layer:
+        assert s.world == 8
+        assert s.cp == 1  # disabled by default
+        assert not (s.tp > 1 and s.sp > 1)
+        if s.dp == 1:
+            assert s.dp_type == DPType.DDP
+    # sorted + deduped
+    keys = [s.sort_key() for s in layer]
+    assert keys == sorted(keys)
+    assert len(keys) == len(set(keys))
+    # vocab variants have no checkpoint dim
+    assert all(not v.checkpoint for v in vocab)
+
+
+def test_default_dp_type_changes_candidates():
+    layer_ddp, _ = enumerate_strategies(8, 28, SearchSpaceLimits(), "ddp")
+    layer_z2, _ = enumerate_strategies(8, 28, SearchSpaceLimits(), "zero2")
+    assert any(s.dp_type == DPType.DDP and s.dp > 1 for s in layer_ddp)
+    assert not any(s.dp_type == DPType.DDP and s.dp > 1 for s in layer_z2)
+    assert any(s.dp_type == DPType.ZERO2 for s in layer_z2)
+
+
+def test_filters():
+    lim = SearchSpaceLimits(disable_pp=1, disable_ckpt=1, disable_fsdp=1)
+    layer, _ = enumerate_strategies(8, 28, lim)
+    assert all(s.pp == 1 and not s.checkpoint and s.dp_type != DPType.ZERO3
+               for s in layer)
+
+
+def test_simple_string():
+    s = SearchStrategy(pp=1, tp=4, dp=2, dp_type=DPType.ZERO3, checkpoint=True)
+    assert s.simple_string() == "1-4*-2f-c"
+    u = SearchStrategy(pp=2, sp=4, dp=1)
+    assert u.simple_string() == "2-4*-1-sp"
+
+
+def test_pp_division_even():
+    assert pp_division_even([28], 4) == [7, 7, 7, 7]
+    assert pp_division_even([30], 4) == [7, 7, 7, 9]
+
+
+def test_to_runtime_roundtrip():
+    s = SearchStrategy(pp=2, sp=4, dp=1, checkpoint=True)
+    r = s.to_runtime()
+    assert r.tp_size == 4 and r.sp and r.pp_deg == 2 and r.checkpoint
