@@ -1,0 +1,36 @@
+"""Strategy-search launcher: ``python -m hetu_galvatron_tpu.cli.search_dist
+<config.yaml> [key=value ...]`` (reference models/gpt/search_dist.py:11-33)."""
+
+from __future__ import annotations
+
+import sys
+
+
+def main(argv=None) -> int:
+    from hetu_galvatron_tpu.core.arguments import args_from_cli
+    from hetu_galvatron_tpu.core.search_engine.engine import SearchEngine
+    from hetu_galvatron_tpu.utils.hf_config_adapter import (
+        model_layer_configs,
+        model_name,
+        resolve_model_config,
+    )
+
+    args = args_from_cli(argv if argv is not None else sys.argv[1:],
+                         mode="search")
+    args = resolve_model_config(args)
+    engine = SearchEngine(
+        args.search,
+        mixed_precision=args.search.mixed_precision,
+        default_dp_type=args.search.default_dp_type,
+        pipeline_type=args.search.pipeline_type,
+    )
+    engine.set_model_info(model_layer_configs(args.model),
+                          model_name(args.model))
+    engine.initialize()
+    throughput = engine.optimize()
+    print(f"search done: max throughput {throughput} samples/s")
+    return 0 if throughput > 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -108,7 +108,7 @@ class ParallelArgs(BaseModel):
     # precision
     mixed_precision: Literal["fp32", "bf16", "fp16"] = "bf16"
     # world
-    num_devices: int = 1  # chips in the mesh (driver/test override)
+    num_devices: int = 0  # 0 => use every visible chip
     dp_axis_on_dcn: bool = True  # outermost dp/pp on DCN for multi-host pods
 
     @model_validator(mode="after")

@@ -1,0 +1,11 @@
+from hetu_galvatron_tpu.core.profiler.hardware_profiler import (  # noqa: F401
+    HardwareProfiler,
+)
+from hetu_galvatron_tpu.core.profiler.model_profiler import (  # noqa: F401
+    ModelProfiler,
+)
+from hetu_galvatron_tpu.core.profiler.runtime_profiler import (  # noqa: F401
+    RuntimeProfiler,
+    compiled_memory_mb,
+    device_memory_mb,
+)

@@ -179,7 +179,8 @@ class SearchEngine:
             sequence_parallel=self.args.sequence_parallel,
             pipeline_type=self.pipeline_type,
             forward_computation_time=mp.time_profiled_list[i],
-            other_time_profiled=mp.other_time_profiled_list[0],
+            other_time_profiled=mp.other_time_profiled_list[
+                min(i, len(mp.other_time_profiled_list) - 1)],
             tp_activation_per_bsz_dict=mp.act_sizes[i],
             other_memory_pp_off=mp.other_memory_pp_off,
             other_memory_pp_on=mp.other_memory_pp_on,

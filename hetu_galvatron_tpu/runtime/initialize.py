@@ -1,0 +1,128 @@
+"""Framework initialization & global run state.
+
+Capability parity with the reference init layer (runtime/initialize.py:114-246
+``initialize_galvatron`` / ``validate_args`` and runtime/parallel_state.py
+globals): argument validation, seeding, device/mesh discovery, and the run's
+observability writers.
+
+TPU-native: there is no process-group bootstrap — the single-controller JAX
+runtime already sees every chip (`jax.devices()`); "initialization" is
+validating the plan against the visible world, seeding, and wiring loggers.
+The reference's env-based RANK/WORLD_SIZE handshake and NCCL init
+(initialize.py:114-160) have no equivalent because XLA owns the transport.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from hetu_galvatron_tpu.core.args_schema import CoreArgs
+
+
+@dataclass
+class RunState:
+    """Global run context (the reference's parallel_state globals:
+    args/tokenizer/writers/memory buffer, parallel_state.py:135-305)."""
+
+    args: CoreArgs
+    devices: List[Any] = field(default_factory=list)
+    world_size: int = 1
+    logger: Optional[logging.Logger] = None
+    tensorboard: Any = None
+    wandb: Any = None
+
+    def log(self, msg: str) -> None:
+        (self.logger.info if self.logger else print)(msg)
+
+
+_STATE: Optional[RunState] = None
+
+
+def get_run_state() -> RunState:
+    if _STATE is None:
+        raise RuntimeError("initialize() has not been called")
+    return _STATE
+
+
+def validate_args(args: CoreArgs, world_size: int) -> None:
+    """Cross-field checks (reference validate_args, initialize.py:190)."""
+    m, p = args.model, args.parallel
+    if m.hidden_size % m.num_attention_heads:
+        raise ValueError("hidden_size must divide by num_attention_heads")
+    if m.num_key_value_heads and m.num_attention_heads % m.num_key_value_heads:
+        raise ValueError("heads must divide by kv heads")
+    if p.config_mode == "global":
+        need = p.pp_deg * max(p.global_tp_deg, 1) * max(p.global_cp_deg, 1)
+        if world_size % max(need, 1):
+            raise ValueError(
+                f"world {world_size} not divisible by pp*tp*cp = {need}")
+    if m.seq_length > m.max_position_embeddings:
+        raise ValueError("seq_length exceeds max_position_embeddings")
+
+
+def set_seed(seed: int) -> None:
+    random.seed(seed)
+    np.random.seed(seed)
+
+
+def _make_logger(args: CoreArgs) -> logging.Logger:
+    logger = logging.getLogger("hetu_galvatron_tpu")
+    if not logger.handlers:
+        h = logging.StreamHandler()
+        h.setFormatter(logging.Formatter("[%(levelname)s] %(message)s"))
+        logger.addHandler(h)
+    logger.setLevel(getattr(logging, args.logging.log_level.upper(),
+                            logging.INFO))
+    return logger
+
+
+def _make_writers(args: CoreArgs):
+    """TensorBoard / wandb writers when configured and importable
+    (reference parallel_state.py:85-131; both are optional deps)."""
+    tb = wb = None
+    if args.logging.tensorboard_dir:
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+
+            tb = SummaryWriter(args.logging.tensorboard_dir)
+        except ImportError:
+            pass
+    if args.logging.wandb_project:
+        try:
+            import wandb
+
+            wb = wandb.init(project=args.logging.wandb_project,
+                            config=args.model_dump())
+        except ImportError:
+            pass
+    return tb, wb
+
+
+def initialize(args: CoreArgs, devices: Optional[List[Any]] = None
+               ) -> RunState:
+    """Validate + seed + discover devices; returns (and stores) the run
+    state (reference initialize_galvatron, initialize.py:142-187 minus the
+    process-group/NCCL legs)."""
+    global _STATE
+    import jax
+
+    devices = list(devices if devices is not None else jax.devices())
+    world = (args.parallel.num_devices if args.parallel.num_devices > 0
+             else len(devices))
+    world = min(world, len(devices))
+    validate_args(args, world)
+    set_seed(args.train.seed)
+    logger = _make_logger(args)
+    tb, wb = _make_writers(args)
+    state = RunState(args=args, devices=devices[:world], world_size=world,
+                     logger=logger)
+    state.tensorboard, state.wandb = tb, wb
+    logger.info("initialized: %d device(s), platform %s, model %s",
+                world, devices[0].platform, args.model.model_name)
+    _STATE = state
+    return state

@@ -1,0 +1,96 @@
+"""CLI launcher smoke tests (reference L7): train_dist / search_dist /
+profiler run end-to-end from YAML configs on the virtual CPU mesh."""
+
+import json
+import os
+
+import pytest
+
+pytestmark = [pytest.mark.distributed]
+
+ZOO = os.path.join(os.path.dirname(__file__), "..", "..",
+                   "hetu_galvatron_tpu", "models", "configs")
+FIXTURES = os.path.join(os.path.dirname(__file__), "..", "fixtures")
+
+TINY_OVERRIDES = [
+    "model.hidden_size=32", "model.num_hidden_layers=2",
+    "model.num_attention_heads=2", "model.vocab_size=64",
+    "model.seq_length=8", "model.max_position_embeddings=16",
+    "model.make_vocab_size_divisible_by=1",
+    "train.train_iters=2", "parallel.mixed_precision=fp32",
+    "parallel.global_train_batch_size=8",
+]
+
+
+def test_model_zoo_yaml_all_load():
+    from hetu_galvatron_tpu.core.arguments import load_config
+
+    for name in os.listdir(ZOO):
+        args = load_config(os.path.join(ZOO, name))
+        assert args.model.hidden_size > 0
+        assert args.model.hidden_size % args.model.num_attention_heads == 0
+
+
+def test_train_dist_cli(capsys):
+    from hetu_galvatron_tpu.cli.train_dist import main
+
+    rc = main([os.path.join(ZOO, "gpt2-small.yaml")] + TINY_OVERRIDES)
+    assert rc == 0
+    assert "training done" in capsys.readouterr().out
+
+
+def test_train_dist_cli_pipeline(capsys):
+    from hetu_galvatron_tpu.cli.train_dist import main
+
+    rc = main([os.path.join(ZOO, "llama2-7b.yaml")] + TINY_OVERRIDES +
+              ["parallel.pp_deg=2", "parallel.chunks=2",
+               "parallel.global_tp_deg=2", "model.num_key_value_heads=2",
+               "model.ffn_hidden_size=64"])
+    assert rc == 0
+    assert "training done" in capsys.readouterr().out
+
+
+def test_search_dist_cli(tmp_path, capsys):
+    from hetu_galvatron_tpu.cli.search_dist import main
+
+    rc = main([
+        os.path.join(ZOO, "llama2-7b.yaml"),
+        "model.num_hidden_layers=28", "model.seq_length=8192",
+        "model.max_position_embeddings=8192",
+        "search.settle_bsz=64", "search.settle_chunks=32",
+        "search.memory_constraint=36", "search.default_dp_type=zero2",
+        "search.pipeline_type=pipedream_flush",
+        "search.async_grad_reduce=false",
+        "search.time_profile_mode=sequence",
+        "search.memory_profile_mode=sequence",
+        f"search.time_profiling_path={FIXTURES}/computation_profiling_bf16_llama2-7b_all.json",
+        f"search.memory_profiling_path={FIXTURES}/memory_profiling_bf16_llama2-7b_all.json",
+        f"search.allreduce_bandwidth_config_path={FIXTURES}/allreduce_bandwidth_1nodes_8gpus_per_node.json",
+        f"search.p2p_bandwidth_config_path={FIXTURES}/p2p_bandwidth_1nodes_8gpus_per_node.json",
+        f"search.overlap_coe_path={FIXTURES}/overlap_coefficient.json",
+        f"search.sp_time_path={FIXTURES}/sp_time_1nodes_8gpus_per_node.json",
+        f"search.output_config_path={tmp_path}",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "max throughput 2.64850914" in out
+    files = os.listdir(tmp_path)
+    assert len(files) == 1 and files[0].startswith("galvatron_config_")
+
+
+def test_profiler_cli_computation(tmp_path, capsys):
+    from hetu_galvatron_tpu.cli.profiler import main
+
+    rc = main([os.path.join(ZOO, "gpt2-small.yaml"),
+               "mode=model_profiler"] + TINY_OVERRIDES + [
+              "model_profiler.profile_type=computation",
+              "model_profiler.layernum_min=1",
+              "model_profiler.layernum_max=2",
+              "model_profiler.profile_batch_size=2",
+              "model_profiler.profile_seq_length_list=[8]",
+              f"model_profiler.output_dir={tmp_path}"])
+    assert rc == 0
+    files = os.listdir(tmp_path)
+    assert any(f.startswith("computation_profiling") for f in files)
+    cfg = json.load(open(os.path.join(tmp_path, files[0])))
+    assert any(k.startswith("layertype_0_") for k in cfg)

@@ -1,0 +1,120 @@
+"""Profiler tests on the virtual CPU mesh: schema compatibility with the
+search engine is the contract (reference tests/profiler/*)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from hetu_galvatron_tpu.core.args_schema import CoreArgs, HardwareProfileArgs
+from hetu_galvatron_tpu.core.profiler.hardware_profiler import HardwareProfiler
+from hetu_galvatron_tpu.core.profiler.model_profiler import ModelProfiler
+from hetu_galvatron_tpu.core.profiler.runtime_profiler import RuntimeProfiler
+from hetu_galvatron_tpu.core.search_engine.profiles import (
+    parse_memory_config,
+    parse_time_config,
+    read_allreduce_bandwidth,
+    read_p2p_bandwidth,
+    remap_collective_latency,
+)
+
+pytestmark = [pytest.mark.profiler, pytest.mark.distributed]
+
+TINY = dict(hidden_size=32, num_hidden_layers=2, num_attention_heads=2,
+            vocab_size=64, max_position_embeddings=64, seq_length=16,
+            make_vocab_size_divisible_by=1)
+
+
+@pytest.fixture(scope="module")
+def hw_args():
+    return HardwareProfileArgs(num_nodes=1, num_devices_per_node=8,
+                               start_mb=1, end_mb=8, scale=2,
+                               warmup_iters=1, profile_iters=2)
+
+
+def test_hardware_profiler_schemas(hw_args, cpu_devices, tmp_path):
+    prof = HardwareProfiler(hw_args, devices=cpu_devices)
+    ar = prof.profile_allreduce_bandwidth(message_mb=1)
+    assert "allreduce_size_8_consec_1" in ar
+    assert "allreduce_size_4_consec_0" in ar
+    assert all(v > 0 for v in ar.values())
+    # consumable by the search-engine reader
+    bw, coe = read_allreduce_bandwidth(ar, 8)
+    assert coe["8"] > 0 and coe["1"] == 0
+
+    p2p = prof.profile_p2p_bandwidth(message_mb=1)
+    assert set(p2p) == {"pp_size_2", "pp_size_4", "pp_size_8"}
+    _, p2p_coe = read_p2p_bandwidth(p2p)
+    assert p2p_coe[2] > 0
+
+    ov = prof.profile_overlap_coefficient(message_mb=1)
+    assert ov["overlap_coe"] >= 1.0
+
+
+def test_sp_time_profile_feeds_latency_tables(hw_args, cpu_devices):
+    args = HardwareProfileArgs(num_nodes=1, num_devices_per_node=4,
+                               start_mb=1, end_mb=128, scale=2,
+                               warmup_iters=1, profile_iters=1)
+    prof = HardwareProfiler(args, devices=cpu_devices[:4])
+    sp = prof.profile_sp_time()
+    # 8 sizes per group per op -> latency remap fits a line
+    tables = remap_collective_latency(sp, "allgather")
+    assert 4 in tables and "popt" in tables[4]
+    a2a = remap_collective_latency(sp, "all2all")
+    assert 2 in a2a
+
+
+def test_runtime_profiler_timing_and_log():
+    args = CoreArgs.model_validate({"profile": {"profile": 1,
+                                                "profile_warmup": 0}})
+    prof = RuntimeProfiler(args)
+    for it in range(4):
+        prof.time_start(it)
+        x = jnp.ones((64, 64)) @ jnp.ones((64, 64))
+        prof.time_end(it, sync=x)
+        line = prof.iteration_log(it, {"loss": 1.0, "grad_norm": 0.5})
+    assert prof.filtered_time_ms() > 0
+    assert "loss 1.0000" in line
+
+
+def test_model_profiler_computation_schema(tmp_path):
+    args = CoreArgs.model_validate({
+        "model": TINY,
+        "model_profiler": {"profile_type": "computation",
+                           "profile_mode": "static",
+                           "profile_batch_size": 2,
+                           "profile_seq_length_list": [16],
+                           "layernum_min": 1, "layernum_max": 2},
+    })
+    prof = ModelProfiler(args)
+    entries = prof.profile_computation()
+    assert "layertype_0_bsz2_seq16" in entries
+    assert "layertype_other_bsz2_seq16" in entries
+    times, others = parse_time_config(
+        entries, mode="static", num_layertype=1, seqlen_list=[16])
+    assert len(times) == 1 and len(others) == 1
+
+
+def test_model_profiler_memory_schema(cpu_devices):
+    args = CoreArgs.model_validate({
+        "model": TINY,
+        "model_profiler": {"profile_type": "memory",
+                           "profile_batch_size": 2,
+                           "profile_seq_length_list": [16],
+                           "layernum_min": 1, "layernum_max": 2,
+                           "max_tp_deg": 2},
+    })
+    prof = ModelProfiler(args, devices=cpu_devices)
+    mem = prof.profile_memory()
+    assert "layertype_0_sp" in mem
+    layer = mem["layertype_0_sp"]["16"]
+    assert layer["parameter_size"] > 0
+    assert 1 in layer["tp_activation_per_bsz_dict"]
+    assert "checkpoint" in layer["tp_activation_per_bsz_dict"]
+    # consumable by the search-engine reader
+    params, acts, off, on = parse_memory_config(
+        mem, mode="static", num_layertype=1, seqlen_list=[16],
+        sequence_parallel=True)
+    assert params[0] > 0 and 1 in acts[0]
+    assert "model_states" in off and "first_stage" in on
