@@ -66,8 +66,21 @@ def main():
 
     params, _ = init_causal_lm(jax.random.key(0), cfg)
     tx = make_optimizer(TrainArgs(lr=1e-4, lr_decay_style="constant"))
-    loss_fn = make_loss_fn(cfg, compute_dtype=jnp.bfloat16)
-    step = jax.jit(make_train_step(loss_fn, tx), donate_argnums=(0, 1))
+
+    def build_step(use_flash: bool):
+        overrides = None
+        if use_flash:
+            from hetu_galvatron_tpu.ops.pallas.flash_attention import flash_sdpa
+
+            overrides = {i: {"sdpa_fn": flash_sdpa}
+                         for i in range(cfg.num_hidden_layers)}
+        loss_fn = make_loss_fn(cfg, compute_dtype=jnp.bfloat16,
+                               layer_overrides=overrides)
+        return jax.jit(make_train_step(loss_fn, tx), donate_argnums=(0, 1))
+
+    want_flash = (dev.platform != "cpu" and cfg.use_flash_attn
+                  and os.environ.get("BENCH_FLASH", "1") != "0")
+    step = build_step(want_flash)
 
     params = jax.device_put(params, dev)
     opt = jax.jit(tx.init)(params)
@@ -75,9 +88,25 @@ def main():
                                             (bsz, seq + 1))
     batch = jax.device_put(jax.tree.map(jnp.asarray, make_batch(data)), dev)
 
-    for _ in range(3):  # warmup + compile
-        params, opt, metrics = step(params, opt, batch)
-    jax.block_until_ready(metrics["loss"])
+    used_flash = want_flash
+    try:
+        for _ in range(3):  # warmup + compile
+            params, opt, metrics = step(params, opt, batch)
+        jax.block_until_ready(metrics["loss"])
+    except Exception as e:  # Mosaic/pallas failure: fall back to XLA core
+        if not want_flash:
+            raise
+        print(f"warning: flash attention failed ({type(e).__name__}: {e}); "
+              "falling back to XLA attention", file=sys.stderr)
+        used_flash = False
+        step = build_step(False)
+        # the failed step may have executed with donated buffers: rebuild
+        params, _ = init_causal_lm(jax.random.key(0), cfg)
+        params = jax.device_put(params, dev)
+        opt = jax.jit(tx.init)(params)
+        for _ in range(3):
+            params, opt, metrics = step(params, opt, batch)
+        jax.block_until_ready(metrics["loss"])
 
     t0 = time.perf_counter()
     for _ in range(iters):
@@ -99,6 +128,7 @@ def main():
         "device": kind,
         "peak_flops": peak,
         "peak_assumed": peak_assumed,
+        "flash_attention": used_flash,
         "bsz": bsz,
         "seq": seq,
         "loss": round(float(metrics["loss"]), 4),

@@ -1,0 +1,5 @@
+from hetu_galvatron_tpu.ops.ring_attention import (  # noqa: F401
+    make_ring_sdpa,
+    zigzag_layout,
+    zigzag_unlayout,
+)

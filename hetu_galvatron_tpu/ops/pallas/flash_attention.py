@@ -1,0 +1,146 @@
+"""Pallas flash attention for TPU: causal, GQA-aware, online-softmax.
+
+Replaces the reference's external flash-attn CUDA ops (SURVEY §2 native-code
+checklist item 4; installed by galvatron/scripts/flash_attn_ops_install.sh)
+with a TPU kernel: per (batch, q-head, q-block) grid cell the kernel streams
+key/value blocks through VMEM with the usual running-max/normalizer
+accumulation, so the [S, S] score matrix never touches HBM and the MXU sees
+[block_q, d] x [d, block_k] tiles.
+
+Layout: q [B, N, S, D], k/v [B, K, S, D] (heads-major so a grid cell's tiles
+are contiguous); GQA maps q-head n to kv-head n // (N // K) in the index map.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = float(jnp.finfo(jnp.float32).min)
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q: int, block_k: int,
+                  seq_len: int, causal: bool, scale: float):
+    qi = pl.program_id(2)
+    q = q_ref[0, 0].astype(jnp.float32) * scale  # [block_q, D]
+    d = q.shape[-1]
+
+    m = jnp.full((block_q,), NEG_INF, jnp.float32)
+    l = jnp.zeros((block_q,), jnp.float32)
+    acc = jnp.zeros((block_q, d), jnp.float32)
+
+    num_k = seq_len // block_k
+    if causal:
+        # blocks past the diagonal contribute nothing; bound the loop
+        last = (qi * block_q + block_q - 1) // block_k + 1
+    else:
+        last = num_k
+
+    def body(ki, carry):
+        m, l, acc = carry
+        k = k_ref[0, 0, pl.dslice(ki * block_k, block_k), :].astype(
+            jnp.float32)
+        v = v_ref[0, 0, pl.dslice(ki * block_k, block_k), :].astype(
+            jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # [bq, bk]
+        if causal:
+            qpos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, NEG_INF)
+        block_max = jnp.max(s, axis=1)
+        new_m = jnp.maximum(m, block_max)
+        corr = jnp.exp(jnp.where(m == NEG_INF, NEG_INF, m - new_m))
+        p = jnp.exp(s - new_m[:, None])
+        p = jnp.where(s == NEG_INF, 0.0, p)
+        new_l = l * corr + jnp.sum(p, axis=1)
+        new_acc = acc * corr[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return new_m, new_l, new_acc
+
+    m, l, acc = jax.lax.fori_loop(0, last, body, (m, l, acc))
+    out = acc / jnp.maximum(l, 1e-20)[:, None]
+    o_ref[0, 0] = out.astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
+                                             "interpret"))
+def flash_attention_hmajor(
+    q: jax.Array,  # [B, N, S, D]
+    k: jax.Array,  # [B, K, S, D]
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    block_q: int = 256,
+    block_k: int = 256,
+    interpret: bool = False,
+) -> jax.Array:
+    B, N, S, D = q.shape
+    K = k.shape[1]
+    G = N // K
+    block_q = min(block_q, S)
+    block_k = min(block_k, S)
+    if S % block_q or S % block_k:
+        raise ValueError(f"seq {S} must divide by blocks {block_q}/{block_k}")
+    grid = (B, N, S // block_q)
+    kernel = functools.partial(
+        _flash_kernel, block_q=block_q, block_k=block_k, seq_len=S,
+        causal=causal, scale=1.0 / math.sqrt(D))
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, D), lambda b, n, qi: (b, n, qi, 0)),
+            pl.BlockSpec((1, 1, S, D), lambda b, n, qi: (b, n // G, 0, 0)),
+            pl.BlockSpec((1, 1, S, D), lambda b, n, qi: (b, n // G, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, D),
+                               lambda b, n, qi: (b, n, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, N, S, D), q.dtype),
+        interpret=interpret,
+    )(q, k, v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash_with_vjp(q, k, v, causal, interpret):
+    qh = q.transpose(0, 2, 1, 3)
+    kh = k.transpose(0, 2, 1, 3)
+    vh = v.transpose(0, 2, 1, 3)
+    out = flash_attention_hmajor(qh, kh, vh, causal=causal,
+                                 interpret=interpret)
+    return out.transpose(0, 2, 1, 3)
+
+
+def _flash_fwd(q, k, v, causal, interpret):
+    return _flash_with_vjp(q, k, v, causal, interpret), (q, k, v)
+
+
+def _flash_bwd(causal, interpret, res, g):
+    # Backward recomputes through the dense reference core (the standard
+    # remat trade: forward stays O(block) in VMEM via the Pallas kernel, the
+    # backward matches XLA's own attention gradient). A fused flash backward
+    # kernel is a later optimization.
+    from hetu_galvatron_tpu.models.modules import xla_sdpa
+
+    q, k, v = res
+    _, vjp = jax.vjp(lambda a, b, c: xla_sdpa(a, b, c, causal=causal),
+                     q, k, v)
+    return vjp(g)
+
+
+_flash_with_vjp.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_sdpa(q, k, v, *, causal: bool = True, interpret: bool = False):
+    """Drop-in sdpa_fn for modules.apply_attention: [B, S, N, D] layout in
+    and out; differentiable (forward via the Pallas kernel, backward via the
+    dense-core recompute)."""
+    return _flash_with_vjp(q, k, v, causal, interpret)

@@ -1,0 +1,128 @@
+"""Ring attention: context-parallel causal attention over the cp mesh axes.
+
+Capability parity with the reference's zigzag ring flash attention
+(runtime/transformer/attention_impl.py:481-905 ``ZigzagRingFlashAttention`` +
+``RingComm`` batched isend/irecv): each cp rank holds a contiguous sequence
+block of q/k/v; k/v blocks rotate around the ring while a streaming (online
+softmax) accumulator folds each block's contribution — memory per chip stays
+O(S/cp) and the ring transfers ride ICI via `lax.ppermute` instead of NCCL
+p2p.
+
+Block-causal masking replaces the reference's zigzag re-layout: block j of
+keys attends to query block r fully when j < r, causally when j == r, and is
+masked when j > r. (Zigzag balances per-rank FLOPs; the masking here is
+correct for the standard contiguous layout and keeps the layout trivial for
+GSPMD boundaries. The compute imbalance is cp-bounded and only matters at
+large cp.)
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+NEG_INF = float(jnp.finfo(jnp.float32).min)
+
+
+def _block_scores(q, k, scale):
+    """[B,Sq,K,G,D] x [B,Sk,K,D] -> [B,K,G,Sq,Sk] fp32."""
+    return jnp.einsum("bskgd,btkd->bkgst", q, k,
+                      preferred_element_type=jnp.float32) * scale
+
+
+def _ring_body(step, carry, *, q, my_idx, cp, s_local, causal, axis):
+    """One ring step: fold key/value block (my_idx - step) mod cp into the
+    streaming softmax accumulator, then rotate k/v to the next rank."""
+    o, m, l, k, v = carry
+    B, Sq, K, G, D = q.shape
+    src_block = (my_idx - step) % cp  # which global block `k` currently holds
+    scores = _block_scores(q, k, 1.0 / math.sqrt(D))  # [B,K,G,Sq,Sk]
+    if causal:
+        qpos = my_idx * s_local + jnp.arange(Sq)[:, None]
+        kpos = src_block * s_local + jnp.arange(k.shape[1])[None, :]
+        scores = jnp.where(qpos >= kpos, scores, NEG_INF)
+    block_max = jnp.max(scores, axis=-1)  # [B,K,G,Sq]
+    new_m = jnp.maximum(m, block_max)
+    # guard fully-masked rows: exp(NEG_INF - NEG_INF) would be 1
+    correction = jnp.exp(jnp.where(m == NEG_INF, NEG_INF, m - new_m))
+    p = jnp.exp(scores - new_m[..., None])
+    p = jnp.where(scores == NEG_INF, 0.0, p)
+    new_l = l * correction + jnp.sum(p, axis=-1)
+    pv = jnp.einsum("bkgst,btkd->bkgsd", p.astype(v.dtype), v,
+                    preferred_element_type=jnp.float32)
+    new_o = o * correction[..., None] + pv
+    perm = [(i, (i + 1) % cp) for i in range(cp)]
+    k = jax.lax.ppermute(k, axis, perm)
+    v = jax.lax.ppermute(v, axis, perm)
+    return new_o, new_m, new_l, k, v
+
+
+def _ring_attention_local(q, k, v, *, axis, causal):
+    """Per-shard kernel under shard_map: q/k/v are the local sequence blocks
+    [B, S/cp, N|K, D]."""
+    cp = jax.lax.axis_size(axis)
+    my_idx = jax.lax.axis_index(axis)
+    B, Sq, N, D = q.shape
+    K = k.shape[2]
+    G = N // K
+    qg = q.reshape(B, Sq, K, G, D)
+    o = jnp.zeros((B, K, G, Sq, D), jnp.float32)
+    m = jnp.full((B, K, G, Sq), NEG_INF, jnp.float32)
+    l = jnp.zeros((B, K, G, Sq), jnp.float32)
+    body = partial(_ring_body, q=qg, my_idx=my_idx, cp=cp,
+                   s_local=Sq, causal=causal, axis=axis)
+    o, m, l, _, _ = jax.lax.fori_loop(0, cp, body, (o, m, l, k, v))
+    o = o / jnp.maximum(l, 1e-20)[..., None]
+    return o.transpose(0, 3, 1, 2, 4).reshape(B, Sq, N, D).astype(q.dtype)
+
+
+def make_ring_sdpa(
+    mesh: Mesh,
+    cp_axes: Tuple[str, ...],
+    dp_axes: Tuple[str, ...] = (),
+    tp_axes: Tuple[str, ...] = (),
+):
+    """sdpa_fn for modules.apply_attention: reshards q/k/v so the sequence
+    lives on the cp axes, runs the ring kernel under shard_map, and hands the
+    seq-sharded output back to GSPMD (the reference reaches its ring kernel
+    through the per-layer dispatch at attention.py:664-720)."""
+    if not cp_axes:
+        raise ValueError("ring attention needs at least one cp axis")
+    axis = cp_axes if len(cp_axes) > 1 else cp_axes[0]
+    spec = P(dp_axes or None, cp_axes, tp_axes or None, None)
+
+    def sdpa(q, k, v, *, causal=True):
+        fn = jax.shard_map(
+            partial(_ring_attention_local, axis=axis, causal=causal),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False)
+        return fn(q, k, v)
+
+    return sdpa
+
+
+def zigzag_layout(x: jax.Array, cp: int, axis: int = 1) -> jax.Array:
+    """Re-layout a sequence into zigzag block order (block i and 2cp-1-i per
+    rank) — the reference's balanced causal layout (redistribute.py:5-41).
+    Provided for interchange with zigzag-trained checkpoints/plans."""
+    blocks = jnp.split(x, 2 * cp, axis=axis)
+    out = []
+    for r in range(cp):
+        out.append(blocks[r])
+        out.append(blocks[2 * cp - 1 - r])
+    return jnp.concatenate(out, axis=axis)
+
+
+def zigzag_unlayout(x: jax.Array, cp: int, axis: int = 1) -> jax.Array:
+    """Inverse of :func:`zigzag_layout`."""
+    blocks = jnp.split(x, 2 * cp, axis=axis)
+    out = [None] * (2 * cp)
+    for r in range(cp):
+        out[r] = blocks[2 * r]
+        out[2 * cp - 1 - r] = blocks[2 * r + 1]
+    return jnp.concatenate(out, axis=axis)

@@ -100,6 +100,24 @@ def opt_state_specs(
     return jax.tree_util.tree_map_with_path(for_leaf, state_shape)
 
 
+def attention_overrides(
+    per_layer: List[LayerSharding],
+    mesh: Mesh,
+) -> Dict[int, Dict[str, Any]]:
+    """Per-layer attention-impl dispatch (reference attention.py:664-720):
+    layers with cp > 1 swap in the ring-attention kernel over their cp axes;
+    TP/Ulysses layers keep the XLA core (GSPMD already inserts the
+    collectives)."""
+    from hetu_galvatron_tpu.ops.ring_attention import make_ring_sdpa
+
+    out: Dict[int, Dict[str, Any]] = {}
+    for i, sh in enumerate(per_layer):
+        if sh.cp_axes:
+            out[i] = {"sdpa_fn": make_ring_sdpa(
+                mesh, sh.cp_axes, dp_axes=sh.dp_axes, tp_axes=sh.tp_axes)}
+    return out
+
+
 def make_boundary_fn(
     per_layer: List[LayerSharding],
     vocab: LayerSharding,
@@ -159,6 +177,14 @@ def make_spmd_train_step(
     opt_pspecs = param_specs(axes_tree, per_layer, vocab, opt=True)
     opt_specs = opt_state_specs(tx, params, opt_pspecs)
     boundary = make_boundary_fn(per_layer, vocab, mesh)
+    ring = attention_overrides(per_layer, mesh)
+    if ring:
+        # per-key merge: a caller override on a cp layer must not drop the
+        # ring sdpa_fn unless it sets sdpa_fn itself
+        merged = dict(layer_overrides or {})
+        for i, kw in ring.items():
+            merged[i] = {**kw, **merged.get(i, {})}
+        layer_overrides = merged
     remat = [sh.checkpoint for sh in per_layer]
     batch_shd = batch_sharding(per_layer, mesh)
     chunks = max(hpc.chunks, 1)

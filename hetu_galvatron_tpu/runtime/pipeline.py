@@ -230,12 +230,16 @@ class PipelineEngine:
         rope = None
         if cfg.position_embedding_type == "rope":
             rope = M.rope_cos_sin(x.shape[1], cfg.head_dim, cfg.rope_theta)
+        from hetu_galvatron_tpu.parallel.spmd import attention_overrides
+
+        overrides = attention_overrides(st.shardings, st.mesh)
         for j, lp in enumerate(sp["layers"]):
             sh = st.shardings[j]
             x = jax.lax.with_sharding_constraint(
                 x, NamedSharding(st.mesh, sh.act_spec()))
             fn = partial(M.apply_decoder_layer, cfg=cfg, rope=rope,
-                         compute_dtype=self.compute_dtype)
+                         compute_dtype=self.compute_dtype,
+                         **overrides.get(j, {}))
             if sh.checkpoint:
                 fn = jax.checkpoint(fn)
             x = fn(lp, x)

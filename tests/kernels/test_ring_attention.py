@@ -1,0 +1,91 @@
+"""Ring-attention kernel correctness vs the dense XLA core (the reference has
+no cp>1 test — SURVEY §4 flags that gap; this closes it)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from hetu_galvatron_tpu.models.modules import xla_sdpa
+from hetu_galvatron_tpu.ops.ring_attention import (
+    make_ring_sdpa,
+    zigzag_layout,
+    zigzag_unlayout,
+)
+
+pytestmark = [pytest.mark.kernels, pytest.mark.distributed]
+
+
+def _qkv(B=2, S=32, N=4, K=4, D=8, seed=0):
+    ks = jax.random.split(jax.random.key(seed), 3)
+    q = jax.random.normal(ks[0], (B, S, N, D), jnp.float32)
+    k = jax.random.normal(ks[1], (B, S, K, D), jnp.float32)
+    v = jax.random.normal(ks[2], (B, S, K, D), jnp.float32)
+    return q, k, v
+
+
+@pytest.mark.parametrize("cp,kv_heads", [(2, 4), (4, 4), (2, 2), (8, 4)])
+def test_ring_matches_dense(cp, kv_heads, cpu_devices):
+    import math
+
+    n_axes = int(math.log2(cp))
+    mesh = Mesh(np.array(cpu_devices[:cp]).reshape((2,) * n_axes),
+                tuple(f"d{i}" for i in range(n_axes)))
+    q, k, v = _qkv(K=kv_heads)
+    ref = xla_sdpa(q, k, v, causal=True)
+    ring = make_ring_sdpa(mesh, tuple(f"d{i}" for i in range(n_axes)))
+    out = jax.jit(lambda a, b, c: ring(a, b, c, causal=True))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_noncausal(cpu_devices):
+    mesh = Mesh(np.array(cpu_devices[:2]), ("c",))
+    q, k, v = _qkv()
+    ref = xla_sdpa(q, k, v, causal=False)
+    ring = make_ring_sdpa(mesh, ("c",))
+    out = jax.jit(lambda a, b, c: ring(a, b, c, causal=False))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_with_dp_and_tp_axes(cpu_devices):
+    """cp combined with dp and tp on one mesh (batch + heads sharded too)."""
+    mesh = Mesh(np.array(cpu_devices[:8]).reshape(2, 2, 2),
+                ("dp", "cp", "tp"))
+    q, k, v = _qkv(B=2, S=16, N=4, K=4)
+    ref = xla_sdpa(q, k, v, causal=True)
+    ring = make_ring_sdpa(mesh, ("cp",), dp_axes=("dp",), tp_axes=("tp",))
+    out = jax.jit(lambda a, b, c: ring(a, b, c, causal=True))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_zigzag_layout_roundtrip():
+    x = jnp.arange(2 * 16 * 3).reshape(2, 16, 3)
+    for cp in (2, 4):
+        z = zigzag_layout(x, cp)
+        back = zigzag_unlayout(z, cp)
+        np.testing.assert_array_equal(np.asarray(back), np.asarray(x))
+        assert not np.array_equal(np.asarray(z), np.asarray(x))
+
+
+def test_ring_gradients_match(cpu_devices):
+    """d(loss)/d(q,k,v) through the ring must match the dense core."""
+    mesh = Mesh(np.array(cpu_devices[:2]), ("c",))
+    q, k, v = _qkv(S=16)
+    ring = make_ring_sdpa(mesh, ("c",))
+
+    def loss_ref(q, k, v):
+        return jnp.sum(xla_sdpa(q, k, v, causal=True) ** 2)
+
+    def loss_ring(q, k, v):
+        return jnp.sum(ring(q, k, v, causal=True) ** 2)
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    g_ring = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+    for a, b in zip(g_ref, g_ring):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=5e-5, atol=5e-5)
