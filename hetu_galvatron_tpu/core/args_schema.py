@@ -92,6 +92,8 @@ class ParallelArgs(BaseModel):
     global_tp_deg: int = 1
     global_tp_consec: int = 1
     global_cp_deg: int = 1
+    global_ep_deg: int = 1  # expert parallel (MoE), carved from dp
+    global_etp_deg: int = 1  # tp inside each expert
     sdp: int = 0  # 1 => force zero3 on all layers
     default_dp_type: Literal["ddp", "zero2", "zero3"] = "ddp"
     global_checkpoint: int = 0

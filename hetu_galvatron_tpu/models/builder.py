@@ -42,11 +42,18 @@ def build_causal_lm_arch(cfg: ModelArgs) -> List[str]:
 
 def init_causal_lm(key: jax.Array, cfg: ModelArgs) -> Tuple[Params, Params]:
     """Returns (params, logical_axes) with layers as a per-layer tuple so the
-    axes tree mirrors params exactly (required for tree-mapped shardings)."""
+    axes tree mirrors params exactly (required for tree-mapped shardings).
+    MoE models alternate dense/MoE layers per moe_layer_freq."""
+    from hetu_galvatron_tpu.models.moe import init_moe_decoder_layer, is_moe_layer
+
     n = cfg.num_hidden_layers
     keys = jax.random.split(key, n + 2)
     embed_p, embed_a = M.init_embedding(keys[0], cfg)
-    layers = [M.init_decoder_layer(keys[1 + i], cfg) for i in range(n)]
+    layers = [
+        (init_moe_decoder_layer(keys[1 + i], cfg) if is_moe_layer(cfg, i)
+         else M.init_decoder_layer(keys[1 + i], cfg))
+        for i in range(n)
+    ]
     prenorm_p, prenorm_a = M.init_norm(cfg)
     head_p, head_a = M.init_lm_head(keys[n + 1], cfg)
     params = {
@@ -74,6 +81,7 @@ def forward_causal_lm(
     layer_overrides: Optional[Dict[int, Dict[str, Any]]] = None,
     boundary_fn: Optional[Callable[[int, jax.Array], jax.Array]] = None,
     logits_fp32: bool = True,
+    with_aux: bool = False,
 ) -> jax.Array:
     """tokens [B, S] -> logits [B, S, V].
 
@@ -86,21 +94,31 @@ def forward_causal_lm(
     `with_sharding_constraint` resharding at layer boundaries, replacing the
     reference's relocation wrappers (runtime/parallel.py:272-304).
     """
+    from hetu_galvatron_tpu.models.moe import apply_moe_decoder_layer
+
     S = tokens.shape[1]
     rope = None
     if cfg.position_embedding_type == "rope":
         rope = M.rope_cos_sin(S, cfg.head_dim, cfg.rope_theta)
     x = M.apply_embedding(params["embed"], tokens, cfg, compute_dtype=compute_dtype)
+    aux_total = jnp.zeros((), jnp.float32)
     for i, lp in enumerate(params["layers"]):
         if boundary_fn is not None:
             x = boundary_fn(i, x)
         kwargs: Dict[str, Any] = dict(rope=rope, compute_dtype=compute_dtype)
         if layer_overrides and i in layer_overrides:
             kwargs.update(layer_overrides[i])
-        fn = lambda p, h, kw=kwargs: M.apply_decoder_layer(p, h, cfg, **kw)
+        if "moe" in lp:
+            fn = lambda p, h, kw=kwargs: apply_moe_decoder_layer(
+                p, h, cfg, **kw)
+        else:
+            fn = lambda p, h, kw=kwargs: (
+                M.apply_decoder_layer(p, h, cfg, **kw),
+                jnp.zeros((), jnp.float32))
         if remat_flags is not None and remat_flags[i]:
             fn = jax.checkpoint(fn)
-        x = fn(lp, x)
+        x, aux = fn(lp, x)
+        aux_total = aux_total + aux
     if boundary_fn is not None:
         x = boundary_fn(len(params["layers"]), x)
     x = M.apply_norm(params["prenorm"], x, cfg)
@@ -108,7 +126,8 @@ def forward_causal_lm(
         params["head"], x, cfg,
         wte=params["embed"]["wte"], compute_dtype=compute_dtype,
     )
-    return logits if logits_fp32 else logits.astype(compute_dtype)
+    logits = logits if logits_fp32 else logits.astype(compute_dtype)
+    return (logits, aux_total) if with_aux else logits
 
 
 def causal_lm_loss(
@@ -126,12 +145,14 @@ def causal_lm_loss(
     Equivalent role to the reference's loss closure from the dataloader
     (dataloader.py:558 _loss_func + train_dist.py forward_backward wiring).
     """
-    logits = forward_causal_lm(
+    logits, aux = forward_causal_lm(
         params, batch["tokens"], cfg,
         compute_dtype=compute_dtype, remat_flags=remat_flags,
         layer_overrides=layer_overrides, boundary_fn=boundary_fn,
+        with_aux=True,
     )
-    return M.cross_entropy_loss(logits, batch["labels"], batch.get("loss_mask"))
+    ce = M.cross_entropy_loss(logits, batch["labels"], batch.get("loss_mask"))
+    return ce + aux
 
 
 def param_count(params: Params) -> int:

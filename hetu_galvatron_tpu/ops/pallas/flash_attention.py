@@ -2,13 +2,16 @@
 
 Replaces the reference's external flash-attn CUDA ops (SURVEY §2 native-code
 checklist item 4; installed by galvatron/scripts/flash_attn_ops_install.sh)
-with a TPU kernel: per (batch, q-head, q-block) grid cell the kernel streams
-key/value blocks through VMEM with the usual running-max/normalizer
-accumulation, so the [S, S] score matrix never touches HBM and the MXU sees
-[block_q, d] x [d, block_k] tiles.
+with a TPU kernel: the grid runs (batch, q-head, q-block, k-block) with the
+k-block axis innermost, so each k/v tile is DMA'd into VMEM on demand while
+running-max/normalizer/accumulator scratch persists across k-steps — the
+[S, S] score matrix never exists and VMEM holds only O(block) tiles, so
+sequence length is bounded by HBM, not VMEM.
 
 Layout: q [B, N, S, D], k/v [B, K, S, D] (heads-major so a grid cell's tiles
 are contiguous); GQA maps q-head n to kv-head n // (N // K) in the index map.
+Backward runs through the dense reference core (remat); a fused backward
+kernel is a later optimization.
 """
 
 from __future__ import annotations
@@ -19,56 +22,55 @@ import math
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = float(jnp.finfo(jnp.float32).min)
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q: int, block_k: int,
-                  seq_len: int, causal: bool, scale: float):
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
+                  block_q: int, block_k: int, num_k: int, causal: bool,
+                  scale: float):
     qi = pl.program_id(2)
-    q = q_ref[0, 0].astype(jnp.float32) * scale  # [block_q, D]
-    d = q.shape[-1]
+    ki = pl.program_id(3)
 
-    m = jnp.full((block_q,), NEG_INF, jnp.float32)
-    l = jnp.zeros((block_q,), jnp.float32)
-    acc = jnp.zeros((block_q, d), jnp.float32)
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    num_k = seq_len // block_k
-    if causal:
-        # blocks past the diagonal contribute nothing; bound the loop
-        last = (qi * block_q + block_q - 1) // block_k + 1
-    else:
-        last = num_k
+    # blocks entirely past the causal diagonal contribute nothing
+    diag_last = (qi * block_q + block_q - 1) // block_k if causal else num_k
 
-    def body(ki, carry):
-        m, l, acc = carry
-        k = k_ref[0, 0, pl.dslice(ki * block_k, block_k), :].astype(
-            jnp.float32)
-        v = v_ref[0, 0, pl.dslice(ki * block_k, block_k), :].astype(
-            jnp.float32)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)  # [bq, bk]
+    @pl.when(ki <= diag_last)
+    def _update():
+        q = q_ref[0, 0].astype(jnp.float32) * scale  # [bq, D]
+        k = k_ref[0, 0].astype(jnp.float32)  # [bk, D]
+        v = v_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
         if causal:
             qpos = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             kpos = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(qpos >= kpos, s, NEG_INF)
+        m = m_ref[...]
         block_max = jnp.max(s, axis=1)
         new_m = jnp.maximum(m, block_max)
         corr = jnp.exp(jnp.where(m == NEG_INF, NEG_INF, m - new_m))
         p = jnp.exp(s - new_m[:, None])
         p = jnp.where(s == NEG_INF, 0.0, p)
-        new_l = l * corr + jnp.sum(p, axis=1)
-        new_acc = acc * corr[:, None] + jax.lax.dot_general(
+        m_ref[...] = new_m
+        l_ref[...] = l_ref[...] * corr + jnp.sum(p, axis=1)
+        acc_ref[...] = acc_ref[...] * corr[:, None] + jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        return new_m, new_l, new_acc
 
-    m, l, acc = jax.lax.fori_loop(0, last, body, (m, l, acc))
-    out = acc / jnp.maximum(l, 1e-20)[:, None]
-    o_ref[0, 0] = out.astype(o_ref.dtype)
+    @pl.when(ki == num_k - 1)
+    def _finalize():
+        out = acc_ref[...] / jnp.maximum(l_ref[...], 1e-20)[:, None]
+        o_ref[0, 0] = out.astype(o_ref.dtype)
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
@@ -90,21 +92,30 @@ def flash_attention_hmajor(
     block_k = min(block_k, S)
     if S % block_q or S % block_k:
         raise ValueError(f"seq {S} must divide by blocks {block_q}/{block_k}")
-    grid = (B, N, S // block_q)
+    num_k = S // block_k
+    grid = (B, N, S // block_q, num_k)  # k-block axis innermost
     kernel = functools.partial(
-        _flash_kernel, block_q=block_q, block_k=block_k, seq_len=S,
+        _flash_kernel, block_q=block_q, block_k=block_k, num_k=num_k,
         causal=causal, scale=1.0 / math.sqrt(D))
     return pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, 1, block_q, D), lambda b, n, qi: (b, n, qi, 0)),
-            pl.BlockSpec((1, 1, S, D), lambda b, n, qi: (b, n // G, 0, 0)),
-            pl.BlockSpec((1, 1, S, D), lambda b, n, qi: (b, n // G, 0, 0)),
+            pl.BlockSpec((1, 1, block_q, D),
+                         lambda b, n, qi, ki: (b, n, qi, 0)),
+            pl.BlockSpec((1, 1, block_k, D),
+                         lambda b, n, qi, ki: (b, n // G, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, D),
+                         lambda b, n, qi, ki: (b, n // G, ki, 0)),
         ],
         out_specs=pl.BlockSpec((1, 1, block_q, D),
-                               lambda b, n, qi: (b, n, qi, 0)),
+                               lambda b, n, qi, ki: (b, n, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((B, N, S, D), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q,), jnp.float32),
+            pltpu.VMEM((block_q,), jnp.float32),
+            pltpu.VMEM((block_q, D), jnp.float32),
+        ],
         interpret=interpret,
     )(q, k, v)
 

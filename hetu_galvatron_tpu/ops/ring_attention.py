@@ -35,12 +35,12 @@ def _block_scores(q, k, scale):
                       preferred_element_type=jnp.float32) * scale
 
 
-def _ring_body(step, carry, *, q, my_idx, cp, s_local, causal, axis):
-    """One ring step: fold key/value block (my_idx - step) mod cp into the
-    streaming softmax accumulator, then rotate k/v to the next rank."""
-    o, m, l, k, v = carry
+def _fold_block(step, acc, *, q, k, v, my_idx, cp, s_local, causal):
+    """Fold the key/value block currently held (global block
+    (my_idx - step) mod cp) into the streaming softmax accumulator."""
+    o, m, l = acc
     B, Sq, K, G, D = q.shape
-    src_block = (my_idx - step) % cp  # which global block `k` currently holds
+    src_block = (my_idx - step) % cp
     scores = _block_scores(q, k, 1.0 / math.sqrt(D))  # [B,K,G,Sq,Sk]
     if causal:
         qpos = my_idx * s_local + jnp.arange(Sq)[:, None]
@@ -56,10 +56,18 @@ def _ring_body(step, carry, *, q, my_idx, cp, s_local, causal, axis):
     pv = jnp.einsum("bkgst,btkd->bkgsd", p.astype(v.dtype), v,
                     preferred_element_type=jnp.float32)
     new_o = o * correction[..., None] + pv
+    return new_o, new_m, new_l
+
+
+def _ring_body(step, carry, *, q, my_idx, cp, s_local, causal, axis):
+    """One ring step: fold the current block, then rotate k/v onward."""
+    o, m, l, k, v = carry
+    o, m, l = _fold_block(step, (o, m, l), q=q, k=k, v=v, my_idx=my_idx,
+                          cp=cp, s_local=s_local, causal=causal)
     perm = [(i, (i + 1) % cp) for i in range(cp)]
     k = jax.lax.ppermute(k, axis, perm)
     v = jax.lax.ppermute(v, axis, perm)
-    return new_o, new_m, new_l, k, v
+    return o, m, l, k, v
 
 
 def _ring_attention_local(q, k, v, *, axis, causal):
@@ -76,7 +84,10 @@ def _ring_attention_local(q, k, v, *, axis, causal):
     l = jnp.zeros((B, K, G, Sq), jnp.float32)
     body = partial(_ring_body, q=qg, my_idx=my_idx, cp=cp,
                    s_local=Sq, causal=causal, axis=axis)
-    o, m, l, _, _ = jax.lax.fori_loop(0, cp, body, (o, m, l, k, v))
+    # cp-1 fold+rotate steps, then the final fold without the wasted rotate
+    o, m, l, k, v = jax.lax.fori_loop(0, cp - 1, body, (o, m, l, k, v))
+    o, m, l = _fold_block(cp - 1, (o, m, l), q=qg, k=k, v=v, my_idx=my_idx,
+                          cp=cp, s_local=Sq, causal=causal)
     o = o / jnp.maximum(l, 1e-20)[..., None]
     return o.transpose(0, 3, 1, 2, 4).reshape(B, Sq, N, D).astype(q.dtype)
 

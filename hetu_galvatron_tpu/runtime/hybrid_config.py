@@ -118,6 +118,8 @@ def get_hybrid_parallel_config(
             pp_deg=pp_deg, tp_size=tp, cp_size=cp, dp_size=stage // (tp * cp),
             sp=par.use_ulysses, tp_consecutive=bool(par.global_tp_consec),
             dp_type=dp_type, checkpoint=bool(par.global_checkpoint),
+            ep_size=max(par.global_ep_deg, 1),
+            etp_size=max(par.global_etp_deg, 1),
         )
         layers = [base] * n_layers
         vocab = EmbeddingLMHeadStrategy(

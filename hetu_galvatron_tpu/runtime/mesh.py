@@ -101,6 +101,15 @@ class LayerSharding:
     ulysses: bool = False  # tp axes carry sequence (a2a attention), not weights
     dp_type: DPType = DPType.DDP
     checkpoint: bool = False
+    # MoE: experts over ep axes (carved from dp), expert weights' mlp axis
+    # over etp axes (the reference's pp-ep-edp-etp grid, comm_groups.py:322-345)
+    ep_axes: Tuple[str, ...] = ()
+    etp_axes: Tuple[str, ...] = ()
+
+    @property
+    def edp_axes(self) -> Tuple[str, ...]:
+        """Expert-dp: the dp axes not consumed by ep."""
+        return self.dp_axes[len(self.ep_axes):]
 
     # -- param / optimizer-state specs ------------------------------------
 
@@ -109,16 +118,23 @@ class LayerSharding:
 
     def param_spec(self, logical_axes: Tuple[str, ...],
                    zero3_override: Optional[bool] = None) -> P:
-        """PartitionSpec for a param with the given logical axis names."""
+        """PartitionSpec for a param with the given logical axis names.
+        Expert params (an "expert" axis present) shard their weight dims over
+        etp and their ZeRO-3 embed dim over edp instead of tp/dp."""
         zero3 = (self.dp_type == DPType.ZERO3
                  if zero3_override is None else zero3_override)
         shard_embed = zero3 and len(logical_axes) >= 2
+        is_expert = "expert" in logical_axes
+        weight_axes = self.etp_axes if is_expert else self._weight_axes()
+        embed_axes = self.edp_axes if is_expert else self.dp_axes
         dims = []
         for name in logical_axes:
-            if name in _TP_LOGICAL:
-                dims.append(self._weight_axes() or None)
+            if name == "expert":
+                dims.append(self.ep_axes or None)
+            elif name in _TP_LOGICAL:
+                dims.append(weight_axes or None)
             elif name == "embed" and shard_embed:
-                dims.append(self.dp_axes or None)
+                dims.append(embed_axes or None)
             else:
                 dims.append(None)
         return P(*dims)
@@ -169,9 +185,18 @@ def lower_strategy(s: LayerStrategy, mesh: Mesh) -> LayerSharding:
         tp_axes = axes[:ktp]
         cp_axes = axes[ktp:ktp + kcp]
         dp_axes = axes[ktp + kcp:]
+    kep, ketp = _log2(s.ep_size), _log2(s.etp_size)
+    if kep > len(dp_axes):
+        raise ValueError(
+            f"ep {s.ep_size} exceeds the dp degree {s.dp_size} it is carved "
+            "from (reference grid pp-ep-edp-etp)")
+    if ketp > len(tp_axes):
+        raise ValueError(f"etp {s.etp_size} exceeds tp {s.tp_size}")
     return LayerSharding(
         dp_axes=dp_axes, cp_axes=cp_axes, tp_axes=tp_axes,
         ulysses=s.sp, dp_type=s.dp_type, checkpoint=s.checkpoint,
+        ep_axes=dp_axes[:kep],
+        etp_axes=tp_axes[len(tp_axes) - ketp:] if ketp else (),
     )
 
 

@@ -223,6 +223,10 @@ class PipelineEngine:
 
     def _stage_apply(self, st: _Stage, sp: Params, x: jax.Array,
                      labels=None, loss_mask=None):
+        """Non-head stages return (x, stage_aux); the head stage returns
+        ce_loss + its own aux (MoE auxiliary losses contribute per stage)."""
+        from hetu_galvatron_tpu.models.moe import apply_moe_decoder_layer
+
         cfg = self.cfg
         if st.has_embed:
             x = M.apply_embedding(sp["embed"], x, cfg,
@@ -233,21 +237,30 @@ class PipelineEngine:
         from hetu_galvatron_tpu.parallel.spmd import attention_overrides
 
         overrides = attention_overrides(st.shardings, st.mesh)
+        aux_total = jnp.zeros((), jnp.float32)
         for j, lp in enumerate(sp["layers"]):
             sh = st.shardings[j]
             x = jax.lax.with_sharding_constraint(
                 x, NamedSharding(st.mesh, sh.act_spec()))
-            fn = partial(M.apply_decoder_layer, cfg=cfg, rope=rope,
-                         compute_dtype=self.compute_dtype,
-                         **overrides.get(j, {}))
+            if "moe" in lp:
+                fn = partial(apply_moe_decoder_layer, cfg=cfg, rope=rope,
+                             compute_dtype=self.compute_dtype,
+                             **overrides.get(j, {}))
+            else:
+                base = partial(M.apply_decoder_layer, cfg=cfg, rope=rope,
+                               compute_dtype=self.compute_dtype,
+                               **overrides.get(j, {}))
+                fn = lambda p, h, b=base: (b(p, h),
+                                           jnp.zeros((), jnp.float32))
             if sh.checkpoint:
                 fn = jax.checkpoint(fn)
-            x = fn(lp, x)
+            x, aux = fn(lp, x)
+            aux_total = aux_total + aux
         if not st.has_head:
             # a stage may carry zero decoder layers (embed-only stage 0)
             sh = st.shardings[-1] if st.shardings else st.vocab
             return jax.lax.with_sharding_constraint(
-                x, NamedSharding(st.mesh, sh.act_spec()))
+                x, NamedSharding(st.mesh, sh.act_spec())), aux_total
         x = jax.lax.with_sharding_constraint(
             x, NamedSharding(st.mesh, st.vocab.act_spec()))
         x = M.apply_norm(sp["prenorm"], x, cfg)
@@ -256,14 +269,15 @@ class PipelineEngine:
             "bsh,hv->bsv", x.astype(self.compute_dtype),
             w.astype(self.compute_dtype),
             preferred_element_type=jnp.float32)
-        return M.cross_entropy_loss(logits, labels, loss_mask)
+        return M.cross_entropy_loss(logits, labels, loss_mask) + aux_total
 
     def _make_fwd(self, st: _Stage) -> Optional[Callable]:
         if st.has_head:
             return None  # head fwd is fused into its value_and_grad backward
 
         def f(sp, x):
-            return self._stage_apply(st, sp, x)
+            y, _ = self._stage_apply(st, sp, x)
+            return y
         return jax.jit(f)
 
     def _make_bwd(self, st: _Stage) -> Callable:
@@ -281,10 +295,13 @@ class PipelineEngine:
                 return dp, dx, loss
             return jax.jit(g)
 
-        def g(sp, x, dy):
-            _, vjp = jax.vjp(lambda sp_, x_: self._stage_apply(st, sp_, x_),
-                             sp, x)
-            return vjp(dy)
+        def g(sp, x, dy, seed):
+            # cotangents: dy for the activation, seed (the microbatch weight)
+            # for this stage's MoE aux loss which enters the total directly
+            (_, aux), vjp = jax.vjp(
+                lambda sp_, x_: self._stage_apply(st, sp_, x_), sp, x)
+            dp, dx = vjp((dy, seed))
+            return dp, dx, aux
         return jax.jit(g)
 
     def _make_update(self, st: _Stage) -> Callable:
@@ -360,7 +377,9 @@ class PipelineEngine:
         seed = jnp.asarray(w, jnp.float32)
         dp, dx, loss = self._bwd_jits[-1](stage_params[-1], inputs[-1], lbl,
                                           msk, seed)
-        ctx["losses"][m] = loss
+        # keep loss/aux as lazy device scalars — any host sync here would
+        # serialize the schedule; train_step folds them once at the end
+        aux_parts = []
         grad_acc[-1] = _tree_add(grad_acc[-1], dp)
         for s in range(self.pp - 2, -1, -1):
             dy = jax.device_put(
@@ -368,8 +387,13 @@ class PipelineEngine:
                                   (self.stages[s].shardings[-1].act_spec()
                                    if self.stages[s].shardings
                                    else self.stages[s].vocab.act_spec())))
-            dp, dx = self._bwd_jits[s](stage_params[s], inputs[s], dy)
+            dp, dx, aux = self._bwd_jits[s](stage_params[s], inputs[s], dy,
+                                            seed)
+            if self.cfg.num_experts:
+                aux_parts.append(aux)
             grad_acc[s] = _tree_add(grad_acc[s], dp)
+        ctx["losses"][m] = loss
+        ctx["aux"][m] = aux_parts
         # free stored activations for this microbatch (1F1B memory bound)
         ctx["inputs"][m] = None
 
@@ -382,7 +406,8 @@ class PipelineEngine:
         """One optimizer step under the configured schedule."""
         mbs, weights = self._microbatches(batch)
         mcount = len(mbs)
-        ctx = {"inputs": [], "labels": [], "losses": []}
+        ctx = {"inputs": [], "labels": [], "losses": [],
+               "aux": [[] for _ in range(mcount)]}
         grad_acc: List[Any] = [None] * self.pp
 
         if self.hpc.pipeline_type == "gpipe":
@@ -443,8 +468,9 @@ class PipelineEngine:
                                         jnp.asarray(scale, jnp.float32))
             new_params.append(p)
             new_opts.append(o)
-        loss = float(sum(jnp.asarray(w, jnp.float32) * l
-                         for w, l in zip(weights, ctx["losses"])))
+        # single host sync at the very end (all device work already queued)
+        loss = sum(float(w) * (float(l) + sum(float(a) for a in aux))
+                   for w, l, aux in zip(weights, ctx["losses"], ctx["aux"]))
         return new_params, new_opts, {"loss": loss, "grad_norm": gnorm}
 
 

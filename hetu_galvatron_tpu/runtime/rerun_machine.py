@@ -1,0 +1,207 @@
+"""Fault-detection rerun state machine.
+
+Capability parity with the reference rerun machinery
+(runtime/utils/rerun_state_machine.py:127-1307 ``RerunStateMachine`` /
+``RerunDataIterator`` / ``RerunErrorInjector``, initialized at
+initialize.py:152): validate each step's result (NaN / loss spike), re-run
+the same microbatch in place to classify a suspect result as a transient
+hardware fault (re-run differs) vs a deterministic/persistent one (re-run
+matches), replay batches through a caching iterator, inject synthetic errors
+for drills, and signal checkpoint-and-exit with the reference's dedicated
+exit codes.
+
+TPU note: determinism is XLA's default on TPU (no atomics-based nondeterminism
+like CUDA), which makes the "re-run matches exactly => deterministic issue"
+signal stronger than on GPUs.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+from hetu_galvatron_tpu.core.args_schema import RerunArgs
+
+# reference exit codes (rerun_state_machine.py:33-37)
+EXIT_CODE_RESUME_TO_DISAMBIGUATE = 16
+EXIT_CODE_FAILED_ON_RESULT_VALIDATION = 17
+
+
+class RerunDiagnostic(str, Enum):
+    CORRECT = "correct"
+    TRANSIENT_ERROR = "transient_error"  # re-run produced a different result
+    PERSISTENT_ERROR = "persistent_error"  # re-run reproduced the bad result
+
+
+class RerunState(str, Enum):
+    NOT_RUNNING_YET = "not_running_yet"
+    RUNNING = "running"
+    RERUNNING_IN_PLACE = "rerunning_in_place"
+
+
+@dataclass
+class RerunRecord:
+    iteration: int
+    value: float
+    rerun_value: Optional[float]
+    diagnostic: RerunDiagnostic
+    reason: str
+
+
+class RerunDataIterator:
+    """Replayable wrapper: keeps the current step's batches so a rerun
+    replays identical data (reference RerunDataIterator,
+    rerun_state_machine.py:989)."""
+
+    def __init__(self, it: Iterator):
+        self._it = it
+        self._cache: List[Any] = []
+        self._replaying = False
+        self._replay_idx = 0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._replaying:
+            if self._replay_idx >= len(self._cache):
+                raise StopIteration
+            item = self._cache[self._replay_idx]
+            self._replay_idx += 1
+            return item
+        item = next(self._it)
+        self._cache.append(item)
+        return item
+
+    def rewind(self) -> None:
+        self._replaying = True
+        self._replay_idx = 0
+
+    def advance(self) -> None:
+        """Commit the step: drop cached batches, resume the live stream."""
+        self._cache.clear()
+        self._replaying = False
+        self._replay_idx = 0
+
+
+class RerunErrorInjector:
+    """Synthetic fault injection for drills (reference RerunErrorInjector,
+    rerun_state_machine.py:1143)."""
+
+    def __init__(self, rate: float = 0.0,
+                 kind: str = "transient_error", seed: int = 0):
+        self.rate = rate
+        self.kind = kind
+        self._rng = random.Random(seed)
+        self._injected_iters: Dict[int, int] = {}
+
+    def maybe_corrupt(self, value: float, iteration: int,
+                      attempt: int) -> float:
+        if self.rate <= 0:
+            return value
+        if attempt == 0:
+            if self._rng.random() < self.rate:
+                self._injected_iters[iteration] = 1
+                return float("nan")
+            return value
+        # rerun attempt: persistent faults reproduce, transient ones vanish
+        if iteration in self._injected_iters and \
+                self.kind == "persistent_error":
+            return float("nan")
+        return value
+
+
+class RerunStateMachine:
+    """Wraps the host train loop's step result (reference
+    should_run_forward_backward :251 / validate_result :434)."""
+
+    def __init__(self, args: RerunArgs):
+        self.args = args
+        self.state = RerunState.NOT_RUNNING_YET
+        self.records: List[RerunRecord] = []
+        self.injector = RerunErrorInjector(
+            args.error_injection_rate, args.error_injection_type)
+        self._ema: Optional[float] = None
+        self._last_exit_code: Optional[int] = None
+
+    @property
+    def enabled(self) -> bool:
+        return self.args.enable and self.args.mode != "disabled"
+
+    # -- validation ---------------------------------------------------------
+
+    def _suspicious(self, value: float) -> Optional[str]:
+        if self.args.check_for_nan and (math.isnan(value)
+                                        or math.isinf(value)):
+            return "non-finite loss"
+        if self.args.check_for_spike and self._ema is not None and \
+                value > self.args.spike_factor * self._ema:
+            return (f"loss spike: {value:.4f} > {self.args.spike_factor} x "
+                    f"EMA {self._ema:.4f}")
+        return None
+
+    def _update_ema(self, value: float) -> None:
+        if math.isfinite(value):
+            self._ema = (value if self._ema is None
+                         else 0.9 * self._ema + 0.1 * value)
+
+    def validate_result(
+        self,
+        value: float,
+        iteration: int,
+        rerun_fn: Optional[Callable[[], float]] = None,
+        data_iterator: Optional[RerunDataIterator] = None,
+    ) -> RerunDiagnostic:
+        """Check one step's loss; on suspicion re-run the identical step to
+        attribute the fault. Returns the diagnostic; exit-code requests are
+        exposed via :meth:`exit_code_requested`."""
+        if not self.enabled:
+            self._update_ema(value)
+            return RerunDiagnostic.CORRECT
+        value = self.injector.maybe_corrupt(value, iteration, attempt=0)
+        self.state = RerunState.RUNNING
+        reason = self._suspicious(value)
+        if reason is None:
+            self._update_ema(value)
+            return RerunDiagnostic.CORRECT
+
+        diagnostic = RerunDiagnostic.PERSISTENT_ERROR
+        rerun_value: Optional[float] = None
+        if rerun_fn is not None:
+            self.state = RerunState.RERUNNING_IN_PLACE
+            if data_iterator is not None:
+                data_iterator.rewind()
+            rerun_value = self.injector.maybe_corrupt(
+                float(rerun_fn()), iteration, attempt=1)
+            same = (rerun_value == value) or (
+                math.isnan(rerun_value) and math.isnan(value))
+            diagnostic = (RerunDiagnostic.PERSISTENT_ERROR if same
+                          else RerunDiagnostic.TRANSIENT_ERROR)
+        self.records.append(RerunRecord(
+            iteration=iteration, value=value, rerun_value=rerun_value,
+            diagnostic=diagnostic, reason=reason))
+        self.state = RerunState.RUNNING
+        if self.args.mode == "validate_results":
+            self._last_exit_code = (
+                EXIT_CODE_FAILED_ON_RESULT_VALIDATION
+                if diagnostic == RerunDiagnostic.PERSISTENT_ERROR
+                else EXIT_CODE_RESUME_TO_DISAMBIGUATE)
+        return diagnostic
+
+    def exit_code_requested(self) -> Optional[int]:
+        """Non-None when the run should checkpoint and exit with the given
+        code (reference exit codes 16/17)."""
+        return self._last_exit_code
+
+    def report(self) -> Dict[str, Any]:
+        return {
+            "checked_iterations": len(self.records),
+            "transient": sum(r.diagnostic == RerunDiagnostic.TRANSIENT_ERROR
+                             for r in self.records),
+            "persistent": sum(r.diagnostic == RerunDiagnostic.PERSISTENT_ERROR
+                              for r in self.records),
+            "records": [r.__dict__ for r in self.records],
+        }

@@ -1,0 +1,95 @@
+"""Rerun state machine: NaN/spike detection, transient-vs-persistent
+attribution, replayable iterator, error injection (reference
+rerun_state_machine.py behaviors)."""
+
+import math
+
+import pytest
+
+from hetu_galvatron_tpu.core.args_schema import RerunArgs
+from hetu_galvatron_tpu.runtime.rerun_machine import (
+    EXIT_CODE_FAILED_ON_RESULT_VALIDATION,
+    EXIT_CODE_RESUME_TO_DISAMBIGUATE,
+    RerunDataIterator,
+    RerunDiagnostic,
+    RerunStateMachine,
+)
+
+pytestmark = pytest.mark.utils
+
+
+def _machine(**kw):
+    return RerunStateMachine(RerunArgs(enable=True, mode="validate_results",
+                                       **kw))
+
+
+def test_disabled_passthrough():
+    m = RerunStateMachine(RerunArgs(enable=False))
+    assert m.validate_result(float("nan"), 0) == RerunDiagnostic.CORRECT
+    assert m.exit_code_requested() is None
+
+
+def test_nan_transient_vs_persistent():
+    m = _machine()
+    # transient: the rerun produces a clean value
+    d = m.validate_result(float("nan"), 0, rerun_fn=lambda: 1.0)
+    assert d == RerunDiagnostic.TRANSIENT_ERROR
+    assert m.exit_code_requested() == EXIT_CODE_RESUME_TO_DISAMBIGUATE
+    # persistent: the rerun reproduces the NaN
+    m2 = _machine()
+    d = m2.validate_result(float("nan"), 0, rerun_fn=lambda: float("nan"))
+    assert d == RerunDiagnostic.PERSISTENT_ERROR
+    assert m2.exit_code_requested() == EXIT_CODE_FAILED_ON_RESULT_VALIDATION
+
+
+def test_spike_detection():
+    m = _machine(spike_factor=5.0)
+    for it in range(5):
+        assert m.validate_result(2.0, it) == RerunDiagnostic.CORRECT
+    d = m.validate_result(50.0, 5, rerun_fn=lambda: 50.0)
+    assert d == RerunDiagnostic.PERSISTENT_ERROR
+    assert m.report()["persistent"] == 1
+
+
+def test_normal_values_update_ema():
+    m = _machine(spike_factor=10.0)
+    for it in range(10):
+        assert m.validate_result(3.0 - it * 0.1, it) == RerunDiagnostic.CORRECT
+    assert not m.records
+
+
+def test_data_iterator_replay():
+    it = RerunDataIterator(iter(range(10)))
+    assert next(it) == 0 and next(it) == 1
+    it.rewind()
+    assert next(it) == 0 and next(it) == 1
+    it.advance()
+    assert next(it) == 2
+
+
+def test_error_injection_drill():
+    m = RerunStateMachine(RerunArgs(
+        enable=True, mode="validate_results", error_injection_rate=1.0,
+        error_injection_type="transient_error"))
+    d = m.validate_result(1.0, 0, rerun_fn=lambda: 1.0)
+    assert d == RerunDiagnostic.TRANSIENT_ERROR  # injected once, gone on rerun
+
+    m2 = RerunStateMachine(RerunArgs(
+        enable=True, mode="validate_results", error_injection_rate=1.0,
+        error_injection_type="persistent_error"))
+    d = m2.validate_result(1.0, 0, rerun_fn=lambda: 1.0)
+    assert d == RerunDiagnostic.PERSISTENT_ERROR
+
+
+def test_rerun_replays_same_data():
+    it = RerunDataIterator(iter(range(100)))
+    m = _machine()
+    batch = next(it)
+
+    def rerun():
+        b = next(it)
+        assert b == batch  # identical data replayed
+        return 1.0
+
+    d = m.validate_result(float("nan"), 0, rerun_fn=rerun, data_iterator=it)
+    assert d == RerunDiagnostic.TRANSIENT_ERROR
