@@ -1,0 +1,58 @@
+// Dataset index helpers: sample-mapping construction for the indexed GPT
+// dataset.
+//
+// Same role as the reference's C++ dataset builder
+// (runtime/datasets/megatron/helpers.cpp build_sample_idx, compiled lazily at
+// startup via initialize.py:163-187): given per-document token counts, emit
+// for each training sample of (seq_len + 1) tokens the (document index,
+// in-document offset) where it starts, treating the corpus as one
+// concatenated token stream. O(num_samples + num_docs) two-pointer walk —
+// the hot one-shot loop that is painfully slow in Python for billion-token
+// corpora.
+//
+// Build: make -C csrc dataset  (g++ -O2 -shared -fPIC)
+
+#include <cstdint>
+
+extern "C" {
+
+// doc_lens:   [num_docs] token count per document
+// out_doc:    [num_samples] starting document index per sample
+// out_offset: [num_samples] starting token offset within that document
+// Returns the number of samples actually written (may be < num_samples when
+// the corpus is too small).
+int64_t build_sample_idx(const int64_t* doc_lens, int64_t num_docs,
+                         int64_t seq_len, int64_t num_samples,
+                         int64_t* out_doc, int64_t* out_offset) {
+    const int64_t stride = seq_len;  // samples advance seq_len tokens
+    int64_t doc = 0;
+    int64_t offset = 0;
+    int64_t total = 0;
+    for (int64_t d = 0; d < num_docs; ++d) total += doc_lens[d];
+
+    int64_t written = 0;
+    int64_t pos = 0;
+    for (int64_t s = 0; s < num_samples; ++s) {
+        if (pos + seq_len + 1 > total) break;
+        out_doc[written] = doc;
+        out_offset[written] = offset;
+        ++written;
+        // advance the two-pointer walk by `stride` tokens
+        int64_t remaining = stride;
+        while (remaining > 0 && doc < num_docs) {
+            const int64_t avail = doc_lens[doc] - offset;
+            if (avail > remaining) {
+                offset += remaining;
+                remaining = 0;
+            } else {
+                remaining -= avail;
+                ++doc;
+                offset = 0;
+            }
+        }
+        pos += stride;
+    }
+    return written;
+}
+
+}  // extern "C"

@@ -11,17 +11,46 @@ TPU-first design notes (vs the torch/NCCL reference):
   - process groups        -> `jax.sharding.Mesh` views + named-axis collectives
   - FSDP wrapping         -> parameter/optimizer PartitionSpecs on the `dp` axis
   - Megatron TP layers    -> GSPMD-sharded einsums (XLA inserts the collectives)
-  - NCCL p2p pipeline     -> `shard_map` over the `pp` axis with `lax.ppermute`
-  - flash-attn CUDA ops   -> Pallas flash/splash attention kernels
-  - Triton kernels        -> Pallas kernels
+  - NCCL p2p pipeline     -> per-stage jitted programs + sharded device_put
+  - flash-attn CUDA ops   -> Pallas flash attention kernel
+  - Triton kernels        -> Pallas
   - activation relocation -> `with_sharding_constraint` resharding at boundaries
 """
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
+from hetu_galvatron_tpu.core.arguments import (  # noqa: F401
+    args_from_cli,
+    load_config,
+)
+from hetu_galvatron_tpu.core.args_schema import CoreArgs, ModelArgs  # noqa: F401
 from hetu_galvatron_tpu.utils.strategy import (  # noqa: F401
     DPType,
+    EmbeddingLMHeadStrategy,
     LayerStrategy,
-    strategy_list2config,
     config2strategy,
+    strategy_list2config,
 )
+
+
+def __getattr__(name):
+    """Lazy heavyweight entry points (importing them pulls in jax)."""
+    if name == "SearchEngine":
+        from hetu_galvatron_tpu.core.search_engine.engine import SearchEngine
+
+        return SearchEngine
+    if name == "PipelineEngine":
+        from hetu_galvatron_tpu.runtime.pipeline import PipelineEngine
+
+        return PipelineEngine
+    if name == "build_mesh":
+        from hetu_galvatron_tpu.runtime.mesh import build_mesh
+
+        return build_mesh
+    if name == "get_hybrid_parallel_config":
+        from hetu_galvatron_tpu.runtime.hybrid_config import (
+            get_hybrid_parallel_config,
+        )
+
+        return get_hybrid_parallel_config
+    raise AttributeError(name)

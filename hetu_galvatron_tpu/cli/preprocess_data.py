@@ -1,0 +1,94 @@
+"""Corpus preparation: text -> tokenized .bin/.idx indexed dataset.
+
+Counterpart of the reference's Megatron preprocessing flow (the reference
+consumes externally-preprocessed mmap corpora; this CLI closes the loop):
+``python -m hetu_galvatron_tpu.cli.preprocess_data input.txt[,more.txt]
+output_prefix [tokenizer=<hf-name-or-path>] [append_eod=1]``.
+
+One document per input line (JSONL with a "text" field also accepted). With
+no tokenizer given, a byte-level fallback (vocab 256 + eod 256) keeps the
+pipeline dependency-free.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Iterator, List, Optional
+
+
+def _iter_documents(paths: List[str]) -> Iterator[str]:
+    for path in paths:
+        with open(path) as f:
+            for line in f:
+                line = line.rstrip("\n")
+                if not line:
+                    continue
+                if line.lstrip().startswith("{"):
+                    try:
+                        obj = json.loads(line)
+                        yield str(obj.get("text", line))
+                        continue
+                    except json.JSONDecodeError:
+                        pass
+                yield line
+
+
+class ByteTokenizer:
+    """Dependency-free fallback: UTF-8 bytes as ids, eod = 256."""
+
+    vocab_size = 257
+    eod_id = 256
+
+    def encode(self, text: str) -> List[int]:
+        return list(text.encode("utf-8"))
+
+
+def make_tokenizer(name: Optional[str]):
+    if not name or name == "byte":
+        return ByteTokenizer()
+    from transformers import AutoTokenizer
+
+    tok = AutoTokenizer.from_pretrained(name)
+
+    class _Wrap:
+        vocab_size = tok.vocab_size
+        eod_id = tok.eos_token_id if tok.eos_token_id is not None else 0
+
+        def encode(self, text: str) -> List[int]:
+            return tok.encode(text, add_special_tokens=False)
+
+    return _Wrap()
+
+
+def main(argv=None) -> int:
+    from hetu_galvatron_tpu.data.indexed_dataset import write_indexed_dataset
+
+    argv = list(argv if argv is not None else sys.argv[1:])
+    pos = [a for a in argv if "=" not in a]
+    kv = dict(a.split("=", 1) for a in argv if "=" in a)
+    if len(pos) < 2:
+        print("usage: preprocess_data <input[,input2...]> <output_prefix> "
+              "[tokenizer=<hf-name|byte>] [append_eod=1]", file=sys.stderr)
+        return 2
+    inputs = pos[0].split(",")
+    prefix = pos[1]
+    tok = make_tokenizer(kv.get("tokenizer"))
+    append_eod = kv.get("append_eod", "1") != "0"
+
+    def docs():
+        for text in _iter_documents(inputs):
+            ids = tok.encode(text)
+            if append_eod:
+                ids = ids + [tok.eod_id]
+            if ids:
+                yield ids
+
+    stats = write_indexed_dataset(prefix, docs())
+    print(f"wrote {prefix}.bin/.idx: {stats['documents']} documents, "
+          f"{stats['tokens']} tokens (vocab {tok.vocab_size})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -24,12 +24,22 @@ def train(args) -> Dict[str, Any]:
     from hetu_galvatron_tpu.core.profiler.runtime_profiler import RuntimeProfiler
     from hetu_galvatron_tpu.models.builder import init_causal_lm
     from hetu_galvatron_tpu.parallel.spmd import make_spmd_train_step, shard_params
+    from hetu_galvatron_tpu.runtime.checkpoint import (
+        latest_checkpoint,
+        load_checkpoint,
+        save_checkpoint,
+        wait_for_checkpoints,
+    )
     from hetu_galvatron_tpu.runtime.dataloader import get_data_iterator
     from hetu_galvatron_tpu.runtime.hybrid_config import get_hybrid_parallel_config
     from hetu_galvatron_tpu.runtime.initialize import initialize
     from hetu_galvatron_tpu.runtime.mesh import build_mesh
     from hetu_galvatron_tpu.runtime.optimizer import make_lr_schedule, make_optimizer
     from hetu_galvatron_tpu.runtime.pipeline import PipelineEngine
+    from hetu_galvatron_tpu.runtime.rerun_machine import (
+        RerunDataIterator,
+        RerunStateMachine,
+    )
     from hetu_galvatron_tpu.utils.hf_config_adapter import resolve_model_config
 
     args = resolve_model_config(args)
@@ -42,47 +52,112 @@ def train(args) -> Dict[str, Any]:
     params, axes = init_causal_lm(jax.random.key(args.train.seed), cfg)
     tx = make_optimizer(args.train)
     schedule = make_lr_schedule(args.train)
-    data_iter = get_data_iterator(args, global_batch_size=hpc.global_bsz)
+    data_iter = RerunDataIterator(
+        get_data_iterator(args, global_batch_size=hpc.global_bsz))
     profiler = RuntimeProfiler(args, world_size=world)
+    rerun = RerunStateMachine(args.rerun)
+    start_iter = 0
 
     from hetu_galvatron_tpu.models.modules import compute_dtype_of
 
     compute_dtype = compute_dtype_of(args.parallel.mixed_precision)
     losses = []
 
+    def maybe_save(it, sp, so):
+        ck = args.ckpt
+        if ck.save and ck.save_interval and (it + 1) % ck.save_interval == 0:
+            save_checkpoint(ck.save, it + 1, sp, so, hpc=hpc,
+                            async_save=ck.async_save)
+            state.log(f"saved checkpoint at iter {it + 1}")
+
+    def maybe_resume(sp, so):
+        """Restore (sp, so, start_iter) and fast-forward the data stream so
+        a resumed run consumes the batches an uninterrupted run would."""
+        start = 0
+        if args.ckpt.load:
+            ckdir = latest_checkpoint(args.ckpt.load)
+            if ckdir:
+                sp, so, start = load_checkpoint(
+                    ckdir, sp, so, hpc=hpc,
+                    strict_plan=args.ckpt.distributed_checkpoint)
+                state.log(f"resumed from {ckdir} at iter {start}")
+                for _ in range(start):
+                    next(data_iter)
+                    data_iter.advance()
+        return sp, so, start
+
+    exit_code = None
+
+    def run_loop(sp, so, step_fn):
+        """Shared iteration driver for both execution paths. step_fn(sp, so,
+        raw_batch) -> (sp, so, metrics)."""
+        nonlocal exit_code
+        for it in range(start_iter, args.train.train_iters):
+            profiler.time_start(it)
+            batch = next(data_iter)
+            # keep pre-update state alive only when the rerun machine may
+            # re-execute the step for fault attribution
+            prev = (sp, so) if rerun.enabled else None
+            sp, so, metrics = step_fn(sp, so, batch)
+            profiler.time_end(it, sync=metrics.get("loss"))
+            profiler.iteration_log(it, metrics, lr=float(schedule(it)))
+            rerun.validate_result(
+                float(metrics["loss"]), it,
+                rerun_fn=(
+                    (lambda: float(step_fn(*prev, batch)[2]["loss"]))
+                    if prev is not None else None),
+                data_iterator=data_iter)
+            data_iter.advance()
+            losses.append(float(metrics["loss"]))
+            maybe_save(it, sp, so)
+            exit_code = rerun.exit_code_requested()
+            if exit_code is not None:
+                state.log(f"rerun machine requested exit (code {exit_code});"
+                          " checkpointing")
+                ck = args.ckpt
+                already_saved = (ck.save and ck.save_interval
+                                 and (it + 1) % ck.save_interval == 0)
+                if ck.save and not already_saved:
+                    wait_for_checkpoints()  # never race an in-flight save
+                    save_checkpoint(ck.save, it + 1, sp, so, hpc=hpc)
+                break
+        return sp, so
+
     if hpc.pp_deg > 1:
         eng = PipelineEngine(cfg, hpc, args.train, devices=state.devices,
                              compute_dtype=compute_dtype)
         sp = eng.split_params(params, axes)
         so = eng.init_opt(sp, axes)
-        for it in range(args.train.train_iters):
-            profiler.time_start(it)
-            batch = next(data_iter)
-            sp, so, metrics = eng.train_step(sp, so, batch)
-            profiler.time_end(it)
-            profiler.iteration_log(it, metrics, lr=float(schedule(it)))
-            losses.append(metrics["loss"])
+        sp, so, start_iter = maybe_resume(sp, so)
+        run_loop(sp, so, eng.train_step)
     else:
         mesh = build_mesh(world, 1, devices=state.devices)
+        # donation halves live model-state memory but is only safe when the
+        # rerun machine will never re-call the step on pre-update buffers
         step, pspecs, ospecs, batch_shd = make_spmd_train_step(
-            cfg, hpc, mesh, axes, tx, params, compute_dtype=compute_dtype)
-        sp = shard_params(params, pspecs, mesh)
-        so = jax.jit(tx.init, out_shardings=jax.tree.map(
+            cfg, hpc, mesh, axes, tx, params, compute_dtype=compute_dtype,
+            donate=not rerun.enabled)
+        nshd = jax.tree.map(
             lambda s: NamedSharding(mesh, s), ospecs,
-            is_leaf=lambda x: isinstance(x, PartitionSpec)))(sp)
-        for it in range(args.train.train_iters):
-            profiler.time_start(it)
-            batch = jax.device_put(
-                jax.tree.map(jnp.asarray, next(data_iter)), batch_shd)
-            sp, so, metrics = step(sp, so, batch)
-            profiler.time_end(it, sync=metrics["loss"])
-            profiler.iteration_log(it, metrics, lr=float(schedule(it)))
-            losses.append(metrics["loss"])
+            is_leaf=lambda x: isinstance(x, PartitionSpec))
+        sp = shard_params(params, pspecs, mesh)
+        so = jax.jit(tx.init, out_shardings=nshd)(sp)
+        sp, so, start_iter = maybe_resume(sp, so)
 
-    losses = [float(l) for l in losses]
+        def spmd_step(sp, so, raw):
+            b = jax.device_put(jax.tree.map(jnp.asarray, raw), batch_shd)
+            return step(sp, so, b)
+
+        run_loop(sp, so, spmd_step)
+
+    wait_for_checkpoints()
     if args.profile.profile:
         state.log(f"mean iter time: {profiler.filtered_time_ms():.2f} ms")
-    return {"losses": losses, "iter_ms": profiler.filtered_time_ms()}
+    if rerun.enabled and rerun.records:
+        state.log(f"rerun report: {rerun.report()}")
+    return {"losses": losses, "iter_ms": profiler.filtered_time_ms(),
+            "rerun": rerun.report() if rerun.enabled else None,
+            "exit_code": exit_code}
 
 
 def main(argv=None) -> int:
@@ -91,7 +166,13 @@ def main(argv=None) -> int:
     args = args_from_cli(argv if argv is not None else sys.argv[1:],
                          mode="train_dist")
     out = train(args)
-    final = out["losses"][-1] if out["losses"] else float("nan")
+    if out.get("exit_code") is not None:
+        return out["exit_code"]  # the reference's 16/17 fault contract
+    if not out["losses"]:
+        # e.g. resuming a run that had already reached train_iters
+        print("training done: 0 iters (nothing left to train)")
+        return 0
+    final = out["losses"][-1]
     print(f"training done: {len(out['losses'])} iters, final loss {final:.4f}")
     return 0 if np.isfinite(final) else 1
 

@@ -11,50 +11,30 @@ fallback and the cross-check.
 from __future__ import annotations
 
 import ctypes
-import os
-import subprocess
 from typing import Optional, Sequence, Tuple
 
 import numpy as np
 
-_CSRC = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                     "..", "..", "..", "csrc")
-_LIB: Optional[ctypes.CDLL] = None
-_LIB_TRIED = False
+from hetu_galvatron_tpu.utils.native import load_native
+
+
+def _configure(lib: ctypes.CDLL) -> None:
+    lib.dp_solve.restype = ctypes.c_int
+    lib.dp_solve.argtypes = [
+        ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+        ctypes.c_int, ctypes.c_double,
+        np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+        ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_int),
+    ]
 
 
 def _load_cpp_core() -> Optional[ctypes.CDLL]:
-    """Lazily build + load csrc/libdp_core.so; None if the toolchain is
-    unavailable."""
-    global _LIB, _LIB_TRIED
-    if _LIB_TRIED:
-        return _LIB
-    _LIB_TRIED = True
-    so = os.path.join(_CSRC, "libdp_core.so")
-    src = os.path.join(_CSRC, "dp_core.cpp")
-    try:
-        if (not os.path.exists(so)
-                or os.path.getmtime(so) < os.path.getmtime(src)):
-            subprocess.run(["make", "-C", _CSRC], check=True,
-                           capture_output=True)
-        lib = ctypes.CDLL(so)
-        lib.dp_solve.restype = ctypes.c_int
-        lib.dp_solve.argtypes = [
-            ctypes.c_int, ctypes.c_int, ctypes.c_int,
-            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
-            np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
-            np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
-            ctypes.c_int, ctypes.c_double,
-            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
-            np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
-            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
-            ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_int),
-        ]
-        _LIB = lib
-    except (subprocess.CalledProcessError, OSError) as e:  # no toolchain
-        print(f"dp core: C++ build unavailable ({e}); using numpy fallback")
-        _LIB = None
-    return _LIB
+    return load_native("libdp_core.so", "dp_core.cpp", _configure)
 
 
 def dp_solve(

@@ -1,0 +1,224 @@
+"""Memory-mapped indexed token dataset (Megatron-style .bin/.idx pair).
+
+Capability parity with the reference dataset stack (runtime/datasets/megatron/
+indexed_dataset.py:506 ``IndexedDataset``, gpt_dataset.py:65 ``GPTDataset``,
+helpers.cpp sample builders, blended_megatron_dataset_builder.py:39): a
+binary token file + document-offset index read via numpy memmap, a GPT-style
+sample view that concatenates documents into fixed-length training samples,
+and a blended multi-corpus wrapper. The sample mapping is built by the C++
+helper (csrc/dataset_helpers.cpp, lazily compiled + ctypes-bound exactly like
+the DP core) with a numpy fallback.
+
+File format (ours, versioned): ``<name>.bin`` is raw little-endian token ids;
+``<name>.idx`` holds a header (magic/version/dtype/doc count) followed by
+int64 document offsets (in tokens). A converter from token iterators is
+provided for corpus preparation.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from hetu_galvatron_tpu.utils.native import load_native
+
+_MAGIC = b"HGTPUIDX"
+_VERSION = 1
+_DTYPES = {1: np.uint16, 2: np.int32, 3: np.int64}
+_DTYPE_CODES = {np.dtype(v): k for k, v in _DTYPES.items()}
+
+
+def _configure(lib: ctypes.CDLL) -> None:
+    lib.build_sample_idx.restype = ctypes.c_int64
+    lib.build_sample_idx.argtypes = [
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+    ]
+
+
+def _load_helpers():
+    return load_native("libdataset_helpers.so", "dataset_helpers.cpp",
+                       _configure)
+
+
+def build_sample_idx(doc_lens: np.ndarray, seq_len: int,
+                     num_samples: int) -> np.ndarray:
+    """[num_samples, 2] (doc index, in-doc offset) per sample start; C++
+    helper when available, vectorized numpy otherwise."""
+    doc_lens = np.ascontiguousarray(doc_lens, np.int64)
+    lib = _load_helpers()
+    if lib is not None:
+        out_doc = np.empty((num_samples,), np.int64)
+        out_off = np.empty((num_samples,), np.int64)
+        n = lib.build_sample_idx(doc_lens, len(doc_lens), seq_len,
+                                 num_samples, out_doc, out_off)
+        return np.stack([out_doc[:n], out_off[:n]], axis=1)
+    ends = np.cumsum(doc_lens)
+    total = int(ends[-1]) if len(ends) else 0
+    starts_tok = np.arange(num_samples, dtype=np.int64) * seq_len
+    starts_tok = starts_tok[starts_tok + seq_len + 1 <= total]
+    doc = np.searchsorted(ends, starts_tok, side="right")
+    doc_start = np.concatenate([[0], ends[:-1]])
+    return np.stack([doc, starts_tok - doc_start[doc]], axis=1)
+
+
+def write_indexed_dataset(
+    prefix: str, documents: Iterable[Sequence[int]],
+    dtype=np.int32,
+) -> Dict[str, int]:
+    """Token documents -> <prefix>.bin/.idx (corpus-prep utility; the
+    reference ships external preprocess scripts for this)."""
+    dtype = np.dtype(dtype)
+    offsets: List[int] = [0]
+    count = 0
+    with open(prefix + ".bin", "wb") as f:
+        for doc in documents:
+            arr = np.asarray(doc, dtype=dtype)
+            arr.tofile(f)
+            count += arr.size
+            offsets.append(count)
+    with open(prefix + ".idx", "wb") as f:
+        f.write(_MAGIC)
+        f.write(struct.pack("<HHq", _VERSION, _DTYPE_CODES[dtype],
+                            len(offsets) - 1))
+        np.asarray(offsets, np.int64).tofile(f)
+    return {"documents": len(offsets) - 1, "tokens": count}
+
+
+class IndexedDataset:
+    """mmap view over a .bin/.idx pair (reference IndexedDataset,
+    indexed_dataset.py:506)."""
+
+    def __init__(self, prefix: str):
+        with open(prefix + ".idx", "rb") as f:
+            magic = f.read(len(_MAGIC))
+            if magic != _MAGIC:
+                raise ValueError(f"{prefix}.idx: bad magic {magic!r}")
+            version, dtype_code, num_docs = struct.unpack("<HHq", f.read(12))
+            if version != _VERSION:
+                raise ValueError(f"unsupported index version {version}")
+            self.dtype = np.dtype(_DTYPES[dtype_code])
+            self.offsets = np.fromfile(f, np.int64, num_docs + 1)
+        self.tokens = np.memmap(prefix + ".bin", dtype=self.dtype, mode="r")
+        self.num_docs = num_docs
+
+    def __len__(self) -> int:
+        return self.num_docs
+
+    @property
+    def total_tokens(self) -> int:
+        return int(self.offsets[-1])
+
+    @property
+    def doc_lens(self) -> np.ndarray:
+        return np.diff(self.offsets)
+
+    def get_doc(self, i: int) -> np.ndarray:
+        return np.asarray(self.tokens[self.offsets[i]:self.offsets[i + 1]])
+
+    def get_span(self, doc: int, offset: int, length: int) -> np.ndarray:
+        """`length` tokens starting at (doc, offset), crossing document
+        boundaries (GPT concatenated-stream semantics)."""
+        start = int(self.offsets[doc] + offset)
+        return np.asarray(self.tokens[start:start + length])
+
+
+class GPTDataset:
+    """Fixed-length sample view with a per-epoch reshuffled sample order
+    (reference GPTDataset builds an epoch-aware shuffle_idx,
+    gpt_dataset.py:65): index i in epoch e = i // len uses a permutation
+    seeded by (seed, e), so multi-epoch runs never repeat batch order."""
+
+    def __init__(self, indexed: IndexedDataset, seq_length: int,
+                 seed: int = 1234, shuffle: bool = True):
+        self.indexed = indexed
+        self.seq_length = seq_length
+        self.seed = seed
+        self.shuffle = shuffle
+        max_samples = max(
+            (indexed.total_tokens - 1) // seq_length, 0)
+        self.sample_idx = build_sample_idx(
+            indexed.doc_lens, seq_length, max_samples)
+        self._epoch = -1
+        self._order = np.arange(len(self.sample_idx))
+
+    def __len__(self) -> int:
+        return len(self.sample_idx)
+
+    def _order_for(self, epoch: int) -> np.ndarray:
+        if epoch != self._epoch:
+            order = np.arange(len(self.sample_idx))
+            if self.shuffle:
+                np.random.RandomState(self.seed + epoch).shuffle(order)
+            self._epoch, self._order = epoch, order
+        return self._order
+
+    def __getitem__(self, i: int) -> np.ndarray:
+        n = max(len(self), 1)
+        order = self._order_for(i // n)
+        doc, off = self.sample_idx[order[i % n]]
+        return self.indexed.get_span(int(doc), int(off),
+                                     self.seq_length + 1).astype(np.int32)
+
+
+class BlendedDataset:
+    """Sample-proportional blend of several GPTDatasets (reference
+    BlendedMegatronDatasetBuilder, blended_megatron_dataset_builder.py:39)."""
+
+    def __init__(self, datasets: Sequence[GPTDataset],
+                 weights: Optional[Sequence[float]] = None, seed: int = 1234):
+        if not datasets:
+            raise ValueError("empty dataset blend")
+        self.datasets = list(datasets)
+        w = np.asarray(weights if weights is not None
+                       else [len(d) for d in self.datasets], np.float64)
+        self.weights = w / w.sum()
+        rng = np.random.RandomState(seed)
+        self._picks = rng.choice(len(self.datasets), size=65536,
+                                 p=self.weights)
+        # prefix counts make access stateless: within-dataset index of pick
+        # table position i is how many earlier picks chose the same dataset
+        onehot = self._picks[:, None] == np.arange(len(self.datasets))[None]
+        cum = np.cumsum(onehot, axis=0)
+        self._within = cum[np.arange(len(self._picks)), self._picks] - 1
+        self._per_cycle = cum[-1]
+
+    def __len__(self) -> int:
+        return sum(len(d) for d in self.datasets)
+
+    def __getitem__(self, i: int) -> np.ndarray:
+        """Deterministic: the same i always yields the same sample."""
+        cycle, pos = divmod(i, len(self._picks))
+        d = int(self._picks[pos])
+        idx = cycle * int(self._per_cycle[d]) + int(self._within[pos])
+        return self.datasets[d][idx]
+
+
+def indexed_batches(prefix_or_paths, seq_length: int, global_batch_size: int,
+                    *, seed: int = 1234,
+                    weights: Optional[Sequence[float]] = None
+                    ) -> Iterator[Dict[str, np.ndarray]]:
+    """Batch iterator over (blended) indexed corpora matching the synthetic
+    iterator's contract (dataloader.get_data_iterator)."""
+    from hetu_galvatron_tpu.runtime.dataloader import make_batch
+
+    paths = ([prefix_or_paths] if isinstance(prefix_or_paths, str)
+             else list(prefix_or_paths))
+    ds_list = [GPTDataset(IndexedDataset(p), seq_length, seed=seed)
+               for p in paths]
+    ds = (ds_list[0] if len(ds_list) == 1
+          else BlendedDataset(ds_list, weights=weights, seed=seed))
+    if len(ds) == 0:
+        raise ValueError("indexed corpus smaller than one sample")
+    i = 0
+    while True:
+        rows = [ds[i * global_batch_size + j]
+                for j in range(global_batch_size)]
+        yield make_batch(np.stack(rows))
+        i += 1

@@ -177,6 +177,7 @@ def apply_attention(
     rope: Optional[Tuple[jax.Array, jax.Array]] = None,
     sdpa_fn: Callable[..., jax.Array] = xla_sdpa,
     compute_dtype=jnp.bfloat16,
+    causal: bool = True,
 ) -> jax.Array:
     B, S, H = x.shape
     hd = cfg.head_dim
@@ -195,7 +196,7 @@ def apply_attention(
         cos, sin = rope
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
-    out = sdpa_fn(q, k, v, causal=True)
+    out = sdpa_fn(q, k, v, causal=causal)
     out = out.reshape(B, S, nq * hd)
     y = jnp.einsum("bsf,fh->bsh", out, p["wo"].astype(compute_dtype),
                    preferred_element_type=jnp.float32)
@@ -290,10 +291,12 @@ def apply_decoder_layer(
     compute_dtype=jnp.bfloat16,
 ) -> jax.Array:
     """Pre-norm residual block (reference GalvatronDecoderLayer,
-    modules.py:233)."""
+    modules.py:233). Encoder families (bert) run the same block with
+    bidirectional attention."""
+    causal = cfg.model_type != "bert"
     h = apply_norm(p["ln1"], x, cfg)
     x = x + apply_attention(p["attn"], h, cfg, rope=rope, sdpa_fn=sdpa_fn,
-                            compute_dtype=compute_dtype)
+                            compute_dtype=compute_dtype, causal=causal)
     h = apply_norm(p["ln2"], x, cfg)
     x = x + apply_mlp(p["mlp"], h, cfg, compute_dtype=compute_dtype)
     return x

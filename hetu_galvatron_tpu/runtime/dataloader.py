@@ -6,8 +6,10 @@ and the random profiling dataset): deterministic synthetic token streams for
 profiling/benchmarks and a batch iterator that yields numpy arrays ready for
 ``jax.device_put`` with a dp-sharded layout.
 
-The mmap indexed Megatron dataset (+C++ index builder) is a later component
-(SURVEY C13); this module defines the iterator contract it will plug into.
+The mmap indexed dataset (+C++ index builder) lives in
+``data/indexed_dataset.py`` and plugs into :func:`get_data_iterator` via
+``data.dataset=indexed``; BERT-family models get masked-LM batches instead of
+the causal shift.
 
 TPU note: the reference broadcasts batches within TP groups and zigzag-slices
 for CP on each rank (utils.py:194-295). Under GSPMD there is one logical batch:
@@ -56,6 +58,38 @@ def make_batch(samples: np.ndarray) -> Dict[str, np.ndarray]:
     }
 
 
+def make_mlm_batch(
+    samples: np.ndarray,
+    vocab_size: int,
+    rng: np.random.RandomState,
+    *,
+    mask_prob: float = 0.15,
+    mask_token: Optional[int] = None,
+) -> Dict[str, np.ndarray]:
+    """[B, S] tokens -> BERT-style masked-LM batch: 15% of positions are
+    selected (80% -> [MASK], 10% -> random token, 10% -> unchanged); labels
+    are the originals and loss_mask covers only the selected positions.
+
+    ``rng`` must advance between calls (the caller owns it) so each batch
+    masks different positions. ``mask_token`` defaults to the top id of the
+    (padded) vocab — real tokenizers should pass their [MASK] id; the padded
+    rows the vocab-size rounding adds are a safe default home for it."""
+    tokens = samples.astype(np.int32).copy()
+    labels = samples.astype(np.int32)
+    mask_token = vocab_size - 1 if mask_token is None else mask_token
+    selected = rng.rand(*tokens.shape) < mask_prob
+    action = rng.rand(*tokens.shape)
+    tokens[selected & (action < 0.8)] = mask_token
+    random_ids = rng.randint(0, vocab_size, tokens.shape)
+    swap = selected & (action >= 0.8) & (action < 0.9)
+    tokens[swap] = random_ids[swap]
+    return {
+        "tokens": tokens,
+        "labels": labels,
+        "loss_mask": selected.astype(np.float32),
+    }
+
+
 def synthetic_batches(
     model: ModelArgs,
     global_batch_size: int,
@@ -82,6 +116,25 @@ def get_data_iterator(
     gbs = global_batch_size or args.parallel.global_train_batch_size
     data: DataArgs = args.data
     if data.dataset == "random":
-        return synthetic_batches(args.model, gbs, seed=args.train.seed)
-    raise NotImplementedError(
-        "indexed datasets land with the C++ index builder (SURVEY C13)")
+        it = synthetic_batches(args.model, gbs, seed=args.train.seed)
+    elif data.dataset == "indexed":
+        from hetu_galvatron_tpu.data.indexed_dataset import indexed_batches
+
+        if not data.data_path:
+            raise ValueError("data.dataset=indexed requires data.data_path")
+        it = indexed_batches(data.data_path, args.model.seq_length, gbs,
+                             seed=args.train.seed)
+    else:
+        raise ValueError(f"unknown dataset kind {data.dataset}")
+    if args.model.model_type == "bert":
+        # encoders train on the MLM objective, never the causal shift
+        # (bidirectional attention would leak shifted labels)
+        return mlm_batches(it, args.model, seed=args.train.seed)
+    return it
+
+
+def mlm_batches(it: Iterator[Dict[str, np.ndarray]], model: ModelArgs,
+                seed: int) -> Iterator[Dict[str, np.ndarray]]:
+    rng = np.random.RandomState(seed + 1)
+    for batch in it:
+        yield make_mlm_batch(batch["tokens"], model.padded_vocab_size, rng)

@@ -72,6 +72,7 @@ def set_seed(seed: int) -> None:
 
 def _make_logger(args: CoreArgs) -> logging.Logger:
     logger = logging.getLogger("hetu_galvatron_tpu")
+    logger.propagate = False  # avoid double lines via the root logger
     if not logger.handlers:
         h = logging.StreamHandler()
         h.setFormatter(logging.Formatter("[%(levelname)s] %(message)s"))

@@ -1,0 +1,45 @@
+"""Lazy build + ctypes binding for the C++ helpers in csrc/.
+
+Shared by the search-engine DP core and the dataset index builder (the
+reference compiles its dataset helpers lazily at startup the same way,
+runtime/initialize.py:163-187). Builds go through the Makefile so $CXX and
+flags are honored; a missing toolchain degrades to the caller's fallback.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Callable, Dict, Optional
+
+_CSRC = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "..", "..", "csrc")
+_CACHE: Dict[str, Optional[ctypes.CDLL]] = {}
+
+
+def load_native(
+    lib_name: str,
+    source_name: str,
+    configure: Callable[[ctypes.CDLL], None],
+) -> Optional[ctypes.CDLL]:
+    """Build csrc/<lib_name> from <source_name> via make if stale, load it,
+    run `configure` (restype/argtypes setup) once, and cache. Returns None
+    when the toolchain is unavailable."""
+    if lib_name in _CACHE:
+        return _CACHE[lib_name]
+    so = os.path.join(_CSRC, lib_name)
+    src = os.path.join(_CSRC, source_name)
+    try:
+        if (not os.path.exists(so)
+                or os.path.getmtime(so) < os.path.getmtime(src)):
+            subprocess.run(["make", "-C", _CSRC, lib_name], check=True,
+                           capture_output=True)
+        lib = ctypes.CDLL(so)
+        configure(lib)
+    except (subprocess.CalledProcessError, OSError) as e:
+        print(f"native helper {lib_name}: build unavailable ({e}); "
+              "using python fallback")
+        lib = None
+    _CACHE[lib_name] = lib
+    return lib

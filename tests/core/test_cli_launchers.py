@@ -94,3 +94,33 @@ def test_profiler_cli_computation(tmp_path, capsys):
     assert any(f.startswith("computation_profiling") for f in files)
     cfg = json.load(open(os.path.join(tmp_path, files[0])))
     assert any(k.startswith("layertype_0_") for k in cfg)
+
+
+def test_train_dist_cli_checkpoint_resume(tmp_path, capsys):
+    """Save at an interval, then resume from the checkpoint directory."""
+    from hetu_galvatron_tpu.cli.train_dist import main
+
+    common = [os.path.join(ZOO, "gpt2-small.yaml")] + TINY_OVERRIDES + [
+        "train.train_iters=4", f"ckpt.save={tmp_path}",
+        "ckpt.save_interval=2"]
+    assert main(common) == 0
+    assert any(d.startswith("step_") for d in os.listdir(tmp_path))
+    rc = main(common + [f"ckpt.load={tmp_path}", "train.train_iters=6"])
+    assert rc == 0
+    # resumed run trains only iters 4..5 (2 iters), not all 6
+    out = capsys.readouterr().out
+    assert "training done: 2 iters" in out
+
+
+def test_train_dist_cli_indexed_data(tmp_path):
+    import numpy as np
+    from hetu_galvatron_tpu.cli.train_dist import main
+    from hetu_galvatron_tpu.data.indexed_dataset import write_indexed_dataset
+
+    rng = np.random.RandomState(0)
+    prefix = str(tmp_path / "corpus")
+    write_indexed_dataset(
+        prefix, [rng.randint(0, 64, 50).tolist() for _ in range(40)])
+    rc = main([os.path.join(ZOO, "gpt2-small.yaml")] + TINY_OVERRIDES + [
+        "data.dataset=indexed", f"data.data_path=[{prefix}]"])
+    assert rc == 0
