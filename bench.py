@@ -29,7 +29,33 @@ PEAK_FLOPS = {
 }
 
 
+def _arm_watchdog(seconds: float) -> None:
+    """If TPU init or compile wedges (the axon tunnel can hang indefinitely
+    in make_c_api_client), still emit one JSON line and exit instead of
+    hanging the driver."""
+    import threading
+
+    def fire():
+        print(json.dumps({
+            "metric": "gpt2_125m_train_mfu", "value": 0.0, "unit": "% MFU",
+            "vs_baseline": 0.0,
+            "error": f"bench watchdog fired after {seconds:.0f}s "
+                     "(device init or compile hang)",
+        }), flush=True)
+        os._exit(3)
+
+    t = threading.Timer(seconds, fire)
+    t.daemon = True
+    t.start()
+    global _WATCHDOG
+    _WATCHDOG = t
+
+
+_WATCHDOG = None
+
+
 def main():
+    _arm_watchdog(float(os.environ.get("BENCH_TIMEOUT", 900)))
     if os.environ.get("BENCH_PLATFORM") == "cpu":
         import jax
 
@@ -133,6 +159,8 @@ def main():
         "seq": seq,
         "loss": round(float(metrics["loss"]), 4),
     }
+    if _WATCHDOG is not None:
+        _WATCHDOG.cancel()
     print(json.dumps(out))
 
 

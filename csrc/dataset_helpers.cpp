@@ -10,7 +10,7 @@
 // the hot one-shot loop that is painfully slow in Python for billion-token
 // corpora.
 //
-// Build: make -C csrc dataset  (g++ -O2 -shared -fPIC)
+// Build: make -C csrc libdataset_helpers.so  (g++ -O2 -shared -fPIC)
 
 #include <cstdint>
 

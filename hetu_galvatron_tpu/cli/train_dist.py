@@ -113,13 +113,14 @@ def train(args) -> Dict[str, Any]:
             exit_code = rerun.exit_code_requested()
             if exit_code is not None:
                 state.log(f"rerun machine requested exit (code {exit_code});"
-                          " checkpointing")
-                ck = args.ckpt
-                already_saved = (ck.save and ck.save_interval
-                                 and (it + 1) % ck.save_interval == 0)
-                if ck.save and not already_saved:
+                          " checkpointing pre-fault state")
+                if args.ckpt.save and prev is not None:
+                    # save the PRE-update state at iter `it`: the faulty
+                    # update must not be persisted, and the relaunch re-runs
+                    # the suspect iteration to disambiguate
                     wait_for_checkpoints()  # never race an in-flight save
-                    save_checkpoint(ck.save, it + 1, sp, so, hpc=hpc)
+                    save_checkpoint(args.ckpt.save, it, prev[0], prev[1],
+                                    hpc=hpc)
                 break
         return sp, so
 

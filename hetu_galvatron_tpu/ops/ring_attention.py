@@ -8,12 +8,10 @@ softmax) accumulator folds each block's contribution — memory per chip stays
 O(S/cp) and the ring transfers ride ICI via `lax.ppermute` instead of NCCL
 p2p.
 
-Block-causal masking replaces the reference's zigzag re-layout: block j of
-keys attends to query block r fully when j < r, causally when j == r, and is
-masked when j > r. (Zigzag balances per-rank FLOPs; the masking here is
-correct for the standard contiguous layout and keeps the layout trivial for
-GSPMD boundaries. The compute imbalance is cp-bounded and only matters at
-large cp.)
+Two sequence layouts are supported: contiguous blocks (trivial GSPMD
+boundaries; block-causal masking; per-rank compute imbalance bounded by cp)
+and the reference's zigzag layout (``zigzag=True``: each rank holds global
+half-blocks r and 2cp-1-r, equalizing unmasked work across the ring).
 """
 
 from __future__ import annotations
@@ -35,16 +33,30 @@ def _block_scores(q, k, scale):
                       preferred_element_type=jnp.float32) * scale
 
 
-def _fold_block(step, acc, *, q, k, v, my_idx, cp, s_local, causal):
-    """Fold the key/value block currently held (global block
+def _positions(rank, length, cp, zigzag):
+    """Global sequence positions of a rank's local block. Contiguous layout:
+    [rank*L, rank*L + L). Zigzag layout (reference redistribute.py:5-41):
+    the local block is the concatenation of global half-blocks rank and
+    2cp-1-rank, balancing causal work across the ring."""
+    i = jnp.arange(length)
+    if not zigzag:
+        return rank * length + i
+    h = length // 2
+    return jnp.where(i < h,
+                     rank * h + i,
+                     (2 * cp - 1 - rank) * h + (i - h))
+
+
+def _fold_block(step, acc, *, q, k, v, my_idx, cp, causal, zigzag):
+    """Fold the key/value block currently held (from rank
     (my_idx - step) mod cp) into the streaming softmax accumulator."""
     o, m, l = acc
     B, Sq, K, G, D = q.shape
     src_block = (my_idx - step) % cp
     scores = _block_scores(q, k, 1.0 / math.sqrt(D))  # [B,K,G,Sq,Sk]
     if causal:
-        qpos = my_idx * s_local + jnp.arange(Sq)[:, None]
-        kpos = src_block * s_local + jnp.arange(k.shape[1])[None, :]
+        qpos = _positions(my_idx, Sq, cp, zigzag)[:, None]
+        kpos = _positions(src_block, k.shape[1], cp, zigzag)[None, :]
         scores = jnp.where(qpos >= kpos, scores, NEG_INF)
     block_max = jnp.max(scores, axis=-1)  # [B,K,G,Sq]
     new_m = jnp.maximum(m, block_max)
@@ -59,18 +71,18 @@ def _fold_block(step, acc, *, q, k, v, my_idx, cp, s_local, causal):
     return new_o, new_m, new_l
 
 
-def _ring_body(step, carry, *, q, my_idx, cp, s_local, causal, axis):
+def _ring_body(step, carry, *, q, my_idx, cp, causal, zigzag, axis):
     """One ring step: fold the current block, then rotate k/v onward."""
     o, m, l, k, v = carry
     o, m, l = _fold_block(step, (o, m, l), q=q, k=k, v=v, my_idx=my_idx,
-                          cp=cp, s_local=s_local, causal=causal)
+                          cp=cp, causal=causal, zigzag=zigzag)
     perm = [(i, (i + 1) % cp) for i in range(cp)]
     k = jax.lax.ppermute(k, axis, perm)
     v = jax.lax.ppermute(v, axis, perm)
     return o, m, l, k, v
 
 
-def _ring_attention_local(q, k, v, *, axis, causal):
+def _ring_attention_local(q, k, v, *, axis, causal, zigzag=False):
     """Per-shard kernel under shard_map: q/k/v are the local sequence blocks
     [B, S/cp, N|K, D]."""
     cp = jax.lax.axis_size(axis)
@@ -83,11 +95,11 @@ def _ring_attention_local(q, k, v, *, axis, causal):
     m = jnp.full((B, K, G, Sq), NEG_INF, jnp.float32)
     l = jnp.zeros((B, K, G, Sq), jnp.float32)
     body = partial(_ring_body, q=qg, my_idx=my_idx, cp=cp,
-                   s_local=Sq, causal=causal, axis=axis)
+                   causal=causal, zigzag=zigzag, axis=axis)
     # cp-1 fold+rotate steps, then the final fold without the wasted rotate
     o, m, l, k, v = jax.lax.fori_loop(0, cp - 1, body, (o, m, l, k, v))
     o, m, l = _fold_block(cp - 1, (o, m, l), q=qg, k=k, v=v, my_idx=my_idx,
-                          cp=cp, s_local=Sq, causal=causal)
+                          cp=cp, causal=causal, zigzag=zigzag)
     o = o / jnp.maximum(l, 1e-20)[..., None]
     return o.transpose(0, 3, 1, 2, 4).reshape(B, Sq, N, D).astype(q.dtype)
 
@@ -97,22 +109,37 @@ def make_ring_sdpa(
     cp_axes: Tuple[str, ...],
     dp_axes: Tuple[str, ...] = (),
     tp_axes: Tuple[str, ...] = (),
+    zigzag: bool = False,
 ):
     """sdpa_fn for modules.apply_attention: reshards q/k/v so the sequence
     lives on the cp axes, runs the ring kernel under shard_map, and hands the
     seq-sharded output back to GSPMD (the reference reaches its ring kernel
-    through the per-layer dispatch at attention.py:664-720)."""
+    through the per-layer dispatch at attention.py:664-720).
+
+    ``zigzag=True`` re-lays the sequence into the reference's balanced
+    causal order around the kernel (RoPE is applied upstream, so permuting
+    post-RoPE q/k/v is position-safe). Balancing costs one all-to-all-ish
+    reshard at entry/exit; pushing the zigzag layout out to the dataloader
+    (get_batch zigzag slice, reference utils.py:295) removes that cost and
+    is the long-sequence deployment mode."""
     if not cp_axes:
         raise ValueError("ring attention needs at least one cp axis")
     axis = cp_axes if len(cp_axes) > 1 else cp_axes[0]
     spec = P(dp_axes or None, cp_axes, tp_axes or None, None)
+    cp = 1
+    for a in cp_axes:
+        cp *= mesh.shape[a]
 
     def sdpa(q, k, v, *, causal=True):
         fn = jax.shard_map(
-            partial(_ring_attention_local, axis=axis, causal=causal),
+            partial(_ring_attention_local, axis=axis, causal=causal,
+                    zigzag=zigzag),
             mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
             check_vma=False)
-        return fn(q, k, v)
+        if zigzag:
+            q, k, v = (zigzag_layout(t, cp) for t in (q, k, v))
+        out = fn(q, k, v)
+        return zigzag_unlayout(out, cp) if zigzag else out
 
     return sdpa
 

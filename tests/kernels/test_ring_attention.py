@@ -89,3 +89,39 @@ def test_ring_gradients_match(cpu_devices):
     for a, b in zip(g_ref, g_ring):
         np.testing.assert_allclose(np.asarray(b), np.asarray(a),
                                    rtol=5e-5, atol=5e-5)
+
+
+@pytest.mark.parametrize("cp", [2, 4])
+def test_zigzag_ring_matches_dense(cp, cpu_devices):
+    """Zigzag-balanced ring == dense attention (the reference's
+    ZigzagRingFlashAttention layout, attention_impl.py:481-905)."""
+    import math
+
+    n_axes = int(math.log2(cp))
+    mesh = Mesh(np.array(cpu_devices[:cp]).reshape((2,) * n_axes),
+                tuple(f"d{i}" for i in range(n_axes)))
+    q, k, v = _qkv(S=32)
+    ref = xla_sdpa(q, k, v, causal=True)
+    ring = make_ring_sdpa(mesh, tuple(f"d{i}" for i in range(n_axes)),
+                          zigzag=True)
+    out = jax.jit(lambda a, b, c: ring(a, b, c, causal=True))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_zigzag_balances_unmasked_work():
+    """Zigzag positions give every rank the same number of unmasked
+    (q, k) pairs, unlike the contiguous layout."""
+    from hetu_galvatron_tpu.ops.ring_attention import _positions
+    import jax.numpy as jnp
+
+    cp, L = 4, 8  # local length 8 => half-blocks of 4
+    total = []
+    for r in range(cp):
+        qpos = np.asarray(_positions(r, L, cp, True))[:, None]
+        work = 0
+        for src in range(cp):
+            kpos = np.asarray(_positions(src, L, cp, True))[None, :]
+            work += int((qpos >= kpos).sum())
+        total.append(work)
+    assert len(set(total)) == 1, f"unbalanced: {total}"
