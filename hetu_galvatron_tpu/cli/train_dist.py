@@ -109,8 +109,12 @@ def train(args) -> Dict[str, Any]:
                 data_iterator=data_iter)
             data_iter.advance()
             losses.append(float(metrics["loss"]))
-            maybe_save(it, sp, so)
+            # check for a fault BEFORE the interval save: the faulty update
+            # must never be persisted (a step_{it+1} checkpoint would shadow
+            # the pre-fault step_{it} one on resume)
             exit_code = rerun.exit_code_requested()
+            if exit_code is None:
+                maybe_save(it, sp, so)
             if exit_code is not None:
                 state.log(f"rerun machine requested exit (code {exit_code});"
                           " checkpointing pre-fault state")

@@ -10,8 +10,8 @@ sequence length is bounded by HBM, not VMEM.
 
 Layout: q [B, N, S, D], k/v [B, K, S, D] (heads-major so a grid cell's tiles
 are contiguous); GQA maps q-head n to kv-head n // (N // K) in the index map.
-Backward runs through the dense reference core (remat); a fused backward
-kernel is a later optimization.
+The backward is fused too (dq and dk/dv kernels recompute p per tile from the
+saved logsumexp), so neither direction materializes [S, S].
 """
 
 from __future__ import annotations
@@ -27,8 +27,8 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = float(jnp.finfo(jnp.float32).min)
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
-                  block_q: int, block_k: int, num_k: int, causal: bool,
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
+                  *, block_q: int, block_k: int, num_k: int, causal: bool,
                   scale: float):
     qi = pl.program_id(2)
     ki = pl.program_id(3)
@@ -69,8 +69,10 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
 
     @pl.when(ki == num_k - 1)
     def _finalize():
-        out = acc_ref[...] / jnp.maximum(l_ref[...], 1e-20)[:, None]
-        o_ref[0, 0] = out.astype(o_ref.dtype)
+        l = jnp.maximum(l_ref[...], 1e-20)
+        o_ref[0, 0] = (acc_ref[...] / l[:, None]).astype(o_ref.dtype)
+        # logsumexp per row, consumed by the backward kernels
+        lse_ref[0, 0] = m_ref[...] + jnp.log(l)
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
@@ -108,9 +110,16 @@ def flash_attention_hmajor(
             pl.BlockSpec((1, 1, block_k, D),
                          lambda b, n, qi, ki: (b, n // G, ki, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 1, block_q, D),
-                               lambda b, n, qi, ki: (b, n, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((B, N, S, D), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, D),
+                         lambda b, n, qi, ki: (b, n, qi, 0)),
+            pl.BlockSpec((1, 1, block_q),
+                         lambda b, n, qi, ki: (b, n, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, N, S, D), q.dtype),
+            jax.ShapeDtypeStruct((B, N, S), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q,), jnp.float32),
             pltpu.VMEM((block_q,), jnp.float32),
@@ -120,31 +129,210 @@ def flash_attention_hmajor(
     )(q, k, v)
 
 
+def _flash_bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                           dk_ref, dv_ref, dk_acc, dv_acc, *,
+                           block_q: int, block_k: int, num_q: int, G: int,
+                           causal: bool, scale: float):
+    """Grid (B, KV, kb, G, qb): accumulate dk/dv for one k/v tile across the
+    G query heads of this kv head and all q blocks."""
+    kb = pl.program_id(2)
+    g = pl.program_id(3)
+    qb = pl.program_id(4)
+
+    @pl.when((g == 0) & (qb == 0))
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    # q blocks entirely above the causal diagonal contribute nothing
+    first_q = (kb * block_k) // block_q if causal else 0
+
+    @pl.when(qb >= first_q)
+    def _update():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0]
+        delta = delta_ref[0, 0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            qpos = qb * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        p = jnp.where(s == NEG_INF, 0.0, p)
+        dv_acc[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        dk_acc[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when((g == G - 1) & (qb == num_q - 1))
+    def _finalize():
+        dk_ref[0, 0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, dq_acc, *, block_q: int, block_k: int,
+                         num_k: int, causal: bool, scale: float):
+    """Grid (B, N, qb, kb): accumulate dq for one q tile across k blocks."""
+    qb = pl.program_id(2)
+    kb = pl.program_id(3)
+
+    @pl.when(kb == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    diag_last = (qb * block_q + block_q - 1) // block_k if causal else num_k
+
+    @pl.when(kb <= diag_last)
+    def _update():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0]
+        delta = delta_ref[0, 0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            qpos = qb * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        p = jnp.where(s == NEG_INF, 0.0, p)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        dq_acc[...] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(kb == num_k - 1)
+    def _finalize():
+        dq_ref[0, 0] = dq_acc[...].astype(dq_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
+                                             "interpret"))
+def flash_attention_bwd_hmajor(
+    q, k, v, o, lse, do, *,
+    causal: bool = True,
+    block_q: int = 256,
+    block_k: int = 256,
+    interpret: bool = False,
+):
+    """Fused flash backward (heads-major layouts): recomputes p from lse per
+    tile, so nothing O(S^2) ever hits HBM. Returns (dq, dk, dv)."""
+    B, N, S, D = q.shape
+    KV = k.shape[1]
+    G = N // KV
+    block_q = min(block_q, S)
+    block_k = min(block_k, S)
+    num_q = S // block_q
+    num_k = S // block_k
+    scale = 1.0 / math.sqrt(D)
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+
+    dkdv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkdv_kernel, block_q=block_q,
+                          block_k=block_k, num_q=num_q, G=G, causal=causal,
+                          scale=scale),
+        grid=(B, KV, num_k, G, num_q),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, D),
+                         lambda b, kh, kb, g, qb: (b, kh * G + g, qb, 0)),
+            pl.BlockSpec((1, 1, block_k, D),
+                         lambda b, kh, kb, g, qb: (b, kh, kb, 0)),
+            pl.BlockSpec((1, 1, block_k, D),
+                         lambda b, kh, kb, g, qb: (b, kh, kb, 0)),
+            pl.BlockSpec((1, 1, block_q, D),
+                         lambda b, kh, kb, g, qb: (b, kh * G + g, qb, 0)),
+            pl.BlockSpec((1, 1, block_q),
+                         lambda b, kh, kb, g, qb: (b, kh * G + g, qb)),
+            pl.BlockSpec((1, 1, block_q),
+                         lambda b, kh, kb, g, qb: (b, kh * G + g, qb)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_k, D),
+                         lambda b, kh, kb, g, qb: (b, kh, kb, 0)),
+            pl.BlockSpec((1, 1, block_k, D),
+                         lambda b, kh, kb, g, qb: (b, kh, kb, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, KV, S, D), k.dtype),
+            jax.ShapeDtypeStruct((B, KV, S, D), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, D), jnp.float32),
+            pltpu.VMEM((block_k, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, block_q=block_q,
+                          block_k=block_k, num_k=num_k, causal=causal,
+                          scale=scale),
+        grid=(B, N, num_q, num_k),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, D),
+                         lambda b, n, qb, kb: (b, n, qb, 0)),
+            pl.BlockSpec((1, 1, block_k, D),
+                         lambda b, n, qb, kb: (b, n // G, kb, 0)),
+            pl.BlockSpec((1, 1, block_k, D),
+                         lambda b, n, qb, kb: (b, n // G, kb, 0)),
+            pl.BlockSpec((1, 1, block_q, D),
+                         lambda b, n, qb, kb: (b, n, qb, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, n, qb, kb: (b, n, qb)),
+            pl.BlockSpec((1, 1, block_q), lambda b, n, qb, kb: (b, n, qb)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, D),
+                               lambda b, n, qb, kb: (b, n, qb, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, N, S, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dkdv[0], dkdv[1]
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def _flash_with_vjp(q, k, v, causal, interpret):
     qh = q.transpose(0, 2, 1, 3)
     kh = k.transpose(0, 2, 1, 3)
     vh = v.transpose(0, 2, 1, 3)
-    out = flash_attention_hmajor(qh, kh, vh, causal=causal,
-                                 interpret=interpret)
+    out, _ = flash_attention_hmajor(qh, kh, vh, causal=causal,
+                                    interpret=interpret)
     return out.transpose(0, 2, 1, 3)
 
 
 def _flash_fwd(q, k, v, causal, interpret):
-    return _flash_with_vjp(q, k, v, causal, interpret), (q, k, v)
+    qh = q.transpose(0, 2, 1, 3)
+    kh = k.transpose(0, 2, 1, 3)
+    vh = v.transpose(0, 2, 1, 3)
+    out, lse = flash_attention_hmajor(qh, kh, vh, causal=causal,
+                                      interpret=interpret)
+    return out.transpose(0, 2, 1, 3), (qh, kh, vh, out, lse)
 
 
 def _flash_bwd(causal, interpret, res, g):
-    # Backward recomputes through the dense reference core (the standard
-    # remat trade: forward stays O(block) in VMEM via the Pallas kernel, the
-    # backward matches XLA's own attention gradient). A fused flash backward
-    # kernel is a later optimization.
-    from hetu_galvatron_tpu.models.modules import xla_sdpa
-
-    q, k, v = res
-    _, vjp = jax.vjp(lambda a, b, c: xla_sdpa(a, b, c, causal=causal),
-                     q, k, v)
-    return vjp(g)
+    qh, kh, vh, out, lse = res
+    dq, dk, dv = flash_attention_bwd_hmajor(
+        qh, kh, vh, out, lse, g.transpose(0, 2, 1, 3),
+        causal=causal, interpret=interpret)
+    return (dq.transpose(0, 2, 1, 3), dk.transpose(0, 2, 1, 3),
+            dv.transpose(0, 2, 1, 3))
 
 
 _flash_with_vjp.defvjp(_flash_fwd, _flash_bwd)
@@ -152,6 +340,7 @@ _flash_with_vjp.defvjp(_flash_fwd, _flash_bwd)
 
 def flash_sdpa(q, k, v, *, causal: bool = True, interpret: bool = False):
     """Drop-in sdpa_fn for modules.apply_attention: [B, S, N, D] layout in
-    and out; differentiable (forward via the Pallas kernel, backward via the
-    dense-core recompute)."""
+    and out; fully differentiable — forward and backward both run as fused
+    Pallas kernels (backward recomputes p per tile from the saved
+    logsumexp), so neither direction materializes [S, S]."""
     return _flash_with_vjp(q, k, v, causal, interpret)

@@ -131,6 +131,13 @@ def make_ring_sdpa(
         cp *= mesh.shape[a]
 
     def sdpa(q, k, v, *, causal=True):
+        S = q.shape[1]
+        if S % cp:
+            raise ValueError(f"sequence {S} not divisible by cp {cp}")
+        if zigzag and S % (2 * cp):
+            raise ValueError(
+                f"zigzag layout needs sequence {S} divisible by 2*cp "
+                f"= {2 * cp} (two half-blocks per rank)")
         fn = jax.shard_map(
             partial(_ring_attention_local, axis=axis, causal=causal,
                     zigzag=zigzag),

@@ -1,0 +1,61 @@
+"""The whole product, end to end: profiled JSONs -> search engine -> searched
+galvatron_config JSON -> training runtime executes the heterogeneous plan.
+This is the reference's headline workflow (README "System Architecture":
+Profiler -> Search Engine -> Runtime)."""
+
+import glob
+import json
+import os
+
+import pytest
+
+pytestmark = [pytest.mark.distributed, pytest.mark.slow]
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "..", "fixtures")
+ZOO = os.path.join(os.path.dirname(__file__), "..", "..",
+                   "hetu_galvatron_tpu", "models", "configs")
+
+
+def test_search_then_train_the_searched_plan(tmp_path, capsys):
+    from hetu_galvatron_tpu.cli.search_dist import main as search_main
+    from hetu_galvatron_tpu.cli.train_dist import main as train_main
+
+    # 1) search (profiled fixtures, 8 devices, 36 GB) -> plan JSON
+    rc = search_main([
+        os.path.join(ZOO, "llama2-7b.yaml"),
+        "model.num_hidden_layers=28", "model.seq_length=8192",
+        "model.max_position_embeddings=8192",
+        "search.settle_bsz=64", "search.settle_chunks=32",
+        "search.memory_constraint=36", "search.default_dp_type=zero2",
+        "search.pipeline_type=pipedream_flush",
+        "search.async_grad_reduce=false",
+        "search.time_profile_mode=sequence",
+        "search.memory_profile_mode=sequence",
+        f"search.time_profiling_path={FIXTURES}/computation_profiling_bf16_llama2-7b_all.json",
+        f"search.memory_profiling_path={FIXTURES}/memory_profiling_bf16_llama2-7b_all.json",
+        f"search.allreduce_bandwidth_config_path={FIXTURES}/allreduce_bandwidth_1nodes_8gpus_per_node.json",
+        f"search.p2p_bandwidth_config_path={FIXTURES}/p2p_bandwidth_1nodes_8gpus_per_node.json",
+        f"search.overlap_coe_path={FIXTURES}/overlap_coefficient.json",
+        f"search.sp_time_path={FIXTURES}/sp_time_1nodes_8gpus_per_node.json",
+        f"search.output_config_path={tmp_path}",
+    ])
+    assert rc == 0
+    plan = glob.glob(os.path.join(str(tmp_path), "galvatron_config_*.json"))[0]
+    cfg = json.load(open(plan))
+    # the searched plan is heterogeneous: remat on some layers, not others
+    assert "1" in cfg["checkpoint"] and "0" in cfg["checkpoint"]
+
+    # 2) train a 28-layer (tiny-dim) model under the searched plan
+    rc = train_main([
+        os.path.join(ZOO, "llama2-7b.yaml"),
+        "model.hidden_size=32", "model.num_hidden_layers=28",
+        "model.num_attention_heads=4", "model.num_key_value_heads=4",
+        "model.ffn_hidden_size=64", "model.vocab_size=64",
+        "model.seq_length=8", "model.max_position_embeddings=16",
+        "model.make_vocab_size_divisible_by=1",
+        "parallel.mixed_precision=fp32", "train.train_iters=1",
+        "parallel.config_mode=json",
+        f"parallel.galvatron_config_path={plan}",
+    ])
+    assert rc == 0
+    assert "training done: 1 iters" in capsys.readouterr().out
