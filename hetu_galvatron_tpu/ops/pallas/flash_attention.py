@@ -344,3 +344,32 @@ def flash_sdpa(q, k, v, *, causal: bool = True, interpret: bool = False):
     Pallas kernels (backward recomputes p per tile from the saved
     logsumexp), so neither direction materializes [S, S]."""
     return _flash_with_vjp(q, k, v, causal, interpret)
+
+
+def make_flash_sdpa(mesh, dp_axes=(), tp_axes=(), *, interpret: bool = False):
+    """Distributed flash attention: the kernel is a custom call XLA cannot
+    auto-partition, so it runs under shard_map — batch sharded over dp,
+    heads over tp, sequence local (attention needs the full sequence; cp
+    layers use ring attention instead). Grad flows through the fused VJP
+    inside the shard_map."""
+    from jax.sharding import PartitionSpec as P
+
+    import jax
+
+    spec = P(dp_axes or None, None, tp_axes or None, None)
+
+    def sdpa(q, k, v, *, causal=True):
+        S = q.shape[1]
+        bq = min(256, S)
+        if S % bq:  # shapes the kernel can't tile: use the XLA core
+            from hetu_galvatron_tpu.models.modules import xla_sdpa
+
+            return xla_sdpa(q, k, v, causal=causal)
+        # nondiff args of a custom_vjp must stay positional
+        fn = jax.shard_map(
+            lambda a, b, c: _flash_with_vjp(a, b, c, causal, interpret),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False)
+        return fn(q, k, v)
+
+    return sdpa

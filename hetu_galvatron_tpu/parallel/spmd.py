@@ -103,18 +103,31 @@ def opt_state_specs(
 def attention_overrides(
     per_layer: List[LayerSharding],
     mesh: Mesh,
+    *,
+    use_flash: Optional[bool] = None,
 ) -> Dict[int, Dict[str, Any]]:
     """Per-layer attention-impl dispatch (reference attention.py:664-720):
-    layers with cp > 1 swap in the ring-attention kernel over their cp axes;
-    TP/Ulysses layers keep the XLA core (GSPMD already inserts the
-    collectives)."""
+    cp > 1 layers swap in the ring-attention kernel over their cp axes;
+    other layers get the Pallas flash kernel on TPU (``use_flash`` defaults
+    to platform == tpu); everything else keeps the XLA core (GSPMD inserts
+    the collectives)."""
     from hetu_galvatron_tpu.ops.ring_attention import make_ring_sdpa
 
+    if use_flash is None:
+        use_flash = all(d.platform == "tpu"
+                        for d in mesh.devices.flat[:1])
     out: Dict[int, Dict[str, Any]] = {}
     for i, sh in enumerate(per_layer):
         if sh.cp_axes:
             out[i] = {"sdpa_fn": make_ring_sdpa(
                 mesh, sh.cp_axes, dp_axes=sh.dp_axes, tp_axes=sh.tp_axes)}
+        elif use_flash:
+            from hetu_galvatron_tpu.ops.pallas.flash_attention import (
+                make_flash_sdpa,
+            )
+
+            out[i] = {"sdpa_fn": make_flash_sdpa(
+                mesh, dp_axes=sh.dp_axes, tp_axes=sh.tp_axes)}
     return out
 
 
@@ -177,7 +190,9 @@ def make_spmd_train_step(
     opt_pspecs = param_specs(axes_tree, per_layer, vocab, opt=True)
     opt_specs = opt_state_specs(tx, params, opt_pspecs)
     boundary = make_boundary_fn(per_layer, vocab, mesh)
-    ring = attention_overrides(per_layer, mesh)
+    ring = attention_overrides(
+        per_layer, mesh,
+        use_flash=None if cfg.use_flash_attn else False)
     if ring:
         # per-key merge: a caller override on a cp layer must not drop the
         # ring sdpa_fn unless it sets sdpa_fn itself

@@ -236,7 +236,9 @@ class PipelineEngine:
             rope = M.rope_cos_sin(x.shape[1], cfg.head_dim, cfg.rope_theta)
         from hetu_galvatron_tpu.parallel.spmd import attention_overrides
 
-        overrides = attention_overrides(st.shardings, st.mesh)
+        overrides = attention_overrides(
+            st.shardings, st.mesh,
+            use_flash=None if cfg.use_flash_attn else False)
         aux_total = jnp.zeros((), jnp.float32)
         for j, lp in enumerate(sp["layers"]):
             sh = st.shardings[j]

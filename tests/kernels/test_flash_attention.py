@@ -104,3 +104,26 @@ def test_flash_gradients_noncausal():
     for a, b in zip(g_ref, g_flash):
         np.testing.assert_allclose(np.asarray(b), np.asarray(a),
                                    rtol=5e-5, atol=5e-5)
+
+
+def test_distributed_flash_matches_dense(cpu_devices):
+    """shard_map-wrapped flash (batch over dp, heads over tp) == dense, with
+    gradients, on a dp2 x tp2 mesh (interpret mode)."""
+    from jax.sharding import Mesh
+    from hetu_galvatron_tpu.ops.pallas.flash_attention import make_flash_sdpa
+
+    mesh = Mesh(np.array(cpu_devices[:4]).reshape(2, 2), ("dp", "tp"))
+    q, k, v = _qkv(B=2, S=64, N=4, K=4)
+    flash = make_flash_sdpa(mesh, dp_axes=("dp",), tp_axes=("tp",),
+                            interpret=True)
+    ref = xla_sdpa(q, k, v, causal=True)
+    out = jax.jit(lambda a, b, c: flash(a, b, c, causal=True))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+    g_ref = jax.grad(lambda a, b, c: jnp.sum(
+        xla_sdpa(a, b, c, causal=True) ** 2), argnums=(0, 1, 2))(q, k, v)
+    g_out = jax.jit(jax.grad(lambda a, b, c: jnp.sum(
+        flash(a, b, c, causal=True) ** 2), argnums=(0, 1, 2)))(q, k, v)
+    for a, b in zip(g_ref, g_out):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=5e-5, atol=5e-5)
