@@ -18,7 +18,7 @@ runs on the virtual CPU mesh in CI.
 from __future__ import annotations
 
 import time
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Dict, Optional, Sequence
 
 import numpy as np
 
@@ -31,7 +31,6 @@ from hetu_galvatron_tpu.core.profiler.runtime_profiler import (
 )
 from hetu_galvatron_tpu.core.search_engine.profiles import write_json
 from hetu_galvatron_tpu.models.builder import (
-    causal_lm_loss,
     forward_causal_lm,
     init_causal_lm,
     param_count,

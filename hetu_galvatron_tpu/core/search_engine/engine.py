@@ -20,7 +20,7 @@ from __future__ import annotations
 import math
 import os
 from dataclasses import dataclass
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
 
@@ -51,7 +51,6 @@ from hetu_galvatron_tpu.core.search_engine.strategies import (
 from hetu_galvatron_tpu.utils.strategy import (
     DPType,
     EmbeddingLMHeadStrategy,
-    LayerStrategy,
     strategy_list2config,
 )
 

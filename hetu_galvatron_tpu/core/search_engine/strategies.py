@@ -14,8 +14,8 @@ order is part of golden-value parity with the reference search test.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field, replace
-from typing import List, Optional, Tuple
+from dataclasses import dataclass, replace
+from typing import List, Tuple
 
 from hetu_galvatron_tpu.utils.strategy import DPType, LayerStrategy
 

@@ -18,7 +18,6 @@ provided for corpus preparation.
 from __future__ import annotations
 
 import ctypes
-import os
 import struct
 from typing import Dict, Iterable, Iterator, List, Optional, Sequence
 

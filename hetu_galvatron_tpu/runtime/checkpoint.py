@@ -182,15 +182,41 @@ def hf_to_params(state_dict: Dict[str, Any], cfg: ModelArgs) -> Params:
             [lin(pre + "self_attn.q_proj.weight"),
              lin(pre + "self_attn.k_proj.weight"),
              lin(pre + "self_attn.v_proj.weight")], axis=1)
-        win = np.concatenate(
-            [lin(pre + "mlp.gate_proj.weight"),
-             lin(pre + "mlp.up_proj.weight")], axis=1)
         lp = {
             "ln1": {"scale": sd[pre + "input_layernorm.weight"]},
             "attn": {"wqkv": wqkv, "wo": lin(pre + "self_attn.o_proj.weight")},
             "ln2": {"scale": sd[pre + "post_attention_layernorm.weight"]},
-            "mlp": {"win": win, "wout": lin(pre + "mlp.down_proj.weight")},
         }
+        if pre + "block_sparse_moe.gate.weight" in sd:
+            # mixtral-style MoE FFN (reference moe_adapter.py:58-266):
+            # experts.{e}.w1/w3 fuse into win [E, H, 2F], w2 -> wout [E, F, H]
+            E = 0
+            while pre + f"block_sparse_moe.experts.{E}.w1.weight" in sd:
+                E += 1
+            if E != cfg.num_experts:
+                raise ValueError(
+                    f"layer {i}: checkpoint has {E} experts but "
+                    f"cfg.num_experts is {cfg.num_experts}")
+            win = np.stack([
+                np.concatenate(
+                    [lin(pre + f"block_sparse_moe.experts.{e}.w1.weight"),
+                     lin(pre + f"block_sparse_moe.experts.{e}.w3.weight")],
+                    axis=1)
+                for e in range(E)])
+            wout = np.stack([
+                lin(pre + f"block_sparse_moe.experts.{e}.w2.weight")
+                for e in range(E)])
+            lp["moe"] = {
+                "router": lin(pre + "block_sparse_moe.gate.weight"),
+                "win": win,
+                "wout": wout,
+            }
+        else:
+            win = np.concatenate(
+                [lin(pre + "mlp.gate_proj.weight"),
+                 lin(pre + "mlp.up_proj.weight")], axis=1)
+            lp["mlp"] = {"win": win,
+                         "wout": lin(pre + "mlp.down_proj.weight")}
         if cfg.add_qkv_bias:
             lp["attn"]["bqkv"] = np.concatenate(
                 [sd[pre + "self_attn.q_proj.bias"],
@@ -254,11 +280,33 @@ def params_to_hf(params: Params, cfg: ModelArgs) -> Dict[str, np.ndarray]:
         sd[pre + "self_attn.k_proj.weight"] = k.T
         sd[pre + "self_attn.v_proj.weight"] = v.T
         sd[pre + "self_attn.o_proj.weight"] = get(lp["attn"]["wo"]).T
-        win = get(lp["mlp"]["win"])
-        gate, up = np.split(win, 2, axis=1)
-        sd[pre + "mlp.gate_proj.weight"] = gate.T
-        sd[pre + "mlp.up_proj.weight"] = up.T
-        sd[pre + "mlp.down_proj.weight"] = get(lp["mlp"]["wout"]).T
+        if "bqkv" in lp["attn"]:
+            bqkv = get(lp["attn"]["bqkv"])
+            bq, bk, bv = np.split(bqkv, [nq * hd, (nq + nkv) * hd])
+            sd[pre + "self_attn.q_proj.bias"] = bq
+            sd[pre + "self_attn.k_proj.bias"] = bk
+            sd[pre + "self_attn.v_proj.bias"] = bv
+        if "moe" in lp:
+            if "shared" in lp["moe"]:
+                raise NotImplementedError(
+                    "the Mixtral HF layout has no shared-expert slot; "
+                    "export models with num_shared_experts=0")
+            sd[pre + "block_sparse_moe.gate.weight"] = \
+                get(lp["moe"]["router"]).T
+            win = get(lp["moe"]["win"])
+            wout = get(lp["moe"]["wout"])
+            for e in range(win.shape[0]):
+                w1, w3 = np.split(win[e], 2, axis=1)
+                sd[pre + f"block_sparse_moe.experts.{e}.w1.weight"] = w1.T
+                sd[pre + f"block_sparse_moe.experts.{e}.w3.weight"] = w3.T
+                sd[pre + f"block_sparse_moe.experts.{e}.w2.weight"] = \
+                    wout[e].T
+        else:
+            win = get(lp["mlp"]["win"])
+            gate, up = np.split(win, 2, axis=1)
+            sd[pre + "mlp.gate_proj.weight"] = gate.T
+            sd[pre + "mlp.up_proj.weight"] = up.T
+            sd[pre + "mlp.down_proj.weight"] = get(lp["mlp"]["wout"]).T
         sd[pre + "input_layernorm.weight"] = get(lp["ln1"]["scale"])
         sd[pre + "post_attention_layernorm.weight"] = get(lp["ln2"]["scale"])
     sd["model.norm.weight"] = get(params["prenorm"]["scale"])

@@ -15,7 +15,7 @@ true global norm; XLA inserts the cross-device reductions.
 
 from __future__ import annotations
 
-from typing import Any, Dict, Optional
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp

@@ -13,14 +13,13 @@ without host round-trips.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import optax
 
-from hetu_galvatron_tpu.core.args_schema import CoreArgs, ModelArgs, TrainArgs
+from hetu_galvatron_tpu.core.args_schema import CoreArgs, ModelArgs
 from hetu_galvatron_tpu.models.builder import causal_lm_loss
 from hetu_galvatron_tpu.runtime.optimizer import global_grad_norm, make_optimizer
 

@@ -20,7 +20,7 @@ view + `PartitionSpec`s.
 from __future__ import annotations
 
 import json
-from dataclasses import dataclass, field, replace
+from dataclasses import dataclass, replace
 from enum import IntEnum
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 

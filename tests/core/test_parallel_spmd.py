@@ -197,3 +197,41 @@ def test_pp3_mesh_allowed(cpu_devices):
     """pp need not be a power of two; only the per-stage world does."""
     mesh = build_mesh(6, 3, devices=cpu_devices[:6])
     assert dict(mesh.shape) == {"pp": 3, "d0": 2}
+
+
+def test_multi_step_trajectory_matches_single_device(cpu_devices):
+    """5 optimizer steps under tp2 x dp4(zero3): the loss trajectory and the
+    threaded optimizer state must track the single-device run (reference
+    tier-2 loss-trajectory comparisons)."""
+    import optax
+
+    params, axes = init_causal_lm(jax.random.key(0), CFG)
+    args = _args(global_tp_deg=2, default_dp_type="zero3",
+                 global_train_batch_size=8)
+    hpc = get_hybrid_parallel_config(args, 8)
+    mesh = build_mesh(8, hpc.pp_deg, devices=cpu_devices)
+    tx = make_optimizer(TRAIN)
+    step, pspecs, ospecs, batch_shd = make_spmd_train_step(
+        CFG, hpc, mesh, axes, tx, params,
+        compute_dtype=jnp.float32, donate=False)
+    sp = shard_params(params, pspecs, mesh)
+    opt = jax.jit(tx.init, out_shardings=jax.tree.map(
+        lambda s: jax.sharding.NamedSharding(mesh, s), ospecs,
+        is_leaf=lambda x: isinstance(x, jax.sharding.PartitionSpec)))(sp)
+
+    ref_p = params
+    ref_o = tx.init(params)
+    ref_loss_fn = lambda p, b: causal_lm_loss(p, b, CFG,
+                                              compute_dtype=jnp.float32)
+
+    for it in range(5):
+        batch = _batch(seed=it)
+        loss, grads = jax.value_and_grad(ref_loss_fn)(ref_p, batch)
+        upd, ref_o = tx.update(grads, ref_o, ref_p)
+        ref_p = optax.apply_updates(ref_p, upd)
+        sp, opt, metrics = step(sp, opt, jax.device_put(batch, batch_shd))
+        assert abs(float(metrics["loss"]) - float(loss)) < 5e-5, \
+            f"iter {it}: {float(metrics['loss'])} vs {float(loss)}"
+    for a, b in zip(jax.tree.leaves(ref_p), jax.tree.leaves(sp)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-3, atol=1e-3)
