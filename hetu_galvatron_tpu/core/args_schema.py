@@ -28,7 +28,8 @@ class ModelArgs(BaseModel):
     model_name: str = "gpt2-small"
     model_type: Literal["gpt", "llama", "bert", "t5", "moe"] = "gpt"
     hidden_size: int = 768
-    num_hidden_layers: int = 12
+    num_hidden_layers: int = 12  # decoder layers (t5: decoder stack depth)
+    num_encoder_layers: Optional[int] = None  # t5 only; None => same as dec
     num_attention_heads: int = 12
     num_key_value_heads: Optional[int] = None  # None => MHA
     ffn_hidden_size: Optional[int] = None  # None => 4*hidden (or 8/3 for swiglu)

@@ -63,8 +63,17 @@ class ModelProfiler:
                     warmup: int = 2, iters: int = 5) -> float:
         params, _ = init_causal_lm(jax.random.key(0), cfg)
         tokens = jnp.zeros((bsz, cfg.seq_length), jnp.int32)
-        fwd = jax.jit(lambda p, t: forward_causal_lm(
-            p, t, cfg, compute_dtype=jnp.bfloat16))
+        if cfg.model_type == "t5":
+            from hetu_galvatron_tpu.models.encdec import forward_encdec
+
+            half = max(cfg.seq_length // 2, 1)
+            enc = jnp.zeros((bsz, half), jnp.int32)
+            dec = jnp.zeros((bsz, cfg.seq_length - half), jnp.int32)
+            fwd = jax.jit(lambda p, t: forward_encdec(
+                p, enc, dec, cfg, compute_dtype=jnp.bfloat16))
+        else:
+            fwd = jax.jit(lambda p, t: forward_causal_lm(
+                p, t, cfg, compute_dtype=jnp.bfloat16))
         for _ in range(warmup):
             out = fwd(params, tokens)
         jax.block_until_ready(out)
@@ -138,6 +147,15 @@ class ModelProfiler:
             cfg, hpc, mesh, axes, tx, params, donate=False)
         tokens = jax.ShapeDtypeStruct((bsz, cfg.seq_length), jnp.int32)
         batch = {"tokens": tokens, "labels": tokens}
+        if cfg.model_type == "t5":
+            half = max(cfg.seq_length // 2, 1)
+            batch = {
+                "enc_tokens": jax.ShapeDtypeStruct((bsz, half), jnp.int32),
+                "tokens": jax.ShapeDtypeStruct(
+                    (bsz, cfg.seq_length - half), jnp.int32),
+                "labels": jax.ShapeDtypeStruct(
+                    (bsz, cfg.seq_length - half), jnp.int32),
+            }
         pshape = jax.eval_shape(lambda: params)
         oshape = jax.eval_shape(tx.init, params)
         compiled = step.lower(pshape, oshape, batch).compile()

@@ -43,8 +43,14 @@ def build_causal_lm_arch(cfg: ModelArgs) -> List[str]:
 def init_causal_lm(key: jax.Array, cfg: ModelArgs) -> Tuple[Params, Params]:
     """Returns (params, logical_axes) with layers as a per-layer tuple so the
     axes tree mirrors params exactly (required for tree-mapped shardings).
-    MoE models alternate dense/MoE layers per moe_layer_freq."""
+    MoE models alternate dense/MoE layers per moe_layer_freq; t5 builds the
+    encoder-decoder pair (models/encdec.py)."""
     from hetu_galvatron_tpu.models.moe import init_moe_decoder_layer, is_moe_layer
+
+    if cfg.model_type == "t5":
+        from hetu_galvatron_tpu.models.encdec import init_encdec
+
+        return init_encdec(key, cfg)
 
     n = cfg.num_hidden_layers
     keys = jax.random.split(key, n + 2)
@@ -144,7 +150,18 @@ def causal_lm_loss(
 
     Equivalent role to the reference's loss closure from the dataloader
     (dataloader.py:558 _loss_func + train_dist.py forward_backward wiring).
+    t5 batches route to the encoder-decoder loss.
     """
+    if cfg.model_type == "t5":
+        from hetu_galvatron_tpu.models.encdec import encdec_loss
+
+        if layer_overrides:
+            raise NotImplementedError(
+                "per-layer attention overrides (ring/flash dispatch) are not "
+                "wired into the t5 stacks yet; use cp=1 / use_flash_attn "
+                "false for t5")
+        return encdec_loss(params, batch, cfg, compute_dtype=compute_dtype,
+                           remat_flags=remat_flags, boundary_fn=boundary_fn)
     logits, aux = forward_causal_lm(
         params, batch["tokens"], cfg,
         compute_dtype=compute_dtype, remat_flags=remat_flags,

@@ -289,11 +289,14 @@ def apply_decoder_layer(
     rope: Optional[Tuple[jax.Array, jax.Array]] = None,
     sdpa_fn: Callable[..., jax.Array] = xla_sdpa,
     compute_dtype=jnp.bfloat16,
+    causal: Optional[bool] = None,
 ) -> jax.Array:
     """Pre-norm residual block (reference GalvatronDecoderLayer,
-    modules.py:233). Encoder families (bert) run the same block with
-    bidirectional attention."""
-    causal = cfg.model_type != "bert"
+    modules.py:233). Encoder families (bert, t5 encoder stack) run the same
+    block with bidirectional attention; ``causal=None`` derives from the
+    model family."""
+    if causal is None:
+        causal = cfg.model_type != "bert"
     h = apply_norm(p["ln1"], x, cfg)
     x = x + apply_attention(p["attn"], h, cfg, rope=rope, sdpa_fn=sdpa_fn,
                             compute_dtype=compute_dtype, causal=causal)

@@ -59,8 +59,10 @@ def param_specs(
 ) -> Params:
     """PartitionSpec pytree mirroring the params tree: decoder layers use
     their own sharding, embed/prenorm/head use the vocab sharding (reference
-    whole-model rows, hybrid_parallel_config.py:276-293)."""
-    return {
+    whole-model rows, hybrid_parallel_config.py:276-293). Encoder-decoder
+    models (t5) shard the encoder stack with the first decoder strategy
+    (per-layer heterogeneous encoder plans are a search-side extension)."""
+    out = {
         "embed": _spec_tree(axes_tree["embed"], vocab, opt),
         "layers": tuple(
             _spec_tree(a, sh, opt)
@@ -68,6 +70,12 @@ def param_specs(
         "prenorm": _spec_tree(axes_tree["prenorm"], vocab, opt),
         "head": _spec_tree(axes_tree["head"], vocab, opt),
     }
+    if "enc_layers" in axes_tree:
+        out["enc_layers"] = tuple(
+            _spec_tree(a, per_layer[0], opt)
+            for a in axes_tree["enc_layers"])
+        out["enc_norm"] = _spec_tree(axes_tree["enc_norm"], vocab, opt)
+    return out
 
 
 def opt_state_specs(
@@ -190,7 +198,9 @@ def make_spmd_train_step(
     opt_pspecs = param_specs(axes_tree, per_layer, vocab, opt=True)
     opt_specs = opt_state_specs(tx, params, opt_pspecs)
     boundary = make_boundary_fn(per_layer, vocab, mesh)
-    ring = attention_overrides(
+    # t5 stacks do not take per-layer attention overrides yet (encdec_loss
+    # would reject them); they run the XLA core under GSPMD
+    ring = {} if cfg.model_type == "t5" else attention_overrides(
         per_layer, mesh,
         use_flash=None if cfg.use_flash_attn else False)
     if ring:

@@ -190,6 +190,10 @@ def hf_to_params(state_dict: Dict[str, Any], cfg: ModelArgs) -> Params:
         if pre + "block_sparse_moe.gate.weight" in sd:
             # mixtral-style MoE FFN (reference moe_adapter.py:58-266):
             # experts.{e}.w1/w3 fuse into win [E, H, 2F], w2 -> wout [E, F, H]
+            if cfg.num_shared_experts:
+                raise NotImplementedError(
+                    "the Mixtral HF layout has no shared-expert slot; "
+                    "import with num_shared_experts=0")
             E = 0
             while pre + f"block_sparse_moe.experts.{E}.w1.weight" in sd:
                 E += 1

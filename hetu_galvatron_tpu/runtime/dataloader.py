@@ -130,7 +130,26 @@ def get_data_iterator(
         # encoders train on the MLM objective, never the causal shift
         # (bidirectional attention would leak shifted labels)
         return mlm_batches(it, args.model, seed=args.train.seed)
+    if args.model.model_type == "t5":
+        return seq2seq_batches(it)
     return it
+
+
+def seq2seq_batches(it: Iterator[Dict[str, np.ndarray]]
+                    ) -> Iterator[Dict[str, np.ndarray]]:
+    """Causal batches -> seq2seq: the first half of each sample becomes the
+    encoder source, the second half the (shifted) decoder target."""
+    for batch in it:
+        tokens = batch["tokens"]
+        half = tokens.shape[1] // 2
+        # tokens/labels are already the one-step-shifted pair, so slicing
+        # both at `half` keeps decoder input i aligned with label i+1
+        yield {
+            "enc_tokens": tokens[:, :half],
+            "tokens": tokens[:, half:],
+            "labels": batch["labels"][:, half:],
+            "loss_mask": batch["loss_mask"][:, half:],
+        }
 
 
 def mlm_batches(it: Iterator[Dict[str, np.ndarray]], model: ModelArgs,

@@ -99,6 +99,11 @@ class PipelineEngine:
         *,
         compute_dtype=jnp.bfloat16,
     ):
+        if cfg.model_type == "t5":
+            raise NotImplementedError(
+                "pipeline parallelism for encoder-decoder models is not "
+                "implemented; run t5 with pp_deg=1 (tp/dp/zero shard both "
+                "stacks)")
         self.cfg = cfg
         self.hpc = hpc
         self.train = train
