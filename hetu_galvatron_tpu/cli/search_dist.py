@@ -25,7 +25,8 @@ def main(argv=None) -> int:
         pipeline_type=args.search.pipeline_type,
     )
     engine.set_model_info(model_layer_configs(args.model),
-                          model_name(args.model))
+                          model_name(args.model),
+                          model_type=args.model.model_type)
     engine.initialize()
     throughput = engine.optimize()
     print(f"search done: max throughput {throughput} samples/s")

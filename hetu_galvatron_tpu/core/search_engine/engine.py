@@ -121,9 +121,13 @@ class SearchEngine:
     # ---------------- setup ----------------
 
     def set_model_info(self, model_layer_configs: List[Dict[str, Any]],
-                       model_name: str) -> None:
+                       model_name: str, model_type: str = "gpt") -> None:
         """model_layer_configs rows: hidden_size / seq_len / layer_num
-        (reference set_model_layer_configs, search_engine.py:84-91)."""
+        (reference set_model_layer_configs, search_engine.py:84-91).
+        Encoder-decoder models (t5) constrain the search to pp=1 — the
+        runtime has no encoder-decoder pipeline schedule."""
+        if model_type == "t5":
+            self.args.max_pp_deg = 1
         self.hiddensize_list = [c["hidden_size"] for c in model_layer_configs]
         self.layernum_list = [c["layer_num"] for c in model_layer_configs]
         self.seqlen_list = [c["seq_len"] for c in model_layer_configs]
@@ -233,9 +237,18 @@ class SearchEngine:
                         for cap in tp_caps:
                             tasks.append((gbsz, chunks, pp, mode, cap))
 
+        solve = lambda t: self.solve_task(t[0], t[1], t[2], t[4], t[3])
+        if a.parallel_search and len(tasks) > 1:
+            # thread pool (reference search_engine.py:579-610): the C++ DP
+            # core runs outside the GIL, so threads overlap the hot loop
+            import concurrent.futures as cf
+
+            with cf.ThreadPoolExecutor(max_workers=min(8, len(tasks))) as ex:
+                results = list(ex.map(solve, tasks))
+        else:
+            results = map(solve, tasks)
         best = TaskResult()
-        for gbsz, chunks, pp, mode, cap in tasks:
-            r = self.solve_task(gbsz, chunks, pp, cap, mode)
+        for r in results:
             if r.throughput > best.throughput:
                 best = r
         if best.throughput > 0:

@@ -25,7 +25,8 @@ Params = Dict[str, Any]
 
 
 def encoder_layers(cfg: ModelArgs) -> int:
-    return cfg.num_encoder_layers or cfg.num_hidden_layers
+    return (cfg.num_encoder_layers if cfg.num_encoder_layers is not None
+            else cfg.num_hidden_layers)
 
 
 def init_cross_attention(key: jax.Array, cfg: ModelArgs) -> Tuple[Params, Params]:
@@ -42,6 +43,14 @@ def init_cross_attention(key: jax.Array, cfg: ModelArgs) -> Tuple[Params, Params
     }
     a: Params = {"wq": ("embed", "qkv"), "wkv": ("embed", "qkv"),
                  "wo": ("heads", "embed")}
+    if cfg.add_qkv_bias:
+        p["bq"] = jnp.zeros((nq * hd,), jnp.float32)
+        p["bkv"] = jnp.zeros((2 * nkv * hd,), jnp.float32)
+        a["bq"] = ("qkv",)
+        a["bkv"] = ("qkv",)
+    if cfg.add_bias_linear:
+        p["bo"] = jnp.zeros((h,), jnp.float32)
+        a["bo"] = ("embed",)
     return p, a
 
 
@@ -59,9 +68,13 @@ def apply_cross_attention(
     q = jnp.einsum("bth,hf->btf", x.astype(compute_dtype),
                    p["wq"].astype(compute_dtype),
                    preferred_element_type=jnp.float32)
+    if "bq" in p:
+        q = q + p["bq"]
     kv = jnp.einsum("bsh,hf->bsf", memory.astype(compute_dtype),
                     p["wkv"].astype(compute_dtype),
                     preferred_element_type=jnp.float32)
+    if "bkv" in p:
+        kv = kv + p["bkv"]
     q = q.astype(compute_dtype).reshape(B, T, nq, hd)
     k, v = jnp.split(kv.astype(compute_dtype), 2, axis=-1)
     k = k.reshape(B, memory.shape[1], nkv, hd)
@@ -70,6 +83,8 @@ def apply_cross_attention(
     y = jnp.einsum("btf,fh->bth", out.reshape(B, T, nq * hd),
                    p["wo"].astype(compute_dtype),
                    preferred_element_type=jnp.float32)
+    if "bo" in p:
+        y = y + p["bo"]
     return y.astype(compute_dtype)
 
 

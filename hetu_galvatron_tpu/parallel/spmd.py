@@ -200,9 +200,16 @@ def make_spmd_train_step(
     boundary = make_boundary_fn(per_layer, vocab, mesh)
     # t5 stacks do not take per-layer attention overrides yet (encdec_loss
     # would reject them); they run the XLA core under GSPMD
-    ring = {} if cfg.model_type == "t5" else attention_overrides(
-        per_layer, mesh,
-        use_flash=None if cfg.use_flash_attn else False)
+    if cfg.model_type == "t5":
+        if cfg.use_flash_attn and all(
+                d.platform == "tpu" for d in mesh.devices.flat[:1]):
+            print("warning: flash attention is not wired into the t5 "
+                  "stacks; running the XLA attention core")
+        ring = {}
+    else:
+        ring = attention_overrides(
+            per_layer, mesh,
+            use_flash=None if cfg.use_flash_attn else False)
     if ring:
         # per-key merge: a caller override on a cp layer must not drop the
         # ring sdpa_fn unless it sets sdpa_fn itself

@@ -11,11 +11,13 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
+import threading
 from typing import Callable, Dict, Optional
 
 _CSRC = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                      "..", "..", "csrc")
 _CACHE: Dict[str, Optional[ctypes.CDLL]] = {}
+_LOCK = threading.Lock()
 
 
 def load_native(
@@ -26,6 +28,13 @@ def load_native(
     """Build csrc/<lib_name> from <source_name> via make if stale, load it,
     run `configure` (restype/argtypes setup) once, and cache. Returns None
     when the toolchain is unavailable."""
+    if lib_name in _CACHE:
+        return _CACHE[lib_name]
+    with _LOCK:  # threaded callers (parallel_search) must not race the build
+        return _load_locked(lib_name, source_name, configure)
+
+
+def _load_locked(lib_name, source_name, configure):
     if lib_name in _CACHE:
         return _CACHE[lib_name]
     so = os.path.join(_CSRC, lib_name)

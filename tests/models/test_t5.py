@@ -135,3 +135,20 @@ def test_t5_train_dist_cli(capsys):
                "parallel.global_tp_deg=2"])
     assert rc == 0
     assert "training done" in capsys.readouterr().out
+
+
+def test_cross_attention_biases_honored():
+    """add_qkv_bias/add_bias_linear apply to cross-attention too."""
+    cfg = T5.model_copy(update={"add_qkv_bias": True,
+                                "add_bias_linear": True})
+    params, axes = init_causal_lm(jax.random.key(0), cfg)
+    cross = params["layers"][0]["cross"]
+    assert "bq" in cross and "bkv" in cross and "bo" in cross
+    loss = causal_lm_loss(params, _batch(), cfg, compute_dtype=jnp.float32)
+    assert np.isfinite(float(loss))
+
+
+def test_num_encoder_layers_zero_is_zero():
+    cfg = T5.model_copy(update={"num_encoder_layers": 0})
+    params, _ = init_causal_lm(jax.random.key(0), cfg)
+    assert len(params["enc_layers"]) == 0

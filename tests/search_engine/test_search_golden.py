@@ -120,3 +120,12 @@ def test_numpy_fallback_matches_cpp(tmp_path):
     eng.args.use_cpp_core = False
     throughput = eng.optimize()
     assert abs(throughput - GOLDEN_FINE) < 1e-6, throughput
+
+
+def test_parallel_search_matches_sequential(tmp_path):
+    """parallel_search=True explores the same task grid and returns the
+    identical optimum (reference's thread-pool mode)."""
+    eng = _make_engine(tmp_path, settle_chunks=32, fine_grained=1)
+    eng.args.parallel_search = True
+    throughput = eng.optimize()
+    assert abs(throughput - GOLDEN_FINE) < 1e-6, throughput
