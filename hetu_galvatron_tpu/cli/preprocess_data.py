@@ -39,6 +39,7 @@ class ByteTokenizer:
 
     vocab_size = 257
     eod_id = 256
+    mask_id = None
 
     def encode(self, text: str) -> List[int]:
         return list(text.encode("utf-8"))
@@ -54,6 +55,7 @@ def make_tokenizer(name: Optional[str]):
     class _Wrap:
         vocab_size = tok.vocab_size
         eod_id = tok.eos_token_id if tok.eos_token_id is not None else 0
+        mask_id = getattr(tok, "mask_token_id", None)
 
         def encode(self, text: str) -> List[int]:
             return tok.encode(text, add_special_tokens=False)
@@ -85,6 +87,19 @@ def main(argv=None) -> int:
                 yield ids
 
     stats = write_indexed_dataset(prefix, docs())
+    # sidecar metadata so the TRAINING loader knows the tokenizer geometry
+    # (reference passes these through its tokenizer global; here the corpus
+    # is self-describing): consumed by runtime/dataloader.get_data_iterator
+    # for eod loss-masking and the MLM mask id
+    meta = {"vocab_size": int(tok.vocab_size),
+            "eod_id": int(tok.eod_id),
+            "mask_id": (int(tok.mask_id) if getattr(tok, "mask_id", None)
+                        is not None else None),
+            "tokenizer": kv.get("tokenizer") or "byte",
+            "documents": stats["documents"],
+            "tokens": stats["tokens"]}
+    with open(prefix + ".meta.json", "w") as f:
+        json.dump(meta, f, indent=2)
     print(f"wrote {prefix}.bin/.idx: {stats['documents']} documents, "
           f"{stats['tokens']} tokens (vocab {tok.vocab_size})")
     return 0

@@ -52,11 +52,45 @@ def train(args) -> Dict[str, Any]:
     params, axes = init_causal_lm(jax.random.key(args.train.seed), cfg)
     tx = make_optimizer(args.train)
     schedule = make_lr_schedule(args.train)
-    data_iter = RerunDataIterator(
-        get_data_iterator(args, global_batch_size=hpc.global_bsz))
+    base_iter = get_data_iterator(args, global_batch_size=hpc.global_bsz)
+    data_iter = RerunDataIterator(base_iter)
     profiler = RuntimeProfiler(args, world_size=world)
     rerun = RerunStateMachine(args.rerun)
     start_iter = 0
+
+    # batch-size ramp (reference --rampup-batch-size): the micro size
+    # gbsz/chunks stays FIXED; only the microbatch count varies per step
+    calc = rebatch = None
+    if args.train.rampup_batch_size:
+        from hetu_galvatron_tpu.runtime.microbatches import (
+            MicroBatchCalculator,
+            Rebatcher,
+        )
+
+        chunks0 = max(hpc.chunks, 1)
+        if hpc.global_bsz % chunks0:
+            raise ValueError(
+                f"global_bsz {hpc.global_bsz} % chunks {chunks0} != 0")
+        micro = hpc.global_bsz // chunks0
+        start = int(args.train.rampup_batch_size[0])
+        if start < micro and not args.train.decrease_batch_size_if_needed:
+            raise ValueError(
+                f"rampup start batch size {start} is below the fixed micro "
+                f"size global_bsz/chunks = {micro}: the ramp varies the "
+                "microbatch COUNT at a constant micro shape (XLA-static), "
+                "so start must be >= global_bsz/chunks — lower chunks, "
+                "raise the start, or set "
+                "train.decrease_batch_size_if_needed=true to clamp")
+        calc = MicroBatchCalculator(
+            hpc.global_bsz, micro, 1,
+            args.train.rampup_batch_size,
+            args.train.decrease_batch_size_if_needed)
+        state.log(
+            f"batch-size ramp: start {calc.start_global_batch_size} "
+            f"(running {calc.current_running_global_batch_size}) -> "
+            f"{hpc.global_bsz} by {calc.batch_size_increment} over "
+            f"{calc.ramp_samples} samples (micro {calc.micro_batch_size})")
+        rebatch = Rebatcher(base_iter)
 
     from hetu_galvatron_tpu.models.modules import compute_dtype_of
 
@@ -72,7 +106,23 @@ def train(args) -> Dict[str, Any]:
 
     def maybe_resume(sp, so):
         """Restore (sp, so, start_iter) and fast-forward the data stream so
-        a resumed run consumes the batches an uninterrupted run would."""
+        a resumed run consumes the batches an uninterrupted run would.
+
+        Even when plan resharding is allowed (strict_plan off), the stored
+        plan's global_bsz is compared so the fast-forward skips the SAMPLES
+        the original run consumed, not `start` batches at the new size —
+        preserving data order across a batch-size-changing resume (ADVICE
+        r2; the reference asserts plan equality unconditionally)."""
+        import json as _json
+        import math as _math
+        import os as _os
+
+        def stored_plan(ckdir):
+            mp = _os.path.join(ckdir, "meta.json")
+            if not _os.path.exists(mp):
+                return {}
+            return _json.load(open(mp)).get("hybrid_parallel_config") or {}
+
         start = 0
         if args.ckpt.load:
             ckdir = latest_checkpoint(args.ckpt.load)
@@ -81,12 +131,52 @@ def train(args) -> Dict[str, Any]:
                     ckdir, sp, so, hpc=hpc,
                     strict_plan=args.ckpt.distributed_checkpoint)
                 state.log(f"resumed from {ckdir} at iter {start}")
-                for _ in range(start):
+                stored = stored_plan(ckdir)
+                sbsz = stored.get("global_bsz")
+                if calc is not None:
+                    # replay the ramp: skip exactly the samples the original
+                    # run consumed over its first `start` iterations. This
+                    # replays the CURRENT schedule — if the stored plan's
+                    # batch geometry differs, the sample count cannot be
+                    # reconstructed (the ramp triple is not in the plan
+                    # fingerprint), so warn loudly instead of silently
+                    # misaligning (mirrors the non-ramp branch below).
+                    if (sbsz not in (None, hpc.global_bsz)
+                            or stored.get("chunks") not in (None, hpc.chunks)):
+                        state.log(
+                            "warning: resuming a RAMPED run with a different "
+                            f"batch geometry (stored global_bsz/chunks "
+                            f"{sbsz}/{stored.get('chunks')} vs current "
+                            f"{hpc.global_bsz}/{hpc.chunks}): the replayed "
+                            "data schedule will not match the original run")
+                    consumed = 0
+                    for _ in range(start):
+                        calc.update(consumed)
+                        n = calc.current_running_global_batch_size
+                        rebatch.next_batch(n)
+                        consumed += n
+                    consumed_box[0] = consumed
+                    return sp, so, start
+                skip = start
+                if sbsz and sbsz != hpc.global_bsz:
+                    skip = int(_math.ceil(start * sbsz / hpc.global_bsz))
+                    state.log(
+                        f"warning: resuming a run trained at global_bsz "
+                        f"{sbsz} with global_bsz {hpc.global_bsz}; "
+                        f"fast-forwarding {skip} batches "
+                        f"({start * sbsz} samples) to preserve data order")
+                elif stored.get("chunks") not in (None, hpc.chunks):
+                    state.log(
+                        f"warning: checkpoint chunks {stored.get('chunks')} "
+                        f"!= current {hpc.chunks}; gradient accumulation "
+                        "boundaries will differ from the original run")
+                for _ in range(skip):
                     next(data_iter)
                     data_iter.advance()
         return sp, so, start
 
     exit_code = None
+    consumed_box = [0]  # ramped-run sample counter (survives maybe_resume)
 
     def run_loop(sp, so, step_fn):
         """Shared iteration driver for both execution paths. step_fn(sp, so,
@@ -94,7 +184,16 @@ def train(args) -> Dict[str, Any]:
         nonlocal exit_code
         for it in range(start_iter, args.train.train_iters):
             profiler.time_start(it)
-            batch = next(data_iter)
+            if calc is not None:
+                if calc.update(consumed_box[0]):
+                    state.log(f"ramping global batch size to "
+                              f"{calc.current_running_global_batch_size} "
+                              f"({calc.num_micro_batches} microbatches)")
+                batch = rebatch.next_batch(
+                    calc.current_running_global_batch_size)
+                consumed_box[0] += calc.current_running_global_batch_size
+            else:
+                batch = next(data_iter)
             # keep pre-update state alive only when the rerun machine may
             # re-execute the step for fault attribution
             prev = (sp, so) if rerun.enabled else None
@@ -106,8 +205,9 @@ def train(args) -> Dict[str, Any]:
                 rerun_fn=(
                     (lambda: float(step_fn(*prev, batch)[2]["loss"]))
                     if prev is not None else None),
-                data_iterator=data_iter)
-            data_iter.advance()
+                data_iterator=data_iter if calc is None else None)
+            if calc is None:
+                data_iter.advance()
             losses.append(float(metrics["loss"]))
             # check for a fault BEFORE the interval save: the faulty update
             # must never be persisted (a step_{it+1} checkpoint would shadow
@@ -134,7 +234,12 @@ def train(args) -> Dict[str, Any]:
         sp = eng.split_params(params, axes)
         so = eng.init_opt(sp, axes)
         sp, so, start_iter = maybe_resume(sp, so)
-        run_loop(sp, so, eng.train_step)
+        if calc is None:
+            run_loop(sp, so, eng.train_step)
+        else:
+            # the stage jits are microbatch-shaped: a ramp reuses them all
+            run_loop(sp, so, lambda sp_, so_, b: eng.train_step(
+                sp_, so_, b, num_microbatches=calc.num_micro_batches))
     else:
         mesh = build_mesh(world, 1, devices=state.devices)
         # donation halves live model-state memory but is only safe when the
@@ -148,10 +253,22 @@ def train(args) -> Dict[str, Any]:
         sp = shard_params(params, pspecs, mesh)
         so = jax.jit(tx.init, out_shardings=nshd)(sp)
         sp, so, start_iter = maybe_resume(sp, so)
+        # ramp: one jitted step per distinct microbatch COUNT (micro shape
+        # fixed), compiled lazily as the ramp reaches each count
+        step_cache = {max(hpc.chunks, 1): step}
+
+        def get_step(ch):
+            if ch not in step_cache:
+                step_cache[ch] = make_spmd_train_step(
+                    cfg, hpc, mesh, axes, tx, params,
+                    compute_dtype=compute_dtype,
+                    donate=not rerun.enabled, chunks=ch)[0]
+            return step_cache[ch]
 
         def spmd_step(sp, so, raw):
             b = jax.device_put(jax.tree.map(jnp.asarray, raw), batch_shd)
-            return step(sp, so, b)
+            fn = step if calc is None else get_step(calc.num_micro_batches)
+            return fn(sp, so, b)
 
         run_loop(sp, so, spmd_step)
 

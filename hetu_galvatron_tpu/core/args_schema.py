@@ -36,8 +36,12 @@ class ModelArgs(BaseModel):
     vocab_size: int = 50257
     max_position_embeddings: int = 1024
     seq_length: int = 1024
-    hidden_act: Literal["gelu", "swiglu", "geglu", "relu", "silu"] = "gelu"
+    hidden_act: Literal["gelu", "gelu_exact", "swiglu", "geglu", "relu", "silu"] = "gelu"
     normalization: Literal["layernorm", "rmsnorm"] = "layernorm"
+    # None derives from the family: "post" for bert (HF BertLayer applies
+    # LN after each residual; embeddings get their own LN and the final
+    # norm lives in the MLM transform head), "pre" for everything else
+    norm_position: Optional[Literal["pre", "post"]] = None
     layernorm_epsilon: float = 1e-5
     position_embedding_type: Literal["learned", "rope"] = "learned"
     rope_theta: float = 10000.0
@@ -56,6 +60,16 @@ class ModelArgs(BaseModel):
     moe_z_loss_coeff: float = 0.0
     moe_router_dtype: Literal["float32", "bfloat16"] = "float32"
     moe_layer_freq: int = 1  # every k-th layer is MoE
+    # dispatch: "capacity" = GShard one-hot (ep-shardable, drops over-capacity
+    # tokens), "dropless" = sorted ragged grouped matmuls (exact numerics,
+    # reference alltoall dropless dispatcher)
+    moe_dispatcher: Literal["capacity", "dropless"] = "capacity"
+    moe_capacity_factor: float = 1.25
+    # router: softmax topk (optionally expert-bias-corrected selection) or
+    # sinkhorn load balancing (reference router.py:98)
+    moe_router_type: Literal["topk", "sinkhorn"] = "topk"
+    moe_router_enable_expert_bias: bool = False
+    moe_expert_bias_update_rate: float = 1e-3
 
     @property
     def kv_heads(self) -> int:
@@ -75,6 +89,13 @@ class ModelArgs(BaseModel):
     def padded_vocab_size(self) -> int:
         m = self.make_vocab_size_divisible_by
         return ((self.vocab_size + m - 1) // m) * m
+
+    @property
+    def post_norm(self) -> bool:
+        """True = residual-then-norm blocks (HF BERT layout)."""
+        pos = self.norm_position or (
+            "post" if self.model_type == "bert" else "pre")
+        return pos == "post"
 
     # bias flags (HF adapter detects these per family, e.g. qwen2 qkv bias)
     add_bias_linear: bool = True
@@ -141,6 +162,11 @@ class TrainArgs(BaseModel):
     eval_iters: int = 0
     check_loss: bool = False
     deterministic_mode: bool = False
+    # batch-size ramp [start, increment, ramp_samples] (reference
+    # --rampup-batch-size, num_microbatches_calculator.py:193-258);
+    # None = constant global batch size
+    rampup_batch_size: Optional[List[int]] = None
+    decrease_batch_size_if_needed: bool = False
 
 
 class CheckpointArgs(BaseModel):

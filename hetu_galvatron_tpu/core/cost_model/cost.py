@@ -15,12 +15,24 @@ Units: memory in MB, profiled times in ms, returned times in seconds.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+from typing import (
+    TYPE_CHECKING,
+    Any,
+    Dict,
+    List,
+    Optional,
+    Sequence,
+    Tuple,
+    Union,
+)
 
 import numpy as np
 
-from hetu_galvatron_tpu.core.search_engine.strategies import SearchStrategy
 from hetu_galvatron_tpu.utils.strategy import DPType
+
+if TYPE_CHECKING:  # typing only — a runtime import would be circular
+    # (search_engine/__init__ imports engine, engine imports this module)
+    from hetu_galvatron_tpu.core.search_engine.strategies import SearchStrategy
 
 Fit = Union[float, np.ndarray, Tuple[float, float]]
 
@@ -103,7 +115,7 @@ def _zero_ratios(chunks: int, mixed_precision: bool, async_grad_reduce: bool):
 
 
 def layer_time_cost(
-    s: SearchStrategy, ctx: CostContext, gbsz: int, chunks: int
+    s: "SearchStrategy", ctx: CostContext, gbsz: int, chunks: int
 ) -> Tuple[float, float]:
     """Per-layer time in seconds: (with grad sync, without). Mirrors
     TimeCostModelBase end-to-end (layer_cost.py:88-213)."""
@@ -111,12 +123,14 @@ def layer_time_cost(
     param_mb = ctx.parameter_size / s.tp
     n = ctx.layer_num
 
-    # computation (layer_cost.py:88-103)
+    # computation (layer_cost.py:88-103); cp shards the sequence, so the
+    # per-device compute divides by cp too (zigzag ring keeps the causal
+    # work balanced across the ring — ops/ring_attention.py)
     fct_in = ctx.forward_computation_time
     if isinstance(fct_in, (np.ndarray, tuple, list)):
-        fct = _linear(lbsz / s.tp_sp, fct_in) * n
+        fct = _linear(lbsz / s.tp_sp / s.cp, fct_in) * n
     else:
-        fct = fct_in * lbsz / s.tp_sp * n
+        fct = fct_in * lbsz / s.tp_sp / s.cp * n
     bct = fct * ctx.bct_fct_coe
     if s.checkpoint:
         bct += fct
@@ -145,6 +159,21 @@ def layer_time_cost(
         message_mb = (lbsz * ctx.seq_length * ctx.hidden_size *
                       (2 if ctx.mixed_precision else 4) / 1024 / 1024)
         tp_time = _lookup_latency(select, message_mb) * comm_num
+
+    # cp ring-attention communication (beyond the reference, which ships
+    # cp disabled — search_engine/args_schema.py:29): each ring step
+    # exchanges this rank's K and V blocks with a neighbour; the backward
+    # rings K/V again plus the dK/dV accumulators (ops/ring_attention.py).
+    cp_time = 0.0
+    if s.cp > 1:
+        block_mb = (lbsz * ctx.seq_length * ctx.hidden_size / s.cp *
+                    (2 if ctx.mixed_precision else 4) / 1024 / 1024)
+        hops = 2 * (s.cp - 1)          # K + V per ring pass
+        ring_mb = block_mb * hops * 3  # fwd + bwd(K/V + dK/dV)
+        cp_key = f"{s.cp}_0" if s.tp != 1 else f"{s.cp}_1"
+        cp_coe = ctx.comm_coe_dict.get(
+            cp_key, ctx.comm_coe_dict.get(f"{s.cp}"))
+        cp_time = ring_mb * cp_coe * n
 
     # pp p2p (layer_cost.py:152-159)
     p2p_coe = None
@@ -182,6 +211,7 @@ def layer_time_cost(
             r += fsdp_allgather * dc
         if s.pp > 1 and p2p_coe is not None:
             r += p2p_message * p2p_coe
+        r += cp_time
         return r * 0.001 * ctx.costmodel_coe / n
 
     return result(False), result(True)
@@ -193,7 +223,7 @@ def layer_time_cost(
 
 
 def layer_memory_cost(
-    s: SearchStrategy,
+    s: "SearchStrategy",
     ctx: CostContext,
     gbsz: int,
     chunks: int,
@@ -228,6 +258,10 @@ def layer_memory_cost(
             activation /= s.tp_sp
     else:
         activation = act[s.tp_sp] * cum_lbsz
+    # cp shards the sequence (ring attention): activations divide by cp;
+    # model states do not (weights replicate over cp, but ZeRO already
+    # shards states over sdp = dp*sp*cp above)
+    activation /= s.cp
     return model_states + activation
 
 
@@ -237,7 +271,7 @@ def layer_memory_cost(
 
 
 def embed_time_cost(
-    s: SearchStrategy,
+    s: "SearchStrategy",
     ctx: CostContext,
     gbsz: int,
     chunks: int,
@@ -326,7 +360,7 @@ def embed_time_cost(
 
 
 def embed_memory_cost(
-    s: SearchStrategy,
+    s: "SearchStrategy",
     ctx: CostContext,
     gbsz: int,
     chunks: int,
@@ -356,7 +390,8 @@ def embed_memory_cost(
 
     activation = [0.0] * pp
     if pp == 1:
-        activation[0] = (ctx.other_memory_pp_off["activation"][s.tp_sp] * lbsz)
+        activation[0] = (ctx.other_memory_pp_off["activation"][s.tp_sp] * lbsz
+                         / s.cp)
     else:
         if chunks < pp:
             raise ValueError(f"chunks {chunks} < pp {pp}")
@@ -365,9 +400,9 @@ def embed_memory_cost(
         else:
             cum_first, cum_last = chunks, chunks
         activation[0] = (ctx.other_memory_pp_on["first_stage"]["activation"]
-                         [s.tp_sp] * cum_first * lbsz)
+                         [s.tp_sp] * cum_first * lbsz / s.cp)
         activation[-1] = (ctx.other_memory_pp_on["last_stage"]["activation"]
-                          [s.tp_sp] * cum_last * lbsz)
+                          [s.tp_sp] * cum_last * lbsz / s.cp)
 
     return [m + a + ctx.pytorch_context_mem
             for m, a in zip(model_states, activation)]
@@ -381,7 +416,7 @@ def embed_memory_cost(
 def pipeline_time_cost(
     layer_num_list: Sequence[int],
     contexts: Sequence[CostContext],
-    strategy_list: Sequence[SearchStrategy],
+    strategy_list: Sequence["SearchStrategy"],
     partition: Sequence[int],
     chunks: int,
     gbsz: int,
@@ -399,8 +434,8 @@ def pipeline_time_cost(
         layertype_of.extend([t] * n)
 
     uniq = list(set(strategy_list))
-    sync_cost: Dict[Tuple[int, SearchStrategy], float] = {}
-    nosync_cost: Dict[Tuple[int, SearchStrategy], float] = {}
+    sync_cost: Dict[Tuple[int, "SearchStrategy"], float] = {}
+    nosync_cost: Dict[Tuple[int, "SearchStrategy"], float] = {}
     for t in range(len(layer_num_list)):
         for s in uniq:
             w, wo = layer_time_cost(s, contexts[t], gbsz, chunks)

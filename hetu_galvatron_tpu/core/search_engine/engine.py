@@ -124,10 +124,16 @@ class SearchEngine:
                        model_name: str, model_type: str = "gpt") -> None:
         """model_layer_configs rows: hidden_size / seq_len / layer_num
         (reference set_model_layer_configs, search_engine.py:84-91).
-        Encoder-decoder models (t5) constrain the search to pp=1 — the
-        runtime has no encoder-decoder pipeline schedule."""
+        Encoder-decoder models (t5) search the combined enc+dec stack:
+        layertype 0 is the encoder, the plan JSON records the split point
+        (num_encoder_layers) and the runtime pipelines either stack."""
+        self.num_encoder_layers: Optional[int] = None
         if model_type == "t5":
-            self.args.max_pp_deg = 1
+            # adapter convention: layertype 0 is the encoder, omitted when
+            # the model has zero encoder layers
+            self.num_encoder_layers = (
+                model_layer_configs[0]["layer_num"]
+                if len(model_layer_configs) > 1 else 0)
         self.hiddensize_list = [c["hidden_size"] for c in model_layer_configs]
         self.layernum_list = [c["layer_num"] for c in model_layer_configs]
         self.seqlen_list = [c["seq_len"] for c in model_layer_configs]
@@ -336,6 +342,92 @@ class SearchEngine:
         out[0, :, :] = 0  # first layer has no predecessor
         return out
 
+    def pp_division_balanced(self, gbsz: int, chunks: int, pp: int
+                             ) -> List[int]:
+        """Memory-balanced stage division (reference
+        pp_division_memory_balanced, search_engine.py:954-1058): greedily
+        fill stages to the average memory of a ZeRO-2 dp baseline (gpipe
+        accounting), then rebalance overweight/empty stages. Used for
+        multi-layertype models, where even layer counts put uneven memory
+        on stages (reference get_pp_stage_for_bsz single_layer_even)."""
+        if pp == 1:
+            return [self.total_layernum]
+        base = SearchStrategy(pp=pp, tp=1, sp=1, cp=1,
+                              dp=self.world_size // pp,
+                              dp_type=DPType.ZERO2)
+        per_type = [layer_memory_cost(base, self.contexts[t], gbsz, chunks,
+                                      stage_idx=0, pipeline_type="gpipe")
+                    for t in range(self.num_layertype)]
+        layer_costs: List[float] = []
+        for t, n in enumerate(self.layernum_list):
+            layer_costs += [per_type[t]] * n
+        other = list(embed_memory_cost(base.vocab_variant(),
+                                       self.contexts[0], gbsz, chunks,
+                                       pipeline_type="gpipe"))
+        avg = (sum(layer_costs) + sum(other)) / pp
+
+        divide = [0] * pp
+        stage_mem = list(other)
+        idx = 0
+        for i in range(pp):
+            while idx < len(layer_costs):
+                if i < pp - 1 and avg - stage_mem[i] < 0.5 * layer_costs[idx]:
+                    break
+                stage_mem[i] += layer_costs[idx]
+                idx += 1
+                divide[i] += 1
+        # drain overweight early stages forward
+        for i in range(pp - 1):
+            left = sum(divide[:i])
+            right = left + divide[i]
+            cur = sum(layer_costs[left:right]) + other[i]
+            while cur > avg * 1.3 and divide[i] > 0:
+                divide[i] -= 1
+                divide[i + 1] += 1
+                right -= 1
+                cur -= layer_costs[right]
+        # no empty stages
+        for i in range(pp - 1):
+            while divide[i] <= 0:
+                divide[i] += 1
+                divide[i + 1] -= 1
+        for i in range(pp - 1, 0, -1):
+            while divide[i] <= 0:
+                divide[i] += 1
+                divide[i - 1] -= 1
+        return divide
+
+    def check_cost_model(self, gbsz: int, chunks: int,
+                         strategies: Optional[List[SearchStrategy]] = None
+                         ) -> List[Dict[str, Any]]:
+        """Developer introspection (reference check_cost_model,
+        search_engine.py:788): evaluate every candidate strategy's per-layer
+        time and per-stage memory at (gbsz, chunks), print a table, and
+        return the rows for programmatic use."""
+        rows: List[Dict[str, Any]] = []
+        for s in (strategies if strategies is not None
+                  else self.layer_strategies):
+            if s.pp > chunks or gbsz // chunks < s.dp:
+                continue
+            time_sync, time_nosync = layer_time_cost(
+                s, self.contexts[0], gbsz, chunks)
+            mem = [layer_memory_cost(s, self.contexts[0], gbsz, chunks,
+                                     stage_idx=st,
+                                     pipeline_type=self.pipeline_type)
+                   for st in range(s.pp)]
+            vs = s.vocab_variant()
+            vmem = embed_memory_cost(vs, self.contexts[0], gbsz, chunks,
+                                     pipeline_type=self.pipeline_type)
+            row = {"strategy": s.simple_string(), "time": time_sync,
+                   "time_no_sync": time_nosync, "layer_memory": mem,
+                   "vocab_memory": list(vmem)}
+            rows.append(row)
+            print(f"check_cost_model[{s.simple_string()}]: "
+                  f"time {time_sync * 1e3:.3f} ms "
+                  f"(no-sync {time_nosync * 1e3:.3f}) "
+                  f"mem/layer {mem[0]:.1f} MB vocab {vmem[0]:.1f} MB")
+        return rows
+
     def solve_task(self, gbsz: int, chunks: int, pp: int, cap: int,
                    mode: str) -> TaskResult:
         """One (bsz, chunks, pp, mode, max-tp) cell (reference
@@ -348,7 +440,11 @@ class SearchEngine:
         if not layer_list or not vocab_list:
             return TaskResult(bsz=gbsz, chunks=chunks)
         vocab_list = sorted(vocab_list, key=SearchStrategy.sort_key)
-        partition = pp_division_even(self.layernum_list, pp)
+        # single-layertype models keep the reference's even split (golden
+        # parity); multi-layertype (t5/moe) stacks balance stage memory
+        partition = (pp_division_even(self.layernum_list, pp)
+                     if self.num_layertype == 1
+                     else self.pp_division_balanced(gbsz, chunks, pp))
 
         # memory budget with the reserved allocator cache
         # (dynamic_programming.py:154-159)
@@ -505,7 +601,8 @@ class SearchEngine:
             vocab=EmbeddingLMHeadStrategy(
                 vtp=best.vocab_tp_sp, vsp=bool(best.vocab_sp),
                 embed_sdp=bool(best.vocab_sdp)),
-            pp_division=best.pp_stage_list)
+            pp_division=best.pp_stage_list,
+            num_encoder_layers=getattr(self, "num_encoder_layers", None))
         a = self.args
         off = [name for flag, name in (
             (a.disable_dp, "dp"), (a.disable_tp, "tp"), (a.disable_pp, "pp"),

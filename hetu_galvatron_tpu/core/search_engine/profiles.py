@@ -288,9 +288,10 @@ def parse_memory_config(
         if not sequence_parallel:
             raise ValueError("sequence memory profiling requires "
                              "sequence_parallel")
-        if num_layertype != 1:
-            raise ValueError("sequence memory profiling supports exactly one "
-                             "layertype")
+        # (the reference restricts sequence-mode memory profiles to one
+        # layertype; the per-layertype loop below is generic, which lets
+        # encoder-decoder searches scale each stack's activations by its own
+        # sequence length)
         maxseq_list = []
         for i in range(num_layertype):
             layer_mem = memory_config[f"layertype_{i}_sp"]

@@ -144,8 +144,17 @@ def enumerate_strategies(
                     if pp * tp_sp * cp > world_size:
                         continue
                     dp = world_size // pp // tp_sp // cp
-                    if dp == 1:
+                    if dp == 1 and cp == 1:
                         dp_types = [DPType.DDP]
+                    elif dp == 1:
+                        # cp>1 with dp=1: ZeRO still shards states over the
+                        # ring group (sdp = dp*sp*cp > 1) — without this the
+                        # long-sequence cp regime would carry fully
+                        # replicated model states (beyond the reference,
+                        # which never enumerates cp)
+                        dp_types = ([DPType.DDP, DPType.ZERO3]
+                                    if default_dp_type == "ddp"
+                                    else [DPType.ZERO2, DPType.ZERO3])
                     elif default_dp_type == "ddp":
                         dp_types = [DPType.DDP, DPType.ZERO3]
                     else:

@@ -60,7 +60,13 @@ def init_causal_lm(key: jax.Array, cfg: ModelArgs) -> Tuple[Params, Params]:
          else M.init_decoder_layer(keys[1 + i], cfg))
         for i in range(n)
     ]
-    prenorm_p, prenorm_a = M.init_norm(cfg)
+    if cfg.post_norm:
+        # post-norm families (bert) end each block already normalized; the
+        # MLM head's transform LayerNorm is the final norm (HF BertLayer +
+        # BertLMPredictionHead layout) — apply_norm({}) is the identity
+        prenorm_p, prenorm_a = {}, {}
+    else:
+        prenorm_p, prenorm_a = M.init_norm(cfg)
     head_p, head_a = M.init_lm_head(keys[n + 1], cfg)
     params = {
         "embed": embed_p,
@@ -145,23 +151,27 @@ def causal_lm_loss(
     remat_flags: Optional[Sequence[bool]] = None,
     layer_overrides: Optional[Dict[int, Dict[str, Any]]] = None,
     boundary_fn: Optional[Callable[[int, jax.Array], jax.Array]] = None,
+    enc_remat_flags: Optional[Sequence[bool]] = None,
+    enc_layer_overrides: Optional[Dict[int, Dict[str, Any]]] = None,
+    enc_boundary_fn: Optional[Callable[[int, jax.Array], jax.Array]] = None,
 ) -> jax.Array:
     """batch: tokens [B,S], labels [B,S], optional loss_mask [B,S] -> scalar.
 
     Equivalent role to the reference's loss closure from the dataloader
     (dataloader.py:558 _loss_func + train_dist.py forward_backward wiring).
-    t5 batches route to the encoder-decoder loss.
+    t5 batches route to the encoder-decoder loss; the ``enc_*`` knobs index
+    the encoder stack and are only meaningful there.
     """
     if cfg.model_type == "t5":
         from hetu_galvatron_tpu.models.encdec import encdec_loss
 
-        if layer_overrides:
-            raise NotImplementedError(
-                "per-layer attention overrides (ring/flash dispatch) are not "
-                "wired into the t5 stacks yet; use cp=1 / use_flash_attn "
-                "false for t5")
         return encdec_loss(params, batch, cfg, compute_dtype=compute_dtype,
-                           remat_flags=remat_flags, boundary_fn=boundary_fn)
+                           remat_flags=remat_flags,
+                           enc_remat_flags=enc_remat_flags,
+                           boundary_fn=boundary_fn,
+                           enc_boundary_fn=enc_boundary_fn,
+                           layer_overrides=layer_overrides,
+                           enc_layer_overrides=enc_layer_overrides)
     logits, aux = forward_causal_lm(
         params, batch["tokens"], cfg,
         compute_dtype=compute_dtype, remat_flags=remat_flags,

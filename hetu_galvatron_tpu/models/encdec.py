@@ -112,15 +112,22 @@ def apply_cross_decoder_layer(
     cfg: ModelArgs,
     rope=None,
     sdpa_fn: Callable[..., jax.Array] = M.xla_sdpa,
+    cross_sdpa_fn: Optional[Callable[..., jax.Array]] = None,
     compute_dtype=jnp.bfloat16,
 ) -> jax.Array:
-    """Pre-norm: causal self-attention -> cross-attention -> MLP."""
+    """Pre-norm: causal self-attention -> cross-attention -> MLP.
+
+    ``sdpa_fn`` drives the (causal) self-attention; cross-attention uses
+    ``cross_sdpa_fn`` when given, else ``sdpa_fn`` — the dispatch layer
+    (parallel/spmd.py attention_overrides) passes a non-causal-capable kernel
+    here (flash handles causal=False; ring layers fall back to the XLA core
+    because the decoder/encoder sequence lengths differ)."""
     h = M.apply_norm(p["ln1"], x, cfg)
     x = x + M.apply_attention(p["attn"], h, cfg, rope=rope, sdpa_fn=sdpa_fn,
                               compute_dtype=compute_dtype, causal=True)
     h = M.apply_norm(p["lnx"], x, cfg)
     x = x + apply_cross_attention(p["cross"], h, memory, cfg,
-                                  sdpa_fn=sdpa_fn,
+                                  sdpa_fn=cross_sdpa_fn or sdpa_fn,
                                   compute_dtype=compute_dtype)
     h = M.apply_norm(p["ln2"], x, cfg)
     x = x + M.apply_mlp(p["mlp"], h, cfg, compute_dtype=compute_dtype)
@@ -167,14 +174,21 @@ def forward_encdec(
     *,
     compute_dtype=jnp.bfloat16,
     remat_flags=None,
+    enc_remat_flags=None,
     boundary_fn=None,
+    enc_boundary_fn=None,
+    layer_overrides=None,
+    enc_layer_overrides=None,
     logits_fp32: bool = True,
 ) -> jax.Array:
     """(enc_tokens [B,S], dec_tokens [B,T]) -> logits [B,T,V].
 
-    ``remat_flags`` is indexed by DECODER layer (matching the per-layer
-    strategy list); the encoder stack uniformly follows ``remat_flags[0]``.
-    ``boundary_fn`` applies to the decoder stream (per-layer resharding)."""
+    Per-layer knobs mirror the decoder-only builder (models/builder.py):
+    ``remat_flags`` / ``boundary_fn`` / ``layer_overrides`` index DECODER
+    layers; the ``enc_*`` triplet indexes ENCODER layers (heterogeneous
+    per-layer encoder plans — the combined-stack strategy list of
+    runtime/hybrid_config.py). When ``enc_remat_flags`` is None the encoder
+    falls back to ``remat_flags[0]`` uniformly (legacy behavior)."""
     rope_enc = rope_dec = None
     if cfg.position_embedding_type == "rope":
         rope_enc = M.rope_cos_sin(enc_tokens.shape[1], cfg.head_dim,
@@ -182,16 +196,25 @@ def forward_encdec(
         rope_dec = M.rope_cos_sin(dec_tokens.shape[1], cfg.head_dim,
                                   cfg.rope_theta)
 
-    enc_remat = bool(remat_flags[0]) if remat_flags else False
+    if enc_remat_flags is None and remat_flags:
+        enc_remat_flags = [bool(remat_flags[0])] * len(params["enc_layers"])
     mem = M.apply_embedding(params["embed"], enc_tokens, cfg,
                             compute_dtype=compute_dtype)
-    for lp in params["enc_layers"]:
-        fn = lambda p, h: M.apply_decoder_layer(
-            p, h, cfg, rope=rope_enc, compute_dtype=compute_dtype,
-            causal=False)
-        if enc_remat:
+    for i, lp in enumerate(params["enc_layers"]):
+        if enc_boundary_fn is not None:
+            mem = enc_boundary_fn(i, mem)
+        kwargs: Dict[str, Any] = dict(rope=rope_enc,
+                                      compute_dtype=compute_dtype,
+                                      causal=False)
+        if enc_layer_overrides and i in enc_layer_overrides:
+            kwargs.update(enc_layer_overrides[i])
+        kwargs.pop("cross_sdpa_fn", None)  # encoder blocks have no cross-attn
+        fn = lambda p, h, kw=kwargs: M.apply_decoder_layer(p, h, cfg, **kw)
+        if enc_remat_flags is not None and enc_remat_flags[i]:
             fn = jax.checkpoint(fn)
         mem = fn(lp, mem)
+    if enc_boundary_fn is not None:
+        mem = enc_boundary_fn(len(params["enc_layers"]), mem)
     mem = M.apply_norm(params["enc_norm"], mem, cfg)
 
     x = M.apply_embedding(params["embed"], dec_tokens, cfg,
@@ -199,8 +222,11 @@ def forward_encdec(
     for i, lp in enumerate(params["layers"]):
         if boundary_fn is not None:
             x = boundary_fn(i, x)
-        fn = lambda p, h, m: apply_cross_decoder_layer(
-            p, h, m, cfg, rope=rope_dec, compute_dtype=compute_dtype)
+        kwargs = dict(rope=rope_dec, compute_dtype=compute_dtype)
+        if layer_overrides and i in layer_overrides:
+            kwargs.update(layer_overrides[i])
+        fn = lambda p, h, m, kw=kwargs: apply_cross_decoder_layer(
+            p, h, m, cfg, **kw)
         if remat_flags is not None and remat_flags[i]:
             fn = jax.checkpoint(fn)
         x = fn(lp, x, mem)
@@ -220,13 +246,21 @@ def encdec_loss(
     *,
     compute_dtype=jnp.bfloat16,
     remat_flags=None,
+    enc_remat_flags=None,
     boundary_fn=None,
+    enc_boundary_fn=None,
+    layer_overrides=None,
+    enc_layer_overrides=None,
 ) -> jax.Array:
     """batch: enc_tokens [B,S], tokens (decoder input) [B,T], labels [B,T],
     optional loss_mask."""
     logits = forward_encdec(params, batch["enc_tokens"], batch["tokens"],
                             cfg, compute_dtype=compute_dtype,
                             remat_flags=remat_flags,
-                            boundary_fn=boundary_fn)
+                            enc_remat_flags=enc_remat_flags,
+                            boundary_fn=boundary_fn,
+                            enc_boundary_fn=enc_boundary_fn,
+                            layer_overrides=layer_overrides,
+                            enc_layer_overrides=enc_layer_overrides)
     return M.cross_entropy_loss(logits, batch["labels"],
                                 batch.get("loss_mask"))

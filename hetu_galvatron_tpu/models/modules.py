@@ -75,7 +75,10 @@ def init_norm(cfg: ModelArgs) -> Tuple[Params, Axes]:
 
 def apply_norm(p: Params, x: jax.Array, cfg: ModelArgs) -> jax.Array:
     """RMSNorm or LayerNorm, computed in fp32 regardless of activation dtype
-    (matches the reference's fp32 norm path, norm.py:6)."""
+    (matches the reference's fp32 norm path, norm.py:6). Empty params =
+    identity (post-norm families have no final pre-head norm)."""
+    if not p:
+        return x
     dtype = x.dtype
     x = x.astype(jnp.float32)
     if cfg.normalization == "rmsnorm":
@@ -237,6 +240,7 @@ def init_mlp(key: jax.Array, cfg: ModelArgs,
 
 _ACTS = {
     "gelu": partial(jax.nn.gelu, approximate=True),
+    "gelu_exact": partial(jax.nn.gelu, approximate=False),  # HF BERT erf gelu
     "relu": jax.nn.relu,
     "silu": jax.nn.silu,
     "swiglu": jax.nn.silu,  # gate activation
@@ -297,6 +301,19 @@ def apply_decoder_layer(
     model family."""
     if causal is None:
         causal = cfg.model_type != "bert"
+    if cfg.post_norm:
+        # HF BertLayer: residual-then-norm (attention.output.LayerNorm,
+        # output.LayerNorm)
+        x = apply_norm(
+            p["ln1"],
+            x + apply_attention(p["attn"], x, cfg, rope=rope,
+                                sdpa_fn=sdpa_fn,
+                                compute_dtype=compute_dtype, causal=causal),
+            cfg)
+        return apply_norm(
+            p["ln2"],
+            x + apply_mlp(p["mlp"], x, cfg, compute_dtype=compute_dtype),
+            cfg)
     h = apply_norm(p["ln1"], x, cfg)
     x = x + apply_attention(p["attn"], h, cfg, rope=rope, sdpa_fn=sdpa_fn,
                             compute_dtype=compute_dtype, causal=causal)
@@ -317,6 +334,13 @@ def init_embedding(key: jax.Array, cfg: ModelArgs) -> Tuple[Params, Axes]:
     if cfg.position_embedding_type == "learned":
         p["wpe"] = _normal(k2, (cfg.max_position_embeddings, cfg.hidden_size), 0.02)
         a["wpe"] = ("pos", "embed")
+    if cfg.post_norm:
+        # HF BertEmbeddings applies LayerNorm after summing the tables;
+        # token-type embeddings (single-segment type 0) are folded into wpe
+        # by the HF converter (runtime/checkpoint.py)
+        ln_p, ln_a = init_norm(cfg)
+        p["ln"] = ln_p
+        a["ln"] = ln_a
     return p, a
 
 
@@ -326,10 +350,31 @@ def apply_embedding(p: Params, tokens: jax.Array, cfg: ModelArgs,
     if "wpe" in p:
         S = tokens.shape[1]
         x = x + p["wpe"][:S][None, :, :]
+    if "ln" in p:
+        x = apply_norm(p["ln"], x, cfg)
     return x.astype(compute_dtype)
 
 
 def init_lm_head(key: jax.Array, cfg: ModelArgs) -> Tuple[Params, Axes]:
+    if cfg.model_type == "bert":
+        # HF BertLMPredictionHead: dense -> act -> LayerNorm -> (tied)
+        # decoder + vocab bias (cls.predictions.*)
+        k1, k2 = jax.random.split(key)
+        ln_p, ln_a = init_norm(cfg)
+        p: Params = {"wt": _normal(k1, (cfg.hidden_size, cfg.hidden_size), 0.02),
+                     "bt": jnp.zeros((cfg.hidden_size,), jnp.float32),
+                     "ln": ln_p,
+                     "bias": jnp.zeros((cfg.padded_vocab_size,), jnp.float32)}
+        # wt stays un-TP-sharded ("pos" = neutral axis): the transform is one
+        # [H,H] matmul whose output feeds a full-width LayerNorm — TP-sharding
+        # it would force an all-gather straight after
+        a: Axes = {"wt": ("pos", "embed"), "bt": ("embed",),
+                   "ln": ln_a, "bias": ("vocab",)}
+        if not cfg.tie_word_embeddings:
+            p["whead"] = _normal(k2, (cfg.hidden_size, cfg.padded_vocab_size),
+                                 0.02)
+            a["whead"] = ("embed", "vocab")
+        return p, a
     if cfg.tie_word_embeddings:
         return {}, {}
     return (
@@ -346,11 +391,24 @@ def apply_lm_head(
     compute_dtype=jnp.bfloat16,
 ) -> jax.Array:
     """Returns fp32 logits [B, S, V]; tied weights reuse the embedding table
-    (reference GalvatronCausalLMHead, modules.py:316-339)."""
-    w = p["whead"] if not cfg.tie_word_embeddings else wte.T
-    return jnp.einsum("bsh,hv->bsv", x.astype(compute_dtype),
-                      w.astype(compute_dtype),
-                      preferred_element_type=jnp.float32)
+    (reference GalvatronCausalLMHead, modules.py:316-339). The bert path
+    runs the HF MLM transform (dense -> act -> LN) and adds the vocab bias.
+    A params tree that carries ``whead`` uses it even when the config says
+    tied — the pipeline engine's last stage holds the transposed tied copy
+    instead of a wte reference (runtime/pipeline.py split_params)."""
+    if "wt" in p:
+        x = jnp.einsum("bsh,hk->bsk", x.astype(compute_dtype),
+                       p["wt"].astype(compute_dtype),
+                       preferred_element_type=jnp.float32) + p["bt"]
+        x = apply_norm(p["ln"], _ACTS[cfg.hidden_act](x), cfg)
+        x = x.astype(compute_dtype)
+    w = p["whead"] if "whead" in p else wte.T
+    logits = jnp.einsum("bsh,hv->bsv", x.astype(compute_dtype),
+                        w.astype(compute_dtype),
+                        preferred_element_type=jnp.float32)
+    if "bias" in p:
+        logits = logits + p["bias"]
+    return logits
 
 
 def cross_entropy_loss(

@@ -1,23 +1,36 @@
-"""Mixture-of-Experts layer: top-k router + expert-parallel grouped MLPs.
+"""Mixture-of-Experts layer: routers + token dispatchers + grouped MLPs.
 
 Capability parity with the reference MoE runtime (runtime/moe/router.py:98
-``TopKRouter`` with aux/z-losses, token_dispatcher.py:116/287/942 dispatchers,
-mlp.py:26 ``GroupedMLP``, moe_utils.py:166 aux-loss scaling): a softmax top-k
-router with load-balancing and router-z losses, capacity-bounded token
-dispatch, and per-expert MLPs evaluated as one grouped einsum.
+``TopKRouter`` with aux/z-losses, sinkhorn load balancing and the
+aux-loss-free expert-bias correction; token_dispatcher.py:116/287/942
+allgather/alltoall/flex dispatchers; mlp.py:26 ``GroupedMLP``;
+moe_utils.py:166 aux-loss scaling).
 
-TPU-first: instead of permute/unpermute kernels + all-to-all dispatchers,
-dispatch/combine are one-hot einsums (the GShard formulation) — XLA lowers
-them to gather/scatter fused with the expert matmuls, and sharding the
-``expert`` axis over the ep mesh axes makes GSPMD insert the token
-all-to-alls the reference issues by hand. Over-capacity tokens are dropped
-(weights renormalized), the standard capacity-factor treatment.
+TPU-first: two dispatch formulations replace the reference's three torch
+dispatchers —
+
+* ``capacity`` (GShard one-hot einsums): dispatch/combine are dense einsums
+  over a fixed per-expert capacity; sharding the ``expert`` axis over the ep
+  mesh axes makes GSPMD insert the token all-to-alls the reference issues by
+  hand. Over-capacity tokens are dropped (weights renormalized). This is the
+  expert-parallel mode — every shape is static and ep/etp-shardable.
+* ``dropless`` (sort + ``lax.ragged_dot``): token slots are sorted by expert
+  and the expert MLPs run as grouped ragged matmuls — no token is ever
+  dropped and no capacity buffer is materialized (the reference's alltoall
+  dropless dispatcher, token_dispatcher.py:287). Static [T*K] shapes keep it
+  jit-clean; HF Mixtral numerics reproduce exactly (see
+  tests/models/test_moe.py Mixtral parity).
+
+Routers: softmax top-k (optionally with the DeepSeek-style expert-bias
+selection correction, reference router.py expert_bias) and sinkhorn load
+balancing (selection via a no-grad sinkhorn normalization, weights via
+sigmoid/softmax of the raw logits — reference sinkhorn_load_balancing).
 """
 
 from __future__ import annotations
 
 import math
-from typing import Any, Dict, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -37,11 +50,114 @@ def is_moe_layer(cfg: ModelArgs, layer_idx: int) -> bool:
     return (layer_idx + 1) % freq == 0
 
 
-def moe_capacity(cfg: ModelArgs, tokens: int, capacity_factor: float = 1.25
-                 ) -> int:
+def moe_capacity(cfg: ModelArgs, tokens: int,
+                 capacity_factor: Optional[float] = None) -> int:
     """Per-expert token capacity (reference capacity-factor dispatch)."""
+    cf = capacity_factor if capacity_factor is not None \
+        else cfg.moe_capacity_factor
     return max(int(math.ceil(tokens * cfg.moe_topk / cfg.num_experts
-                             * capacity_factor)), cfg.moe_topk)
+                             * cf)), cfg.moe_topk)
+
+
+def sinkhorn(logits: jax.Array, n_iters: int = 8) -> jax.Array:
+    """Sinkhorn normalization of a [T, E] score matrix (reference
+    moe_utils.sinkhorn, fixed iteration count for jit)."""
+    cost = jnp.exp(logits.astype(jnp.float32))
+    T, E = cost.shape
+    d1 = jnp.ones((E,), jnp.float32)
+
+    def body(_, d1):
+        d0 = 1.0 / T / jnp.maximum((cost * d1[None, :]).sum(-1), 1e-9)
+        return 1.0 / E / jnp.maximum((cost * d0[:, None]).sum(0), 1e-9)
+
+    d1 = jax.lax.fori_loop(0, n_iters, body, d1)
+    d0 = 1.0 / T / jnp.maximum((cost * d1[None, :]).sum(-1), 1e-9)
+    return d0[:, None] * cost * d1[None, :]
+
+
+def route_tokens(
+    p: Params, xt: jax.Array, cfg: ModelArgs, compute_dtype=jnp.bfloat16
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Router: [T, H] tokens -> (topk_idx [T,K] int, weights [T,K] fp32,
+    aux_loss scalar).
+
+    topk: softmax probs; selection optionally corrected by a no-grad expert
+    bias (p["expert_bias"], reference moe_router_enable_expert_bias — the
+    bias steers WHICH experts are picked, never the combine weights);
+    weights renormalized over the selected k (HF Mixtral convention).
+    sinkhorn: selection from a no-grad sinkhorn normalization; weights are
+    sigmoid (k=1) / softmax (k>1) of the raw logits (reference
+    sinkhorn_load_balancing; aux loss unsupported there)."""
+    E, K = cfg.num_experts, cfg.moe_topk
+    router_dtype = jnp.float32 if cfg.moe_router_dtype == "float32" \
+        else compute_dtype
+    logits = jnp.einsum("th,he->te", xt.astype(router_dtype),
+                        p["router"].astype(router_dtype),
+                        preferred_element_type=jnp.float32)
+
+    if cfg.moe_router_type == "sinkhorn":
+        if cfg.moe_aux_loss_coeff:
+            raise ValueError(
+                "sinkhorn routing does not support the aux loss "
+                "(reference router.py:158); set moe_aux_loss_coeff=0")
+        norm = jax.lax.stop_gradient(sinkhorn(logits))
+        _, topk_idx = jax.lax.top_k(norm, K)
+        scores = (jax.nn.sigmoid(logits) if K == 1
+                  else jax.nn.softmax(logits, axis=-1))
+        w = jnp.take_along_axis(scores, topk_idx, axis=-1)
+        aux = jnp.zeros((), jnp.float32)
+        if cfg.moe_z_loss_coeff:
+            z = jax.scipy.special.logsumexp(logits, axis=-1)
+            aux = cfg.moe_z_loss_coeff * jnp.mean(jnp.square(z))
+        return topk_idx, w.astype(jnp.float32), aux
+
+    probs = jax.nn.softmax(logits, axis=-1)  # [T, E]
+    select_scores = probs
+    if "expert_bias" in p:
+        select_scores = probs + jax.lax.stop_gradient(p["expert_bias"])
+    _, topk_idx = jax.lax.top_k(select_scores, K)
+    bias_term = None
+    if "expert_bias" in p:
+        # aux-loss-free maintenance, routed THROUGH the gradient: this term
+        # has value 0 but d/d(expert_bias) = -update, and the optimizer
+        # applies plain SGD(lr=1) to expert_bias paths
+        # (runtime/optimizer.py partition), so bias_new = bias + update —
+        # the reference's buffer update (router.py:116) without mutating
+        # state inside a pure function. stop_gradient everywhere else keeps
+        # the model's real gradients untouched.
+        counts = jnp.sum(jax.nn.one_hot(topk_idx, E, dtype=jnp.float32),
+                         axis=(0, 1))
+        update = update_expert_bias(jnp.zeros((E,), jnp.float32), counts,
+                                    cfg.moe_expert_bias_update_rate)
+        term = jnp.sum(jax.lax.stop_gradient(-update) * p["expert_bias"])
+        bias_term = term - jax.lax.stop_gradient(term)
+    topk_probs = jnp.take_along_axis(probs, topk_idx, axis=-1)
+    # renormalize over the selected k (HF Mixtral convention; the reference's
+    # moe_router_topk_scaling path covers the same role)
+    topk_probs = topk_probs / jnp.maximum(
+        jnp.sum(topk_probs, axis=-1, keepdims=True), 1e-9)
+
+    # aux losses (reference router.py aux/z-loss; moe_utils.py:166 scaling)
+    sel = jax.nn.one_hot(topk_idx, E, dtype=jnp.float32)  # [T, K, E]
+    frac_tokens = jnp.mean(jnp.sum(sel, axis=1), axis=0)  # f_e
+    frac_probs = jnp.mean(probs, axis=0)  # P_e
+    aux = cfg.moe_aux_loss_coeff * E * jnp.sum(frac_tokens * frac_probs)
+    if cfg.moe_z_loss_coeff:
+        z = jax.scipy.special.logsumexp(logits, axis=-1)
+        aux = aux + cfg.moe_z_loss_coeff * jnp.mean(jnp.square(z))
+    if bias_term is not None:
+        aux = aux + bias_term  # value 0; carries the bias-maintenance grad
+    return topk_idx, topk_probs.astype(jnp.float32), aux
+
+
+def update_expert_bias(expert_bias: jax.Array, tokens_per_expert: jax.Array,
+                       update_rate: float = 1e-3) -> jax.Array:
+    """Aux-loss-free balancing step (reference expert-bias maintenance):
+    nudge under-loaded experts' selection bias up, over-loaded down. The
+    trainer calls this outside the gradient path with the batch's per-expert
+    token counts."""
+    err = jnp.mean(tokens_per_expert) - tokens_per_expert
+    return expert_bias + update_rate * jnp.sign(err)
 
 
 def init_moe_mlp(key: jax.Array, cfg: ModelArgs) -> Tuple[Params, Params]:
@@ -67,59 +183,50 @@ def init_moe_mlp(key: jax.Array, cfg: ModelArgs) -> Tuple[Params, Params]:
                             ffn_dim=f * cfg.num_shared_experts)
         p["shared"] = sp
         a["shared"] = sa
+    if cfg.moe_router_enable_expert_bias:
+        # selection-only bias, updated outside the gradient path via
+        # update_expert_bias (reference expert_bias buffer, router.py:116)
+        p["expert_bias"] = jnp.zeros((e,), jnp.float32)
+        a["expert_bias"] = ("expert_out",)
     return p, a
 
 
-def apply_moe_mlp(
-    p: Params,
-    x: jax.Array,
-    cfg: ModelArgs,
-    compute_dtype=jnp.bfloat16,
-    capacity_factor: float = 1.25,
-) -> Tuple[jax.Array, jax.Array]:
-    """x [B,S,H] -> (y [B,S,H], aux_loss scalar).
+def _expert_act(hproj: jax.Array, cfg: ModelArgs,
+                compute_dtype=jnp.bfloat16) -> jax.Array:
+    hproj = hproj.astype(compute_dtype)
+    act = M._ACTS[cfg.hidden_act]
+    if M._is_gated(cfg.hidden_act):
+        gate, up = jnp.split(hproj, 2, axis=-1)
+        return act(gate) * up
+    return act(hproj)
 
-    aux_loss = load-balancing loss (num_experts * sum_e f_e * P_e, Switch
-    formulation — reference router.py aux_loss) + z-loss on router logits.
-    """
-    B, S, H = x.shape
+
+def _capacity_dispatch(
+    p: Params, xt: jax.Array, topk_idx: jax.Array, w: jax.Array,
+    cfg: ModelArgs, compute_dtype, capacity_factor: Optional[float],
+) -> jax.Array:
+    """GShard one-hot capacity dispatch: position of each (token, k) slot
+    within its expert's capacity buffer; over-capacity slots drop (weights
+    renormalized over the survivors)."""
+    T, _ = xt.shape
     E, K = cfg.num_experts, cfg.moe_topk
-    T = B * S
-    xt = x.reshape(T, H)
-
-    router_dtype = jnp.float32 if cfg.moe_router_dtype == "float32" \
-        else compute_dtype
-    logits = jnp.einsum("th,he->te", xt.astype(router_dtype),
-                        p["router"].astype(router_dtype),
-                        preferred_element_type=jnp.float32)
-    probs = jax.nn.softmax(logits, axis=-1)  # [T, E]
-
-    topk_probs, topk_idx = jax.lax.top_k(probs, K)  # [T, K]
-
-    # aux losses (reference router.py aux/z-loss; moe_utils.py:166 scaling)
-    sel = jax.nn.one_hot(topk_idx, E, dtype=jnp.float32)  # [T, K, E]
-    frac_tokens = jnp.mean(jnp.sum(sel, axis=1), axis=0)  # f_e
-    frac_probs = jnp.mean(probs, axis=0)  # P_e
-    aux = cfg.moe_aux_loss_coeff * E * jnp.sum(frac_tokens * frac_probs)
-    if cfg.moe_z_loss_coeff:
-        z = jax.scipy.special.logsumexp(logits, axis=-1)
-        aux = aux + cfg.moe_z_loss_coeff * jnp.mean(jnp.square(z))
-
-    # capacity-bounded dispatch (GShard): position of each (token, k) slot
-    # within its expert's capacity buffer
     C = moe_capacity(cfg, T, capacity_factor)
+    sel = jax.nn.one_hot(topk_idx, E, dtype=jnp.float32)  # [T, K, E]
     flat_sel = sel.reshape(T * K, E)
     pos = jnp.cumsum(flat_sel, axis=0) * flat_sel - 1.0  # [T*K, E]
     in_cap = (pos >= 0) & (pos < C)
     pos_oh = jax.nn.one_hot(pos, C, dtype=jnp.float32) * \
         in_cap[..., None]  # [T*K, E, C]
     dispatch = pos_oh.reshape(T, K, E, C).sum(axis=1)  # [T, E, C]
-    # renormalize over the slots that survived capacity, so a token whose
-    # top expert overflowed still gets a unit-sum combine weight
+    # redistribute dropped slots' weight over the survivors, preserving the
+    # token's total combine weight (for the renormalized topk router this is
+    # the reference's renormalize-over-survivors; sinkhorn scales survive
+    # unchanged when nothing drops)
     kept = (flat_sel * in_cap.astype(jnp.float32)).sum(-1).reshape(T, K)
-    w = topk_probs.astype(jnp.float32) * kept
-    w = w / jnp.maximum(jnp.sum(w, axis=-1, keepdims=True), 1e-9)
-    combine = jnp.einsum("tkec,tk->tec", pos_oh.reshape(T, K, E, C), w)
+    wk = w * kept
+    wk = wk * (jnp.sum(w, axis=-1, keepdims=True)
+               / jnp.maximum(jnp.sum(wk, axis=-1, keepdims=True), 1e-9))
+    combine = jnp.einsum("tkec,tk->tec", pos_oh.reshape(T, K, E, C), wk)
 
     # expert compute: [E, C, H] -> [E, C, F] -> [E, C, H]
     xe = jnp.einsum("tec,th->ech", dispatch.astype(compute_dtype),
@@ -127,19 +234,66 @@ def apply_moe_mlp(
                     preferred_element_type=jnp.float32).astype(compute_dtype)
     hproj = jnp.einsum("ech,ehf->ecf", xe, p["win"].astype(compute_dtype),
                        preferred_element_type=jnp.float32)
-    hproj = hproj.astype(compute_dtype)
-    act = M._ACTS[cfg.hidden_act]
-    if M._is_gated(cfg.hidden_act):
-        gate, up = jnp.split(hproj, 2, axis=-1)
-        hproj = act(gate) * up
-    else:
-        hproj = act(hproj)
+    hproj = _expert_act(hproj, cfg, compute_dtype)
     ye = jnp.einsum("ecf,efh->ech", hproj, p["wout"].astype(compute_dtype),
                     preferred_element_type=jnp.float32)
-    y = jnp.einsum("tec,ech->th", combine.astype(compute_dtype),
-                   ye.astype(compute_dtype),
-                   preferred_element_type=jnp.float32)
+    return jnp.einsum("tec,ech->th", combine.astype(compute_dtype),
+                      ye.astype(compute_dtype),
+                      preferred_element_type=jnp.float32)
 
+
+def _dropless_dispatch(
+    p: Params, xt: jax.Array, topk_idx: jax.Array, w: jax.Array,
+    cfg: ModelArgs, compute_dtype,
+) -> jax.Array:
+    """Dropless grouped-matmul dispatch (reference alltoall dropless
+    dispatcher, token_dispatcher.py:287, re-designed for XLA): the [T*K]
+    token slots sort by expert id (stable, so intra-expert order is token
+    order), the expert MLPs run as ``lax.ragged_dot`` grouped matmuls over
+    the sorted buffer, and a scatter-add combines weighted outputs. Every
+    shape is static; no token is dropped; renormalized top-k weights make
+    HF Mixtral numerics exact."""
+    T, H = xt.shape
+    E, K = cfg.num_experts, cfg.moe_topk
+    eid = topk_idx.reshape(T * K)
+    order = jnp.argsort(eid, stable=True)
+    tok = jnp.arange(T * K, dtype=jnp.int32) // K  # slot -> token
+    tok_sorted = tok[order]
+    xs = xt[tok_sorted].astype(compute_dtype)  # [T*K, H]
+    group_sizes = jnp.bincount(eid, length=E).astype(jnp.int32)
+    hproj = jax.lax.ragged_dot(xs, p["win"].astype(compute_dtype),
+                               group_sizes,
+                               preferred_element_type=jnp.float32)
+    hproj = _expert_act(hproj, cfg, compute_dtype)
+    ys = jax.lax.ragged_dot(hproj, p["wout"].astype(compute_dtype),
+                            group_sizes,
+                            preferred_element_type=jnp.float32)
+    ws = w.reshape(T * K)[order]
+    return jnp.zeros((T, H), jnp.float32).at[tok_sorted].add(
+        ys * ws[:, None])
+
+
+def apply_moe_mlp(
+    p: Params,
+    x: jax.Array,
+    cfg: ModelArgs,
+    compute_dtype=jnp.bfloat16,
+    capacity_factor: Optional[float] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """x [B,S,H] -> (y [B,S,H], aux_loss scalar).
+
+    Router per ``cfg.moe_router_type`` (see :func:`route_tokens`), dispatch
+    per ``cfg.moe_dispatcher``: "capacity" (GShard, ep-shardable) or
+    "dropless" (ragged grouped matmuls, exact numerics).
+    """
+    B, S, H = x.shape
+    xt = x.reshape(B * S, H)
+    topk_idx, w, aux = route_tokens(p, xt, cfg, compute_dtype)
+    if cfg.moe_dispatcher == "dropless":
+        y = _dropless_dispatch(p, xt, topk_idx, w, cfg, compute_dtype)
+    else:
+        y = _capacity_dispatch(p, xt, topk_idx, w, cfg, compute_dtype,
+                               capacity_factor)
     if "shared" in p:
         y = y + M.apply_mlp(p["shared"], xt[None], cfg,
                             compute_dtype=compute_dtype)[0]

@@ -361,7 +361,9 @@ def make_flash_sdpa(mesh, dp_axes=(), tp_axes=(), *, interpret: bool = False):
     def sdpa(q, k, v, *, causal=True):
         S = q.shape[1]
         bq = min(256, S)
-        if S % bq:  # shapes the kernel can't tile: use the XLA core
+        # shapes the kernel can't tile (non-block-divisible sequence, or
+        # cross-attention with different q/kv lengths): use the XLA core
+        if S % bq or k.shape[1] != S:
             from hetu_galvatron_tpu.models.modules import xla_sdpa
 
             return xla_sdpa(q, k, v, causal=causal)

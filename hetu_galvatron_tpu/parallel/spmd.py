@@ -56,12 +56,14 @@ def param_specs(
     vocab: LayerSharding,
     *,
     opt: bool = False,
+    enc_per_layer: Optional[List[LayerSharding]] = None,
 ) -> Params:
     """PartitionSpec pytree mirroring the params tree: decoder layers use
     their own sharding, embed/prenorm/head use the vocab sharding (reference
     whole-model rows, hybrid_parallel_config.py:276-293). Encoder-decoder
-    models (t5) shard the encoder stack with the first decoder strategy
-    (per-layer heterogeneous encoder plans are a search-side extension)."""
+    models (t5) shard each encoder layer with its own strategy from the
+    combined-stack plan (``enc_per_layer``); legacy callers that pass only
+    decoder shardings fall back to cloning the first decoder strategy."""
     out = {
         "embed": _spec_tree(axes_tree["embed"], vocab, opt),
         "layers": tuple(
@@ -71,9 +73,11 @@ def param_specs(
         "head": _spec_tree(axes_tree["head"], vocab, opt),
     }
     if "enc_layers" in axes_tree:
+        enc = (enc_per_layer if enc_per_layer is not None
+               else [per_layer[0]] * len(axes_tree["enc_layers"]))
         out["enc_layers"] = tuple(
-            _spec_tree(a, per_layer[0], opt)
-            for a in axes_tree["enc_layers"])
+            _spec_tree(a, sh, opt)
+            for a, sh in zip(axes_tree["enc_layers"], enc))
         out["enc_norm"] = _spec_tree(axes_tree["enc_norm"], vocab, opt)
     return out
 
@@ -113,12 +117,20 @@ def attention_overrides(
     mesh: Mesh,
     *,
     use_flash: Optional[bool] = None,
+    with_cross: bool = False,
 ) -> Dict[int, Dict[str, Any]]:
     """Per-layer attention-impl dispatch (reference attention.py:664-720):
     cp > 1 layers swap in the ring-attention kernel over their cp axes;
     other layers get the Pallas flash kernel on TPU (``use_flash`` defaults
     to platform == tpu); everything else keeps the XLA core (GSPMD inserts
-    the collectives)."""
+    the collectives).
+
+    ``with_cross=True`` (t5 decoder layers) also sets ``cross_sdpa_fn``:
+    ring layers pin cross-attention to the XLA core (the ring kernel needs
+    equal q/kv sequence lengths; GSPMD all-gathers the encoder memory over
+    the cp axes instead), while flash layers reuse the flash kernel, which
+    handles causal=False and falls back internally on mismatched lengths."""
+    from hetu_galvatron_tpu.models.modules import xla_sdpa
     from hetu_galvatron_tpu.ops.ring_attention import make_ring_sdpa
 
     if use_flash is None:
@@ -129,6 +141,8 @@ def attention_overrides(
         if sh.cp_axes:
             out[i] = {"sdpa_fn": make_ring_sdpa(
                 mesh, sh.cp_axes, dp_axes=sh.dp_axes, tp_axes=sh.tp_axes)}
+            if with_cross:
+                out[i]["cross_sdpa_fn"] = xla_sdpa
         elif use_flash:
             from hetu_galvatron_tpu.ops.pallas.flash_attention import (
                 make_flash_sdpa,
@@ -183,33 +197,36 @@ def make_spmd_train_step(
     compute_dtype=jnp.bfloat16,
     layer_overrides: Optional[Dict[int, Dict[str, Any]]] = None,
     donate: bool = True,
+    chunks: Optional[int] = None,
 ):
     """Build the jitted hybrid-parallel train step (no pipeline; pp=1).
 
     Returns (train_step, pspecs, opt_specs, batch_shd). The caller places
     params/opt_state with :func:`shard_params` and feeds batches laid out by
     ``batch_shd``. The pipeline engine (pp>1) wraps this per-stage.
+    ``chunks`` overrides the plan's microbatch count (batch-size ramp:
+    the launcher rebuilds the step per chunk count at a fixed micro size).
     """
     if hpc.pp_deg != 1:
         raise ValueError("make_spmd_train_step is the pp=1 path; use the "
                          "pipeline engine for pp>1")
-    per_layer, vocab = layer_shardings(hpc, mesh)
-    pspecs = param_specs(axes_tree, per_layer, vocab)
-    opt_pspecs = param_specs(axes_tree, per_layer, vocab, opt=True)
+    per_layer_all, vocab = layer_shardings(hpc, mesh)
+    n_enc = hpc.num_encoder_layers
+    enc_per, per_layer = per_layer_all[:n_enc], per_layer_all[n_enc:]
+    pspecs = param_specs(axes_tree, per_layer, vocab,
+                         enc_per_layer=enc_per or None)
+    opt_pspecs = param_specs(axes_tree, per_layer, vocab, opt=True,
+                             enc_per_layer=enc_per or None)
     opt_specs = opt_state_specs(tx, params, opt_pspecs)
     boundary = make_boundary_fn(per_layer, vocab, mesh)
-    # t5 stacks do not take per-layer attention overrides yet (encdec_loss
-    # would reject them); they run the XLA core under GSPMD
-    if cfg.model_type == "t5":
-        if cfg.use_flash_attn and all(
-                d.platform == "tpu" for d in mesh.devices.flat[:1]):
-            print("warning: flash attention is not wired into the t5 "
-                  "stacks; running the XLA attention core")
-        ring = {}
-    else:
-        ring = attention_overrides(
-            per_layer, mesh,
-            use_flash=None if cfg.use_flash_attn else False)
+    enc_boundary = (make_boundary_fn(enc_per, vocab, mesh)
+                    if enc_per else None)
+    use_flash = None if cfg.use_flash_attn else False
+    ring = attention_overrides(
+        per_layer, mesh, use_flash=use_flash,
+        with_cross=cfg.model_type == "t5")
+    enc_overrides = (attention_overrides(enc_per, mesh, use_flash=use_flash)
+                     if enc_per else None)
     if ring:
         # per-key merge: a caller override on a cp layer must not drop the
         # ring sdpa_fn unless it sets sdpa_fn itself
@@ -218,14 +235,25 @@ def make_spmd_train_step(
             merged[i] = {**kw, **merged.get(i, {})}
         layer_overrides = merged
     remat = [sh.checkpoint for sh in per_layer]
+    enc_remat = [sh.checkpoint for sh in enc_per]
     batch_shd = batch_sharding(per_layer, mesh)
-    chunks = max(hpc.chunks, 1)
+    chunks = max(chunks if chunks is not None else hpc.chunks, 1)
+
+    enc_kwargs = {}
+    if cfg.model_type == "t5":
+        # always pass the explicit per-layer list: None would trigger the
+        # legacy clone-remat_flags[0] fallback in forward_encdec
+        enc_kwargs = dict(
+            enc_remat_flags=enc_remat,
+            enc_layer_overrides=enc_overrides,
+            enc_boundary_fn=enc_boundary)
 
     def loss_fn(p, batch):
         return causal_lm_loss(
             p, batch, cfg, compute_dtype=compute_dtype,
             remat_flags=remat if any(remat) else None,
-            layer_overrides=layer_overrides, boundary_fn=boundary)
+            layer_overrides=layer_overrides, boundary_fn=boundary,
+            **enc_kwargs)
 
     step = make_train_step(loss_fn, tx, chunks=chunks)
 

@@ -37,7 +37,8 @@ def _plan_fingerprint(hpc) -> Dict[str, Any]:
         hpc.layers, global_bsz=hpc.global_bsz, chunks=hpc.chunks,
         pipeline_type=hpc.pipeline_type,
         default_dp_type=hpc.default_dp_type.short, vocab=hpc.vocab,
-        pp_division=hpc.pp_division)
+        pp_division=hpc.pp_division,
+        num_encoder_layers=hpc.num_encoder_layers or None)
     cfg["world_size"] = hpc.world_size
     return cfg
 
@@ -163,13 +164,24 @@ def hf_to_params(state_dict: Dict[str, Any], cfg: ModelArgs) -> Params:
         if pad > 0:
             wte = np.concatenate([wte, np.zeros((pad, wte.shape[1]),
                                                 wte.dtype)])
+        # HF gpt2 always ties lm_head to wte; an untied target config needs
+        # its own whead or apply_lm_head would KeyError much later (ADVICE r2)
+        head: Params = {}
+        if not cfg.tie_word_embeddings:
+            head = {"whead": (_pad_vocab(sd["lm_head.weight"], cfg).T
+                              if "lm_head.weight" in sd else wte.T)}
         return {
             "embed": {"wte": wte, "wpe": sd["transformer.wpe.weight"]},
             "layers": tuple(layers),
             "prenorm": {"scale": sd["transformer.ln_f.weight"],
                         "bias": sd["transformer.ln_f.bias"]},
-            "head": {},
+            "head": head,
         }
+
+    if cfg.model_type == "bert" or "bert.embeddings.word_embeddings.weight" in sd:
+        return _bert_hf_to_params(sd, cfg)
+    if cfg.model_type == "t5" or "encoder.final_layer_norm.weight" in sd:
+        return _t5_hf_to_params(sd, cfg)
 
     # llama-family: torch Linear stores [out, in] -> transpose
     def lin(name):
@@ -247,12 +259,278 @@ def hf_to_params(state_dict: Dict[str, Any], cfg: ModelArgs) -> Params:
     return out
 
 
+def _pad_vocab(w: "np.ndarray", cfg: ModelArgs) -> "np.ndarray":
+    import numpy as np
+
+    pad = cfg.padded_vocab_size - w.shape[0]
+    if pad > 0:
+        w = np.concatenate(
+            [w, np.zeros((pad,) + w.shape[1:], w.dtype)])
+    return w
+
+
+def _bert_hf_to_params(sd: Dict[str, Any], cfg: ModelArgs) -> Params:
+    """HF BertForMaskedLM -> our post-norm encoder layout (reference
+    tools/checkpoint_convert_h2g.py bert path). Token-type embeddings are
+    folded into wpe for single-segment (type-0) training — the parallelism
+    framework trains MLM on single segments (runtime/dataloader.py
+    mlm_batches)."""
+    import numpy as np
+
+    def lin(name):
+        return sd[name].T
+
+    n = cfg.num_hidden_layers
+    layers = []
+    for i in range(n):
+        pre = f"bert.encoder.layer.{i}."
+        wqkv = np.concatenate(
+            [lin(pre + "attention.self.query.weight"),
+             lin(pre + "attention.self.key.weight"),
+             lin(pre + "attention.self.value.weight")], axis=1)
+        bqkv = np.concatenate(
+            [sd[pre + "attention.self.query.bias"],
+             sd[pre + "attention.self.key.bias"],
+             sd[pre + "attention.self.value.bias"]])
+        layers.append({
+            "attn": {"wqkv": wqkv, "bqkv": bqkv,
+                     "wo": lin(pre + "attention.output.dense.weight"),
+                     "bo": sd[pre + "attention.output.dense.bias"]},
+            "ln1": {"scale": sd[pre + "attention.output.LayerNorm.weight"],
+                    "bias": sd[pre + "attention.output.LayerNorm.bias"]},
+            "mlp": {"win": lin(pre + "intermediate.dense.weight"),
+                    "bin": sd[pre + "intermediate.dense.bias"],
+                    "wout": lin(pre + "output.dense.weight"),
+                    "bout": sd[pre + "output.dense.bias"]},
+            "ln2": {"scale": sd[pre + "output.LayerNorm.weight"],
+                    "bias": sd[pre + "output.LayerNorm.bias"]},
+        })
+    wte = _pad_vocab(sd["bert.embeddings.word_embeddings.weight"], cfg)
+    wpe = (sd["bert.embeddings.position_embeddings.weight"]
+           + sd["bert.embeddings.token_type_embeddings.weight"][0][None, :])
+    head: Params = {
+        "wt": lin("cls.predictions.transform.dense.weight"),
+        "bt": sd["cls.predictions.transform.dense.bias"],
+        "ln": {"scale": sd["cls.predictions.transform.LayerNorm.weight"],
+               "bias": sd["cls.predictions.transform.LayerNorm.bias"]},
+        "bias": _pad_vocab(sd["cls.predictions.bias"], cfg),
+    }
+    if not cfg.tie_word_embeddings:
+        head["whead"] = _pad_vocab(
+            sd.get("cls.predictions.decoder.weight",
+                   sd["bert.embeddings.word_embeddings.weight"]), cfg).T
+    return {
+        "embed": {"wte": wte, "wpe": wpe,
+                  "ln": {"scale": sd["bert.embeddings.LayerNorm.weight"],
+                         "bias": sd["bert.embeddings.LayerNorm.bias"]}},
+        "layers": tuple(layers),
+        "prenorm": {},
+        "head": head,
+    }
+
+
+def _t5_hf_to_params(sd: Dict[str, Any], cfg: ModelArgs) -> Params:
+    """HF T5ForConditionalGeneration -> our encoder-decoder layout.
+
+    All projection/norm/MLP weights map 1:1 (q/k/v fused per stack; the
+    decoder's EncDecAttention becomes the fused-KV cross block). HF T5's
+    relative_attention_bias has no slot here by design — this runtime is
+    position-scheme agnostic (models/encdec.py docstring) and runs the
+    configured scheme (RoPE/learned), so imported T5 weights fine-tune
+    rather than bit-match HF generation."""
+    import numpy as np
+
+    def lin(name):
+        return sd[name].T
+
+    inner = sd["encoder.block.0.layer.0.SelfAttention.q.weight"].shape[0]
+    if inner != cfg.num_attention_heads * cfg.head_dim:
+        raise ValueError(
+            f"t5 checkpoint attention inner dim {inner} != heads*head_dim "
+            f"{cfg.num_attention_heads * cfg.head_dim}: this runtime derives "
+            "head_dim = hidden//heads (t5-small/base/large match; t5-3b/11b "
+            "use d_kv=128 and need a config with matching geometry)")
+
+    gated = "encoder.block.0.layer.1.DenseReluDense.wi_0.weight" in sd
+
+    def mlp(pre):
+        if gated:  # t5 v1.1 gated-act: wi_0 (gate) | wi_1 (up)
+            win = np.concatenate([lin(pre + "DenseReluDense.wi_0.weight"),
+                                  lin(pre + "DenseReluDense.wi_1.weight")],
+                                 axis=1)
+        else:
+            win = lin(pre + "DenseReluDense.wi.weight")
+        return {"win": win, "wout": lin(pre + "DenseReluDense.wo.weight")}
+
+    n_enc = (cfg.num_encoder_layers if cfg.num_encoder_layers is not None
+             else cfg.num_hidden_layers)
+    enc_layers = []
+    for i in range(n_enc):
+        pre = f"encoder.block.{i}."
+        wqkv = np.concatenate(
+            [lin(pre + "layer.0.SelfAttention.q.weight"),
+             lin(pre + "layer.0.SelfAttention.k.weight"),
+             lin(pre + "layer.0.SelfAttention.v.weight")], axis=1)
+        enc_layers.append({
+            "ln1": {"scale": sd[pre + "layer.0.layer_norm.weight"]},
+            "attn": {"wqkv": wqkv,
+                     "wo": lin(pre + "layer.0.SelfAttention.o.weight")},
+            "ln2": {"scale": sd[pre + "layer.1.layer_norm.weight"]},
+            "mlp": mlp(pre + "layer.1."),
+        })
+    dec_layers = []
+    for i in range(cfg.num_hidden_layers):
+        pre = f"decoder.block.{i}."
+        wqkv = np.concatenate(
+            [lin(pre + "layer.0.SelfAttention.q.weight"),
+             lin(pre + "layer.0.SelfAttention.k.weight"),
+             lin(pre + "layer.0.SelfAttention.v.weight")], axis=1)
+        wkv = np.concatenate(
+            [lin(pre + "layer.1.EncDecAttention.k.weight"),
+             lin(pre + "layer.1.EncDecAttention.v.weight")], axis=1)
+        dec_layers.append({
+            "ln1": {"scale": sd[pre + "layer.0.layer_norm.weight"]},
+            "attn": {"wqkv": wqkv,
+                     "wo": lin(pre + "layer.0.SelfAttention.o.weight")},
+            "lnx": {"scale": sd[pre + "layer.1.layer_norm.weight"]},
+            "cross": {"wq": lin(pre + "layer.1.EncDecAttention.q.weight"),
+                      "wkv": wkv,
+                      "wo": lin(pre + "layer.1.EncDecAttention.o.weight")},
+            "ln2": {"scale": sd[pre + "layer.2.layer_norm.weight"]},
+            "mlp": mlp(pre + "layer.2."),
+        })
+    out: Params = {
+        "embed": {"wte": _pad_vocab(sd["shared.weight"], cfg)},
+        "enc_layers": tuple(enc_layers),
+        "enc_norm": {"scale": sd["encoder.final_layer_norm.weight"]},
+        "layers": tuple(dec_layers),
+        "prenorm": {"scale": sd["decoder.final_layer_norm.weight"]},
+    }
+    if cfg.tie_word_embeddings:
+        out["head"] = {}
+    else:
+        out["head"] = {"whead": _pad_vocab(sd["lm_head.weight"], cfg).T}
+    return out
+
+
+def _bert_params_to_hf(params: Params, cfg: ModelArgs) -> Dict[str, "np.ndarray"]:
+    """Inverse of :func:`_bert_hf_to_params`. Token-type embeddings were
+    folded into wpe on import, so type 0 exports as zeros (wpe carries the
+    sum) — re-importing reproduces the same forward exactly."""
+    import numpy as np
+
+    get = lambda t: np.asarray(jax.device_get(t))
+    V, H = cfg.vocab_size, cfg.hidden_size
+    hd, nq, nkv = cfg.head_dim, cfg.num_attention_heads, cfg.kv_heads
+    sd: Dict[str, np.ndarray] = {
+        "bert.embeddings.word_embeddings.weight": get(params["embed"]["wte"])[:V],
+        "bert.embeddings.position_embeddings.weight": get(params["embed"]["wpe"]),
+        "bert.embeddings.token_type_embeddings.weight": np.zeros((2, H),
+                                                                 np.float32),
+        "bert.embeddings.LayerNorm.weight": get(params["embed"]["ln"]["scale"]),
+        "bert.embeddings.LayerNorm.bias": get(params["embed"]["ln"]["bias"]),
+    }
+    for i, lp in enumerate(params["layers"]):
+        pre = f"bert.encoder.layer.{i}."
+        wqkv = get(lp["attn"]["wqkv"])
+        q, k, v = np.split(wqkv, [nq * hd, (nq + nkv) * hd], axis=1)
+        bq, bk, bv = np.split(get(lp["attn"]["bqkv"]),
+                              [nq * hd, (nq + nkv) * hd])
+        sd[pre + "attention.self.query.weight"] = q.T
+        sd[pre + "attention.self.query.bias"] = bq
+        sd[pre + "attention.self.key.weight"] = k.T
+        sd[pre + "attention.self.key.bias"] = bk
+        sd[pre + "attention.self.value.weight"] = v.T
+        sd[pre + "attention.self.value.bias"] = bv
+        sd[pre + "attention.output.dense.weight"] = get(lp["attn"]["wo"]).T
+        sd[pre + "attention.output.dense.bias"] = get(lp["attn"]["bo"])
+        sd[pre + "attention.output.LayerNorm.weight"] = get(lp["ln1"]["scale"])
+        sd[pre + "attention.output.LayerNorm.bias"] = get(lp["ln1"]["bias"])
+        sd[pre + "intermediate.dense.weight"] = get(lp["mlp"]["win"]).T
+        sd[pre + "intermediate.dense.bias"] = get(lp["mlp"]["bin"])
+        sd[pre + "output.dense.weight"] = get(lp["mlp"]["wout"]).T
+        sd[pre + "output.dense.bias"] = get(lp["mlp"]["bout"])
+        sd[pre + "output.LayerNorm.weight"] = get(lp["ln2"]["scale"])
+        sd[pre + "output.LayerNorm.bias"] = get(lp["ln2"]["bias"])
+    hp = params["head"]
+    sd["cls.predictions.transform.dense.weight"] = get(hp["wt"]).T
+    sd["cls.predictions.transform.dense.bias"] = get(hp["bt"])
+    sd["cls.predictions.transform.LayerNorm.weight"] = get(hp["ln"]["scale"])
+    sd["cls.predictions.transform.LayerNorm.bias"] = get(hp["ln"]["bias"])
+    sd["cls.predictions.bias"] = get(hp["bias"])[:V]
+    if not cfg.tie_word_embeddings and "whead" in hp:
+        sd["cls.predictions.decoder.weight"] = get(hp["whead"]).T[:V]
+    return sd
+
+
+def _t5_params_to_hf(params: Params, cfg: ModelArgs) -> Dict[str, "np.ndarray"]:
+    """Inverse of :func:`_t5_hf_to_params` (gated t5-v1.1 MLP layout when the
+    model uses a gated activation)."""
+    import numpy as np
+
+    get = lambda t: np.asarray(jax.device_get(t))
+    from hetu_galvatron_tpu.models.modules import _is_gated
+
+    V = cfg.vocab_size
+    hd, nq, nkv = cfg.head_dim, cfg.num_attention_heads, cfg.kv_heads
+    sd: Dict[str, np.ndarray] = {
+        "shared.weight": get(params["embed"]["wte"])[:V],
+        "encoder.final_layer_norm.weight": get(params["enc_norm"]["scale"]),
+        "decoder.final_layer_norm.weight": get(params["prenorm"]["scale"]),
+    }
+
+    def put_mlp(pre, mp):
+        win = get(mp["win"])
+        if _is_gated(cfg.hidden_act):
+            gate, up = np.split(win, 2, axis=1)
+            sd[pre + "DenseReluDense.wi_0.weight"] = gate.T
+            sd[pre + "DenseReluDense.wi_1.weight"] = up.T
+        else:
+            sd[pre + "DenseReluDense.wi.weight"] = win.T
+        sd[pre + "DenseReluDense.wo.weight"] = get(mp["wout"]).T
+
+    def put_self_attn(pre, ap):
+        wqkv = get(ap["wqkv"])
+        q, k, v = np.split(wqkv, [nq * hd, (nq + nkv) * hd], axis=1)
+        sd[pre + "SelfAttention.q.weight"] = q.T
+        sd[pre + "SelfAttention.k.weight"] = k.T
+        sd[pre + "SelfAttention.v.weight"] = v.T
+        sd[pre + "SelfAttention.o.weight"] = get(ap["wo"]).T
+
+    for i, lp in enumerate(params["enc_layers"]):
+        pre = f"encoder.block.{i}."
+        put_self_attn(pre + "layer.0.", lp["attn"])
+        sd[pre + "layer.0.layer_norm.weight"] = get(lp["ln1"]["scale"])
+        sd[pre + "layer.1.layer_norm.weight"] = get(lp["ln2"]["scale"])
+        put_mlp(pre + "layer.1.", lp["mlp"])
+    for i, lp in enumerate(params["layers"]):
+        pre = f"decoder.block.{i}."
+        put_self_attn(pre + "layer.0.", lp["attn"])
+        sd[pre + "layer.0.layer_norm.weight"] = get(lp["ln1"]["scale"])
+        sd[pre + "layer.1.layer_norm.weight"] = get(lp["lnx"]["scale"])
+        sd[pre + "layer.1.EncDecAttention.q.weight"] = get(lp["cross"]["wq"]).T
+        wkv = get(lp["cross"]["wkv"])
+        k, v = np.split(wkv, 2, axis=1)
+        sd[pre + "layer.1.EncDecAttention.k.weight"] = k.T
+        sd[pre + "layer.1.EncDecAttention.v.weight"] = v.T
+        sd[pre + "layer.1.EncDecAttention.o.weight"] = get(lp["cross"]["wo"]).T
+        sd[pre + "layer.2.layer_norm.weight"] = get(lp["ln2"]["scale"])
+        put_mlp(pre + "layer.2.", lp["mlp"])
+    if not cfg.tie_word_embeddings and params.get("head"):
+        sd["lm_head.weight"] = get(params["head"]["whead"]).T[:V]
+    return sd
+
+
 def params_to_hf(params: Params, cfg: ModelArgs) -> Dict[str, np.ndarray]:
     """Our params -> HF-layout numpy state dict (reference g2h converters).
     Inverse of :func:`hf_to_params`; vocab padding rows are dropped."""
     get = lambda t: np.asarray(jax.device_get(t))
     sd: Dict[str, np.ndarray] = {}
     V = cfg.vocab_size
+    if cfg.model_type == "bert":
+        return _bert_params_to_hf(params, cfg)
+    if cfg.model_type == "t5":
+        return _t5_params_to_hf(params, cfg)
     if cfg.model_type == "gpt":
         sd["transformer.wte.weight"] = get(params["embed"]["wte"])[:V]
         sd["transformer.wpe.weight"] = get(params["embed"]["wpe"])

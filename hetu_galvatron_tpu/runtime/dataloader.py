@@ -65,6 +65,7 @@ def make_mlm_batch(
     *,
     mask_prob: float = 0.15,
     mask_token: Optional[int] = None,
+    eligible: Optional[np.ndarray] = None,
 ) -> Dict[str, np.ndarray]:
     """[B, S] tokens -> BERT-style masked-LM batch: 15% of positions are
     selected (80% -> [MASK], 10% -> random token, 10% -> unchanged); labels
@@ -73,11 +74,16 @@ def make_mlm_batch(
     ``rng`` must advance between calls (the caller owns it) so each batch
     masks different positions. ``mask_token`` defaults to the top id of the
     (padded) vocab — real tokenizers should pass their [MASK] id; the padded
-    rows the vocab-size rounding adds are a safe default home for it."""
+    rows the vocab-size rounding adds are a safe default home for it.
+    ``eligible`` restricts which positions may be selected at all (the
+    loader threads eod_mask_loss through it, so eod tokens are never masked
+    or predicted)."""
     tokens = samples.astype(np.int32).copy()
     labels = samples.astype(np.int32)
     mask_token = vocab_size - 1 if mask_token is None else mask_token
     selected = rng.rand(*tokens.shape) < mask_prob
+    if eligible is not None:
+        selected &= np.asarray(eligible) > 0
     action = rng.rand(*tokens.shape)
     tokens[selected & (action < 0.8)] = mask_token
     random_ids = rng.randint(0, vocab_size, tokens.shape)
@@ -115,6 +121,7 @@ def get_data_iterator(
     (dataloader.py:462)."""
     gbs = global_batch_size or args.parallel.global_train_batch_size
     data: DataArgs = args.data
+    meta: Dict = {}
     if data.dataset == "random":
         it = synthetic_batches(args.model, gbs, seed=args.train.seed)
     elif data.dataset == "indexed":
@@ -122,17 +129,69 @@ def get_data_iterator(
 
         if not data.data_path:
             raise ValueError("data.dataset=indexed requires data.data_path")
+        meta = corpus_meta(data.data_path)
+        if meta.get("vocab_size", 0) > args.model.padded_vocab_size:
+            raise ValueError(
+                f"corpus tokenizer vocab {meta['vocab_size']} exceeds model "
+                f"padded vocab {args.model.padded_vocab_size}")
         it = indexed_batches(data.data_path, args.model.seq_length, gbs,
                              seed=args.train.seed)
+        if (data.eod_mask_loss and meta.get("eod_id") is not None
+                and args.model.model_type != "bert"):
+            # bert handles eod inside mlm_batches (the causal-shifted
+            # loss_mask here would be off by one for MLM positions)
+            it = eod_masked_batches(it, meta["eod_id"])
     else:
         raise ValueError(f"unknown dataset kind {data.dataset}")
     if args.model.model_type == "bert":
         # encoders train on the MLM objective, never the causal shift
         # (bidirectional attention would leak shifted labels)
-        return mlm_batches(it, args.model, seed=args.train.seed)
+        return mlm_batches(it, args.model, seed=args.train.seed,
+                           mask_token=meta.get("mask_id"),
+                           eod_id=(meta.get("eod_id")
+                                   if data.eod_mask_loss else None))
     if args.model.model_type == "t5":
         return seq2seq_batches(it)
     return it
+
+
+def corpus_meta(paths) -> Dict:
+    """Read the preprocess CLI's ``<prefix>.meta.json`` sidecar (tokenizer
+    geometry: vocab_size / eod_id). Multiple blended corpora must agree."""
+    import json
+    import os
+
+    paths = [paths] if isinstance(paths, str) else list(paths)
+    metas = []
+    for p in paths:
+        mp = p + ".meta.json"
+        if os.path.exists(mp):
+            with open(mp) as f:
+                metas.append(json.load(f))
+    if not metas:
+        return {}
+    first = metas[0]
+    for m in metas[1:]:
+        if (m.get("vocab_size"), m.get("eod_id")) != (
+                first.get("vocab_size"), first.get("eod_id")):
+            raise ValueError(
+                "blended corpora were tokenized with different tokenizers: "
+                f"{metas}")
+    return first
+
+
+def eod_masked_batches(it: Iterator[Dict[str, np.ndarray]], eod_id: int
+                       ) -> Iterator[Dict[str, np.ndarray]]:
+    """Zero the loss where the INPUT token is end-of-document (reference
+    eod_mask_loss, utils.py get_ltor_masks_and_position_ids): the eod
+    position would otherwise be trained to predict the NEXT document's
+    first token. Predicting eod itself (label == eod) stays in the loss —
+    the model must learn to emit it."""
+    for batch in it:
+        batch = dict(batch)
+        batch["loss_mask"] = (batch["loss_mask"]
+                              * (batch["tokens"] != eod_id))
+        yield batch
 
 
 def seq2seq_batches(it: Iterator[Dict[str, np.ndarray]]
@@ -153,7 +212,14 @@ def seq2seq_batches(it: Iterator[Dict[str, np.ndarray]]
 
 
 def mlm_batches(it: Iterator[Dict[str, np.ndarray]], model: ModelArgs,
-                seed: int) -> Iterator[Dict[str, np.ndarray]]:
+                seed: int, mask_token: Optional[int] = None,
+                eod_id: Optional[int] = None
+                ) -> Iterator[Dict[str, np.ndarray]]:
+    """``eod_id`` excludes end-of-document tokens from MLM selection (the
+    bert leg of data.eod_mask_loss — without this the flag would be a
+    silent no-op for encoders)."""
     rng = np.random.RandomState(seed + 1)
     for batch in it:
-        yield make_mlm_batch(batch["tokens"], model.padded_vocab_size, rng)
+        eligible = (batch["tokens"] != eod_id) if eod_id is not None else None
+        yield make_mlm_batch(batch["tokens"], model.padded_vocab_size, rng,
+                             mask_token=mask_token, eligible=eligible)

@@ -33,19 +33,33 @@ class HybridParallelConfig:
     """Resolved plan for the whole model (the reference's
     hybrid_parallel_configs dict, hybrid_parallel_config.py:120-139)."""
 
-    layers: List[LayerStrategy]  # one per decoder layer
+    layers: List[LayerStrategy]  # one per transformer layer (see note below)
     vocab: EmbeddingLMHeadStrategy
     pp_deg: int
-    pp_division: List[int]  # decoder layers per stage, sums to len(layers)
+    pp_division: List[int]  # layers per stage, sums to len(layers)
     chunks: int
     global_bsz: int
     pipeline_type: str
     default_dp_type: DPType
     world_size: int
+    # Encoder-decoder models (t5): ``layers`` spans the COMBINED stack —
+    # encoder layers first, then decoder layers — and this records the split
+    # point. 0 for decoder-only models. ``pp_division`` likewise divides the
+    # combined stack, so a stage may hold encoder layers, decoder layers, or
+    # the enc->dec boundary.
+    num_encoder_layers: int = 0
+
+    @property
+    def enc_strategies(self) -> List[LayerStrategy]:
+        return self.layers[:self.num_encoder_layers]
+
+    @property
+    def dec_strategies(self) -> List[LayerStrategy]:
+        return self.layers[self.num_encoder_layers:]
 
     @property
     def pp_stage_of_layer(self) -> List[int]:
-        """Decoder layer index -> pipeline stage (reference pp_ranks_enc)."""
+        """Layer index -> pipeline stage (reference pp_ranks_enc)."""
         out = []
         for stage, n in enumerate(self.pp_division):
             out.extend([stage] * n)
@@ -84,7 +98,12 @@ def get_hybrid_parallel_config(
     """GLOBAL or JSON mode -> HybridParallelConfig (reference
     get_hybrid_parallel_configs_api, hybrid_parallel_config.py:18-130)."""
     par = args.parallel
-    n_layers = args.model.num_hidden_layers
+    n_enc = 0
+    if args.model.model_type == "t5":
+        n_enc = (args.model.num_encoder_layers
+                 if args.model.num_encoder_layers is not None
+                 else args.model.num_hidden_layers)
+    n_layers = args.model.num_hidden_layers + n_enc
     use_json = par.config_mode == "json" or (
         par.galvatron_config_path not in (None, "", "None"))
 
@@ -93,7 +112,13 @@ def get_hybrid_parallel_config(
         layers, vocab, extras = config2strategy(cfg, world_size=world_size)
         if len(layers) != n_layers:
             raise ValueError(
-                f"plan has {len(layers)} layers, model has {n_layers}")
+                f"plan has {len(layers)} layers, model has {n_layers} "
+                f"(encoder {n_enc} + decoder "
+                f"{args.model.num_hidden_layers})")
+        if extras["num_encoder_layers"] not in (None, n_enc):
+            raise ValueError(
+                f"plan was searched for {extras['num_encoder_layers']} "
+                f"encoder layers, model has {n_enc}")
         pp_deg = layers[0].pp_deg
         global_bsz = extras["global_bsz"] or par.global_train_batch_size
         chunks = resolve_chunks(extras["chunks"], pp_deg, global_bsz,
@@ -146,5 +171,5 @@ def get_hybrid_parallel_config(
         layers=list(layers), vocab=vocab, pp_deg=pp_deg,
         pp_division=list(pp_division), chunks=chunks, global_bsz=global_bsz,
         pipeline_type=pipeline_type, default_dp_type=default_dp,
-        world_size=world_size,
+        world_size=world_size, num_encoder_layers=n_enc,
     )

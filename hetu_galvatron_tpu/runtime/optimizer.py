@@ -74,7 +74,13 @@ def make_optimizer(
     train: TrainArgs, params: Optional[Any] = None
 ) -> optax.GradientTransformation:
     """AdamW + global-norm clip + schedule; the returned transformation's
-    state is a pytree that the mesh layer shards per DPType (ZeRO-1/2)."""
+    state is a pytree that the mesh layer shards per DPType (ZeRO-1/2).
+
+    MoE expert-bias buffers (param paths ending in ``expert_bias``) bypass
+    the Adam chain and take plain SGD with lr=1: their "gradient" IS the
+    negated maintenance update emitted by the router
+    (models/moe.py route_tokens), so bias_new = bias + update — the
+    reference's aux-loss-free buffer update (router.py:116)."""
     schedule = make_lr_schedule(train)
     chain = []
     if train.clip_grad and train.clip_grad > 0:
@@ -89,7 +95,24 @@ def make_optimizer(
             optax.add_decayed_weights(train.weight_decay, mask=_decay_mask)
         )
     chain.append(optax.scale_by_learning_rate(schedule))
-    return optax.chain(*chain)
+    return partition_expert_bias(optax.chain(*chain))
+
+
+def partition_expert_bias(
+    adam: optax.GradientTransformation,
+) -> optax.GradientTransformation:
+    """Route ``expert_bias`` leaves to SGD(lr=1), everything else to the
+    given chain (see :func:`make_optimizer`)."""
+
+    def labels(params):
+        return jax.tree_util.tree_map_with_path(
+            lambda path, _: ("bias_buffer"
+                             if str(path[-1]).find("expert_bias") >= 0
+                             else "adam"),
+            params)
+
+    return optax.multi_transform(
+        {"adam": adam, "bias_buffer": optax.sgd(learning_rate=1.0)}, labels)
 
 
 def global_grad_norm(grads: Any) -> jax.Array:

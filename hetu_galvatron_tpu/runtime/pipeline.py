@@ -24,6 +24,15 @@ all-reduce :708-710,1042), re-designed for the single-controller JAX runtime:
   each step both copies' grads are summed across the two stages (the
   reference's finalize_wte_grads over the embedding group) and both are
   updated with identical elementwise Adam math, keeping them in sync.
+* Encoder-decoder (t5) pipelines: the combined enc+dec layer sequence is
+  stage-sliced like the reference's any-arch PipeSequential
+  (pipeline.py:1592). The inter-stage activation is a PAIR ``(a, b)``:
+  ``a`` is the encoder stream (then the encoder memory once the stage
+  holding the last encoder layer applies enc_norm) and ``b`` is the decoder
+  stream. Stage 0 embeds BOTH token streams with the shared embedding, so
+  the decoder stream rides through encoder stages as a passthrough — wte
+  gradients from both streams accumulate on stage 0 with no extra tied-copy
+  reconciliation; memory cotangents flow back through the same pair.
 """
 
 from __future__ import annotations
@@ -65,7 +74,10 @@ def _pipeline_optimizer(train: TrainArgs) -> optax.GradientTransformation:
     global across stages, so the scale factor is applied explicitly by the
     engine (reference clip_grad_norm handles sharded params the same way,
     optimizer/utils.py:14)."""
-    from hetu_galvatron_tpu.runtime.optimizer import _decay_mask
+    from hetu_galvatron_tpu.runtime.optimizer import (
+        _decay_mask,
+        partition_expert_bias,
+    )
 
     chain = [optax.scale_by_adam(b1=train.adam_beta1, b2=train.adam_beta2,
                                  eps=train.adam_eps)]
@@ -73,18 +85,22 @@ def _pipeline_optimizer(train: TrainArgs) -> optax.GradientTransformation:
         chain.append(optax.add_decayed_weights(train.weight_decay,
                                                mask=_decay_mask))
     chain.append(optax.scale_by_learning_rate(make_lr_schedule(train)))
-    return optax.chain(*chain)
+    return partition_expert_bias(optax.chain(*chain))
 
 
 @dataclass
 class _Stage:
     index: int
     mesh: Mesh
-    layer_range: Tuple[int, int]  # [lo, hi) global decoder-layer indices
+    layer_range: Tuple[int, int]  # [lo, hi) decoder-layer indices
     shardings: List[LayerSharding]  # per decoder layer in this stage
     vocab: Optional[LayerSharding]  # set on first/last stage
     has_embed: bool
     has_head: bool
+    # encoder-decoder (t5) only:
+    enc_layer_range: Tuple[int, int] = (0, 0)  # [lo, hi) encoder-layer idxs
+    enc_shardings: List[LayerSharding] = None
+    has_enc_norm: bool = False
 
 
 class PipelineEngine:
@@ -99,16 +115,22 @@ class PipelineEngine:
         *,
         compute_dtype=jnp.bfloat16,
     ):
-        if cfg.model_type == "t5":
-            raise NotImplementedError(
-                "pipeline parallelism for encoder-decoder models is not "
-                "implemented; run t5 with pp_deg=1 (tp/dp/zero shard both "
-                "stacks)")
         self.cfg = cfg
         self.hpc = hpc
         self.train = train
         self.compute_dtype = compute_dtype
         self.pp = hpc.pp_deg
+        if self.pp < 2:
+            # pp=1 routes through the SPMD path (cli/train_dist.py). The
+            # engine's stage-0 backward differentiates w.r.t. its input —
+            # with a single fused embed+head stage that input is integer
+            # tokens — and the tied-embedding grad reconciliation assumes
+            # separate first/last stages (ADVICE r2: a pp=1 engine would
+            # silently untie wte/whead).
+            raise ValueError(
+                "PipelineEngine needs pp_deg >= 2; use make_spmd_train_step "
+                "for pp=1")
+        self.is_t5 = cfg.model_type == "t5"
         devices = list(devices if devices is not None else jax.devices())
         if len(devices) < hpc.world_size:
             raise ValueError(
@@ -117,25 +139,46 @@ class PipelineEngine:
         per_stage = hpc.world_size // self.pp
         self.tx = _pipeline_optimizer(train)
         self.stages: List[_Stage] = []
+        n_enc = hpc.num_encoder_layers
         lo = 0
         for s in range(self.pp):
             sub = devices[s * per_stage:(s + 1) * per_stage]
             mesh = build_mesh(per_stage, 1, devices=sub)
             hi = lo + hpc.pp_division[s]
+            # combined-stack slicing: hpc.layers = enc layers then dec layers
+            enc_lo, enc_hi = min(lo, n_enc), min(hi, n_enc)
+            dec_lo, dec_hi = max(lo, n_enc) - n_enc, max(hi, n_enc) - n_enc
+            enc_shardings = [lower_strategy(st, mesh)
+                             for st in hpc.layers[enc_lo:enc_hi]]
             shardings = [lower_strategy(st, mesh)
-                         for st in hpc.layers[lo:hi]]
+                         for st in hpc.layers[n_enc + dec_lo:n_enc + dec_hi]]
             vocab = lower_vocab_strategy(hpc.vocab, mesh, hpc.default_dp_type)
+            has_enc_norm = self.is_t5 and (
+                enc_lo <= n_enc - 1 < enc_hi or (n_enc == 0 and s == 0))
             self.stages.append(_Stage(
-                index=s, mesh=mesh, layer_range=(lo, hi), shardings=shardings,
-                vocab=vocab, has_embed=(s == 0), has_head=(s == self.pp - 1)))
+                index=s, mesh=mesh, layer_range=(dec_lo, dec_hi),
+                shardings=shardings, vocab=vocab, has_embed=(s == 0),
+                has_head=(s == self.pp - 1),
+                enc_layer_range=(enc_lo, enc_hi),
+                enc_shardings=enc_shardings, has_enc_norm=has_enc_norm))
             lo = hi
         self._fwd_jits = [self._make_fwd(st) for st in self.stages]
         self._bwd_jits = [self._make_bwd(st) for st in self.stages]
         self._update_jits = [self._make_update(st) for st in self.stages]
         self._transpose_jit = jax.jit(jnp.transpose)
+        # expert_bias maintenance pseudo-grads stay out of the clip norm,
+        # matching the SPMD path (clip_by_global_norm lives inside the
+        # multi_transform adam branch, which never sees bias leaves)
         self._gnorm_jit = jax.jit(
-            lambda g: sum(jnp.sum(jnp.square(x.astype(jnp.float32)))
-                          for x in jax.tree.leaves(g)))
+            lambda g: sum(
+                jnp.sum(jnp.square(x.astype(jnp.float32)))
+                for path, x in jax.tree_util.tree_leaves_with_path(g)
+                if "expert_bias" not in str(path[-1])))
+        clip = train.clip_grad
+        self._clip_jit = jax.jit(
+            lambda sq: (jnp.sqrt(sq),
+                        jnp.minimum(1.0, clip / (jnp.sqrt(sq) + 1e-12))
+                        if clip and clip > 0 else jnp.ones((), jnp.float32)))
 
     # ------------------------------------------------------------------
     # params / optimizer state
@@ -145,12 +188,19 @@ class PipelineEngine:
         st = self.stages[s]
         lo, hi = st.layer_range
         out: Params = {"layers": tuple(axes["layers"][lo:hi])}
+        if self.is_t5:
+            elo, ehi = st.enc_layer_range
+            out["enc_layers"] = tuple(axes["enc_layers"][elo:ehi])
+            if st.has_enc_norm:
+                out["enc_norm"] = axes["enc_norm"]
         if st.has_embed:
             out["embed"] = axes["embed"]
         if st.has_head:
             out["prenorm"] = axes["prenorm"]
             if self.cfg.tie_word_embeddings:
-                out["head"] = {"whead": ("embed", "vocab")}
+                # tied copy replaces the wte reference; any extra head params
+                # (bert's MLM transform wt/bt/ln/bias) ride along
+                out["head"] = {**axes["head"], "whead": ("embed", "vocab")}
             else:
                 out["head"] = axes["head"]
         return out
@@ -162,7 +212,11 @@ class PipelineEngine:
         out: Params = {"layers": tuple(
             _spec_tree(a, sh, opt)
             for a, sh in zip(saxes["layers"], st.shardings))}
-        for k in ("embed", "prenorm", "head"):
+        if "enc_layers" in saxes:
+            out["enc_layers"] = tuple(
+                _spec_tree(a, sh, opt)
+                for a, sh in zip(saxes["enc_layers"], st.enc_shardings))
+        for k in ("embed", "prenorm", "head", "enc_norm"):
             if k in saxes:
                 out[k] = _spec_tree(saxes[k], st.vocab, opt)
         return out
@@ -174,12 +228,18 @@ class PipelineEngine:
         for s, st in enumerate(self.stages):
             lo, hi = st.layer_range
             sp: Params = {"layers": tuple(params["layers"][lo:hi])}
+            if self.is_t5:
+                elo, ehi = st.enc_layer_range
+                sp["enc_layers"] = tuple(params["enc_layers"][elo:ehi])
+                if st.has_enc_norm:
+                    sp["enc_norm"] = params["enc_norm"]
             if st.has_embed:
                 sp["embed"] = params["embed"]
             if st.has_head:
                 sp["prenorm"] = params["prenorm"]
                 if self.cfg.tie_word_embeddings:
-                    sp["head"] = {"whead": jnp.asarray(params["embed"]["wte"]).T}
+                    sp["head"] = {**params["head"],
+                                  "whead": jnp.asarray(params["embed"]["wte"]).T}
                 else:
                     sp["head"] = params["head"]
             specs = self.stage_param_specs(axes, s)
@@ -194,11 +254,20 @@ class PipelineEngine:
         for sp in stage_params:
             layers.extend(jax.device_get(list(sp["layers"])))
         full: Params = {"layers": tuple(layers)}
+        if self.is_t5:
+            enc: List[Params] = []
+            for sp in stage_params:
+                enc.extend(jax.device_get(list(sp["enc_layers"])))
+            full["enc_layers"] = tuple(enc)
+            for sp, st in zip(stage_params, self.stages):
+                if st.has_enc_norm:
+                    full["enc_norm"] = jax.device_get(sp["enc_norm"])
         full["embed"] = jax.device_get(stage_params[0]["embed"])
         last = stage_params[-1]
         full["prenorm"] = jax.device_get(last["prenorm"])
         if self.cfg.tie_word_embeddings:
-            full["head"] = {}
+            full["head"] = jax.device_get(
+                {k: v for k, v in last["head"].items() if k != "whead"})
         else:
             full["head"] = jax.device_get(last["head"])
         return full
@@ -271,19 +340,96 @@ class PipelineEngine:
         x = jax.lax.with_sharding_constraint(
             x, NamedSharding(st.mesh, st.vocab.act_spec()))
         x = M.apply_norm(sp["prenorm"], x, cfg)
-        w = sp["head"]["whead"] if "whead" in sp["head"] else None
-        logits = jnp.einsum(
-            "bsh,hv->bsv", x.astype(self.compute_dtype),
-            w.astype(self.compute_dtype),
-            preferred_element_type=jnp.float32)
+        # sp["head"] always carries whead on this stage (split_params puts
+        # the transposed tied copy there), so apply_lm_head uses it directly
+        logits = M.apply_lm_head(sp["head"], x, cfg,
+                                 compute_dtype=self.compute_dtype)
         return M.cross_entropy_loss(logits, labels, loss_mask) + aux_total
+
+    def _stage_apply_t5(self, st: _Stage, sp: Params, carry,
+                        labels=None, loss_mask=None):
+        """Encoder-decoder stage program. ``carry`` is (enc_tokens,
+        dec_tokens) on the embed stage, else the (a, b) activation pair —
+        a = encoder stream / memory [B,S,H], b = decoder stream [B,T,H].
+        Same contract as :meth:`_stage_apply`: non-head stages return
+        (carry, aux); the head stage returns the CE loss."""
+        from hetu_galvatron_tpu.models.encdec import apply_cross_decoder_layer
+        from hetu_galvatron_tpu.parallel.spmd import attention_overrides
+
+        cfg = self.cfg
+        if st.has_embed:
+            enc_tok, dec_tok = carry
+            a = M.apply_embedding(sp["embed"], enc_tok, cfg,
+                                  compute_dtype=self.compute_dtype)
+            b = M.apply_embedding(sp["embed"], dec_tok, cfg,
+                                  compute_dtype=self.compute_dtype)
+        else:
+            a, b = carry
+        rope_enc = rope_dec = None
+        if cfg.position_embedding_type == "rope":
+            rope_enc = M.rope_cos_sin(a.shape[1], cfg.head_dim, cfg.rope_theta)
+            rope_dec = M.rope_cos_sin(b.shape[1], cfg.head_dim, cfg.rope_theta)
+        use_flash = None if cfg.use_flash_attn else False
+        enc_over = attention_overrides(st.enc_shardings, st.mesh,
+                                       use_flash=use_flash)
+        dec_over = attention_overrides(st.shardings, st.mesh,
+                                       use_flash=use_flash, with_cross=True)
+        for j, lp in enumerate(sp["enc_layers"]):
+            sh = st.enc_shardings[j]
+            a = jax.lax.with_sharding_constraint(
+                a, NamedSharding(st.mesh, sh.act_spec()))
+            kwargs = dict(rope=rope_enc, compute_dtype=self.compute_dtype,
+                          causal=False, **enc_over.get(j, {}))
+            kwargs.pop("cross_sdpa_fn", None)
+            fn = partial(M.apply_decoder_layer, cfg=cfg, **kwargs)
+            if sh.checkpoint:
+                fn = jax.checkpoint(fn)
+            a = fn(lp, a)
+        if st.has_enc_norm:
+            a = M.apply_norm(sp["enc_norm"], a, cfg)
+        for j, lp in enumerate(sp["layers"]):
+            sh = st.shardings[j]
+            b = jax.lax.with_sharding_constraint(
+                b, NamedSharding(st.mesh, sh.act_spec()))
+            kwargs = dict(rope=rope_dec, compute_dtype=self.compute_dtype,
+                          **dec_over.get(j, {}))
+            fn = partial(apply_cross_decoder_layer, cfg=cfg, **kwargs)
+            if sh.checkpoint:
+                fn = jax.checkpoint(fn)
+            b = fn(lp, b, a)
+        aux = jnp.zeros((), jnp.float32)  # t5 stacks carry no MoE aux
+        if not st.has_head:
+            spec_a, spec_b = self._carry_specs(st, out=True)
+            return (jax.lax.with_sharding_constraint(
+                        a, NamedSharding(st.mesh, spec_a)),
+                    jax.lax.with_sharding_constraint(
+                        b, NamedSharding(st.mesh, spec_b))), aux
+        b = jax.lax.with_sharding_constraint(
+            b, NamedSharding(st.mesh, st.vocab.act_spec()))
+        b = M.apply_norm(sp["prenorm"], b, cfg)
+        logits = M.apply_lm_head(sp["head"], b, cfg,
+                                 compute_dtype=self.compute_dtype)
+        return M.cross_entropy_loss(logits, labels, loss_mask) + aux
+
+    def _carry_specs(self, st: _Stage, *, out: bool) -> Tuple[P, P]:
+        """(spec_a, spec_b) for the t5 inter-stage activation pair. ``out``
+        selects the stage's last-layer shardings (output constraint /
+        cotangent placement), else its first-layer shardings (forward
+        transfer into the stage). Zero-layer corners fall back to any valid
+        rank-3 spec on the stage."""
+        idx = -1 if out else 0
+        sh_a = (st.enc_shardings[idx] if st.enc_shardings
+                else (st.shardings[idx] if st.shardings else st.vocab))
+        sh_b = st.shardings[idx] if st.shardings else sh_a
+        return sh_a.act_spec(), sh_b.act_spec()
 
     def _make_fwd(self, st: _Stage) -> Optional[Callable]:
         if st.has_head:
             return None  # head fwd is fused into its value_and_grad backward
+        apply = self._stage_apply_t5 if self.is_t5 else self._stage_apply
 
         def f(sp, x):
-            y, _ = self._stage_apply(st, sp, x)
+            y, _ = apply(st, sp, x)
             return y
         return jax.jit(f)
 
@@ -291,14 +437,15 @@ class PipelineEngine:
         """(dparams, dx) by recomputing the stage forward (per-stage remat).
         The head stage returns the (unweighted) loss alongside grads so the
         forward never runs separately just for the metric."""
+        apply = self._stage_apply_t5 if self.is_t5 else self._stage_apply
         if st.has_head:
             def g(sp, x, labels, mask, seed):
                 def lf(sp_, x_):
-                    return self._stage_apply(st, sp_, x_, labels, mask)
+                    return apply(st, sp_, x_, labels, mask)
                 loss, (dp, dx) = jax.value_and_grad(
                     lambda sp_, x_: lf(sp_, x_), argnums=(0, 1))(sp, x)
                 dp = jax.tree.map(lambda t: seed * t, dp)
-                dx = seed * dx
+                dx = jax.tree.map(lambda t: seed * t, dx)
                 return dp, dx, loss
             return jax.jit(g)
 
@@ -306,7 +453,7 @@ class PipelineEngine:
             # cotangents: dy for the activation, seed (the microbatch weight)
             # for this stage's MoE aux loss which enters the total directly
             (_, aux), vjp = jax.vjp(
-                lambda sp_, x_: self._stage_apply(st, sp_, x_), sp, x)
+                lambda sp_, x_: apply(st, sp_, x_), sp, x)
             dp, dx = vjp((dy, seed))
             return dp, dx, aux
         return jax.jit(g)
@@ -315,7 +462,13 @@ class PipelineEngine:
         tx = self.tx
 
         def u(sp, opt, grads, scale):
-            grads = jax.tree.map(lambda g: g * scale, grads)
+            # expert_bias "gradients" ARE the maintenance update (SGD(1)
+            # partition, runtime/optimizer.py) — the global clip must not
+            # scale them, matching the SPMD path where clip_by_global_norm
+            # lives inside the adam branch only
+            grads = jax.tree_util.tree_map_with_path(
+                lambda path, g: (g if "expert_bias" in str(path[-1])
+                                 else g * scale), grads)
             updates, new_opt = tx.update(grads, opt, sp)
             return optax.apply_updates(sp, updates), new_opt
         return jax.jit(u)
@@ -324,8 +477,10 @@ class PipelineEngine:
     # schedules
     # ------------------------------------------------------------------
 
-    def _microbatches(self, batch: Dict[str, np.ndarray]):
-        m = max(self.hpc.chunks, 1)
+    def _microbatches(self, batch: Dict[str, np.ndarray],
+                      num_microbatches: Optional[int] = None):
+        m = max(num_microbatches if num_microbatches is not None
+                else self.hpc.chunks, 1)
         b = batch["tokens"].shape[0]
         if b % m:
             raise ValueError(f"batch {b} not divisible by chunks {m}")
@@ -344,6 +499,9 @@ class PipelineEngine:
     def _put_stage0(self, mb):
         st = self.stages[0]
         shd = NamedSharding(st.mesh, st.vocab.batch_spec())
+        if self.is_t5:
+            return (jax.device_put(jnp.asarray(mb["enc_tokens"]), shd),
+                    jax.device_put(jnp.asarray(mb["tokens"]), shd))
         return jax.device_put(jnp.asarray(mb["tokens"]), shd)
 
     def _put_last(self, mb):
@@ -354,11 +512,31 @@ class PipelineEngine:
                if "loss_mask" in mb else None)
         return lbl, msk
 
-    def _transfer(self, y: jax.Array, to_stage: int) -> jax.Array:
+    def _transfer(self, y, to_stage: int):
+        """Move the inter-stage activation (array, or (a, b) pair for t5)
+        onto the receiving submesh (ICI DMA on TPU)."""
         st = self.stages[to_stage]
+        if self.is_t5:
+            spec_a, spec_b = self._carry_specs(st, out=False)
+            return jax.device_put(
+                y, (NamedSharding(st.mesh, spec_a),
+                    NamedSharding(st.mesh, spec_b)))
         spec = (st.shardings[0].act_spec() if st.shardings
                 else st.vocab.act_spec())
         return jax.device_put(y, NamedSharding(st.mesh, spec))
+
+    def _put_cotangent(self, dx, to_stage: int):
+        """Place the activation cotangent onto the producing stage's submesh
+        with that stage's OUTPUT specs."""
+        st = self.stages[to_stage]
+        if self.is_t5:
+            spec_a, spec_b = self._carry_specs(st, out=True)
+            return jax.device_put(
+                dx, (NamedSharding(st.mesh, spec_a),
+                     NamedSharding(st.mesh, spec_b)))
+        spec = (st.shardings[-1].act_spec() if st.shardings
+                else st.vocab.act_spec())
+        return jax.device_put(dx, NamedSharding(st.mesh, spec))
 
     def _fwd_microbatch(self, stage_params, mb, ctx):
         """Run one microbatch up to the head stage's input; the head's
@@ -389,11 +567,7 @@ class PipelineEngine:
         aux_parts = []
         grad_acc[-1] = _tree_add(grad_acc[-1], dp)
         for s in range(self.pp - 2, -1, -1):
-            dy = jax.device_put(
-                dx, NamedSharding(self.stages[s].mesh,
-                                  (self.stages[s].shardings[-1].act_spec()
-                                   if self.stages[s].shardings
-                                   else self.stages[s].vocab.act_spec())))
+            dy = self._put_cotangent(dx, s)
             dp, dx, aux = self._bwd_jits[s](stage_params[s], inputs[s], dy,
                                             seed)
             if self.cfg.num_experts:
@@ -409,9 +583,13 @@ class PipelineEngine:
         stage_params: List[Params],
         stage_opts: List[Any],
         batch: Dict[str, np.ndarray],
+        num_microbatches: Optional[int] = None,
     ) -> Tuple[List[Params], List[Any], Dict[str, float]]:
-        """One optimizer step under the configured schedule."""
-        mbs, weights = self._microbatches(batch)
+        """One optimizer step under the configured schedule.
+        ``num_microbatches`` overrides the plan's chunk count (batch-size
+        ramp at fixed micro size — the stage jits see the same shapes, so a
+        ramp costs zero recompiles here)."""
+        mbs, weights = self._microbatches(batch, num_microbatches)
         mcount = len(mbs)
         ctx = {"inputs": [], "labels": [], "losses": [],
                "aux": [[] for _ in range(mcount)]}
@@ -443,7 +621,7 @@ class PipelineEngine:
         # tied-embedding grad sum across first/last stages (pipeline.py:1042);
         # transposes run jitted on the owning submesh and the sum crosses
         # stages as a device-to-device sharded transfer (ICI on TPU)
-        if self.cfg.tie_word_embeddings and self.pp > 1:
+        if self.cfg.tie_word_embeddings:
             g_wte = grad_acc[0]["embed"]["wte"]
             g_head = grad_acc[-1]["head"]["whead"]
             g_head_t = jax.device_put(
@@ -459,26 +637,34 @@ class PipelineEngine:
                               self.stages[-1].vocab.param_spec(
                                   ("embed", "vocab"))))
 
-        # global grad-norm clip across stages
-        sq = sum(float(self._gnorm_jit(g)) for g in grad_acc)
+        # global grad-norm clip across stages — kept ON DEVICE (ADVICE r2):
+        # per-stage squared norms fold on stage 0's mesh as replicated
+        # scalars, the clip scale is computed there and re-broadcast to each
+        # submesh, so no host sync lands between backward and the updates
+        rep0 = NamedSharding(self.stages[0].mesh, P())
+        sq_parts = [self._gnorm_jit(g) for g in grad_acc]
+        total_sq = sq_parts[0]
+        for part in sq_parts[1:]:
+            total_sq = total_sq + jax.device_put(part, rep0)
         # tied copies are double-counted: subtract one copy
-        if self.cfg.tie_word_embeddings and self.pp > 1:
-            sq -= float(self._gnorm_jit(grad_acc[-1]["head"]["whead"]))
-        gnorm = float(np.sqrt(sq))
-        clip = self.train.clip_grad
-        scale = min(1.0, clip / (gnorm + 1e-12)) if clip and clip > 0 else 1.0
+        if self.cfg.tie_word_embeddings:
+            total_sq = total_sq - jax.device_put(
+                self._gnorm_jit(grad_acc[-1]["head"]["whead"]), rep0)
+        gnorm_dev, scale_dev = self._clip_jit(total_sq)
 
         new_params, new_opts = [], []
         for s in range(self.pp):
+            scale_s = (scale_dev if s == 0 else jax.device_put(
+                scale_dev, NamedSharding(self.stages[s].mesh, P())))
             p, o = self._update_jits[s](stage_params[s], stage_opts[s],
-                                        grad_acc[s],
-                                        jnp.asarray(scale, jnp.float32))
+                                        grad_acc[s], scale_s)
             new_params.append(p)
             new_opts.append(o)
         # single host sync at the very end (all device work already queued)
         loss = sum(float(w) * (float(l) + sum(float(a) for a in aux))
                    for w, l, aux in zip(weights, ctx["losses"], ctx["aux"]))
-        return new_params, new_opts, {"loss": loss, "grad_norm": gnorm}
+        return new_params, new_opts, {"loss": loss,
+                                      "grad_norm": float(gnorm_dev)}
 
 
 def _tree_add(a, b):

@@ -56,11 +56,31 @@ def populate_model_args_from_hf(
                 values[ours] = d[key]
                 break
     values["model_name"] = d.get("_name_or_path", family) or family
-    values["model_type"] = "moe" if values.get("num_experts", 0) else (
-        "llama" if family in _ROPE_FAMILIES else "gpt"
-    )
+    if family == "bert":
+        values["model_type"] = "bert"
+    elif family == "t5":
+        values["model_type"] = "t5"
+    else:
+        values["model_type"] = "moe" if values.get("num_experts", 0) else (
+            "llama" if family in _ROPE_FAMILIES else "gpt"
+        )
     values["normalization"] = "rmsnorm" if family in _RMS_FAMILIES else "layernorm"
     values["hidden_act"] = "swiglu" if family in _SWIGLU_FAMILIES else "gelu"
+    if family == "bert":
+        # HF bert uses erf gelu everywhere (BertIntermediate + the MLM
+        # transform); our "gelu" is the tanh approximation (gpt2's gelu_new)
+        values["hidden_act"] = "gelu_exact"
+    if family == "t5":
+        # HF t5: num_layers = ENCODER depth, num_decoder_layers = decoder;
+        # act is relu (v1.0) or gated-gelu (v1.1)
+        if d.get("num_layers") is not None:
+            values["num_encoder_layers"] = d["num_layers"]
+            values["num_hidden_layers"] = d.get("num_decoder_layers",
+                                                d["num_layers"])
+        ff = str(d.get("feed_forward_proj", "relu"))
+        values["hidden_act"] = "geglu" if "gated" in ff else "relu"
+        values["tie_word_embeddings"] = bool(d.get("tie_word_embeddings",
+                                                   True))
     values["position_embedding_type"] = (
         "rope" if family in _ROPE_FAMILIES else "learned"
     )
@@ -110,6 +130,17 @@ def model_layer_configs(model_args: ModelArgs) -> List[Dict[str, Any]]:
         "vocab_size": model_args.padded_vocab_size,
         "layer_num": model_args.num_hidden_layers,
     }
+    if model_args.model_type == "t5":
+        # layertype 0 = encoder, 1 = decoder (runtime/dataloader.py
+        # seq2seq_batches splits each sample in half: source | target)
+        n_enc = (model_args.num_encoder_layers
+                 if model_args.num_encoder_layers is not None
+                 else model_args.num_hidden_layers)
+        half = model_args.seq_length // 2
+        enc = dict(base, seq_len=half, layer_num=n_enc)
+        dec = dict(base, seq_len=model_args.seq_length - half,
+                   layer_num=model_args.num_hidden_layers)
+        return ([enc] if n_enc else []) + [dec]
     if not model_args.num_experts:
         return [base]
     # dense/MoE alternation: every moe_layer_freq-th layer is MoE, so layer_num

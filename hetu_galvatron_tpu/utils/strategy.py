@@ -156,6 +156,7 @@ def strategy_list2config(
     default_dp_type: str = "ddp",
     vocab: Optional[EmbeddingLMHeadStrategy] = None,
     pp_division: Optional[Sequence[int]] = None,
+    num_encoder_layers: Optional[int] = None,
 ) -> Dict[str, Any]:
     """Serialize per-layer strategies to the interchange dict.
 
@@ -204,6 +205,12 @@ def strategy_list2config(
         "vcp": vocab.vcp,
         "embed_sdp": int(vocab.embed_sdp),
     }
+    if num_encoder_layers is not None:
+        # encoder-decoder extension (no reference equivalent — the reference
+        # snapshot ships no T5): the per-layer vectors span the COMBINED
+        # encoder+decoder stack, encoder layers first; this key records the
+        # split point so the runtime can slice.
+        cfg["num_encoder_layers"] = int(num_encoder_layers)
     return cfg
 
 
@@ -274,6 +281,8 @@ def config2strategy(
         "pipeline_type": cfg.get("pipeline_type", "pipedream_flush"),
         "pp_division": _dec(cfg["pp_division"]) if "pp_division" in cfg else None,
         "default_dp_type": default_dp.short,
+        "num_encoder_layers": (int(cfg["num_encoder_layers"])
+                               if "num_encoder_layers" in cfg else None),
     }
     return strategies, vocab, extras
 

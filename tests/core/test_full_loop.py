@@ -59,3 +59,65 @@ def test_search_then_train_the_searched_plan(tmp_path, capsys):
     ])
     assert rc == 0
     assert "training done: 1 iters" in capsys.readouterr().out
+
+
+def test_t5_search_then_train_combined_stack(tmp_path, capsys):
+    """Encoder-decoder end to end: the search runs over TWO layertypes
+    (encoder, decoder), the plan records num_encoder_layers and spans the
+    combined stack, and the runtime executes it — pp is searchable for t5
+    now that the pipeline engine stage-slices both stacks."""
+    from hetu_galvatron_tpu.cli.search_dist import main as search_main
+    from hetu_galvatron_tpu.cli.train_dist import main as train_main
+
+    # the llama fixtures profile one layertype; clone it as layertype_1 so
+    # the t5 search sees per-layertype rows for encoder AND decoder
+    comp = json.load(open(os.path.join(
+        FIXTURES, "computation_profiling_bf16_llama2-7b_all.json")))
+    comp.update({k.replace("layertype_0_", "layertype_1_"): v
+                 for k, v in comp.items() if k.startswith("layertype_0_")})
+    mem = json.load(open(os.path.join(
+        FIXTURES, "memory_profiling_bf16_llama2-7b_all.json")))
+    mem.update({k.replace("layertype_0_", "layertype_1_"): v
+                for k, v in mem.items() if k.startswith("layertype_0_")})
+    comp_path, mem_path = tmp_path / "comp.json", tmp_path / "mem.json"
+    comp_path.write_text(json.dumps(comp))
+    mem_path.write_text(json.dumps(mem))
+
+    rc = search_main([
+        os.path.join(ZOO, "t5-3b.yaml"),
+        "model.num_hidden_layers=2", "model.num_encoder_layers=2",
+        "model.seq_length=8192", "model.max_position_embeddings=8192",
+        "search.settle_bsz=16", "search.settle_chunks=4",
+        "search.max_pp_deg=2", "search.memory_constraint=36",
+        "search.default_dp_type=zero2",
+        "search.pipeline_type=pipedream_flush",
+        "search.async_grad_reduce=false",
+        "search.time_profile_mode=sequence",
+        "search.memory_profile_mode=sequence",
+        f"search.time_profiling_path={comp_path}",
+        f"search.memory_profiling_path={mem_path}",
+        f"search.allreduce_bandwidth_config_path={FIXTURES}/allreduce_bandwidth_1nodes_8gpus_per_node.json",
+        f"search.p2p_bandwidth_config_path={FIXTURES}/p2p_bandwidth_1nodes_8gpus_per_node.json",
+        f"search.overlap_coe_path={FIXTURES}/overlap_coefficient.json",
+        f"search.sp_time_path={FIXTURES}/sp_time_1nodes_8gpus_per_node.json",
+        f"search.output_config_path={tmp_path}",
+    ])
+    assert rc == 0
+    plan = glob.glob(os.path.join(str(tmp_path), "galvatron_config_t5*.json"))[0]
+    cfg = json.load(open(plan))
+    assert cfg["num_encoder_layers"] == 2
+    assert len(cfg["tp_sizes_enc"].split(",")) == 4  # enc 2 + dec 2
+
+    rc = train_main([
+        os.path.join(ZOO, "t5-3b.yaml"),
+        "model.hidden_size=32", "model.num_hidden_layers=2",
+        "model.num_encoder_layers=2", "model.num_attention_heads=2",
+        "model.ffn_hidden_size=64", "model.vocab_size=64",
+        "model.seq_length=16", "model.max_position_embeddings=16",
+        "model.make_vocab_size_divisible_by=1",
+        "parallel.mixed_precision=fp32", "train.train_iters=1",
+        "parallel.config_mode=json",
+        f"parallel.galvatron_config_path={plan}",
+    ])
+    assert rc == 0
+    assert "training done: 1 iters" in capsys.readouterr().out

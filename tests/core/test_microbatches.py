@@ -1,0 +1,131 @@
+"""Batch-size ramp calculator + rebatcher + launcher integration
+(reference num_microbatches_calculator.py semantics)."""
+
+import numpy as np
+import pytest
+
+from hetu_galvatron_tpu.runtime.microbatches import (
+    MicroBatchCalculator,
+    Rebatcher,
+)
+
+pytestmark = pytest.mark.core
+
+
+def test_constant_calculator():
+    c = MicroBatchCalculator(global_batch_size=16, micro_batch_size=2,
+                             dp_size=2)
+    assert c.get() == 4  # 16 / (2*2)
+    assert c.get_current_global_batch_size() == 16
+    assert not c.is_ramping
+    assert not c.update(1000)  # never changes
+
+
+def test_ramp_schedule_matches_reference_semantics():
+    # start 4 -> 16 by 4 over 24 samples: 3 increments, 8 samples each
+    c = MicroBatchCalculator(global_batch_size=16, micro_batch_size=2,
+                             dp_size=1, rampup_batch_size=[4, 4, 24])
+    assert c.get_current_global_batch_size() == 4
+    assert c.get() == 2
+    c.update(7)   # still inside the first 8-sample window
+    assert c.get_current_global_batch_size() == 4
+    c.update(8)
+    assert c.get_current_global_batch_size() == 8
+    c.update(16)
+    assert c.get_current_global_batch_size() == 12
+    c.update(25)  # past ramp_samples -> target
+    assert c.get_current_global_batch_size() == 16
+    assert c.get() == 8
+
+
+def test_ramp_full_schedule():
+    c = MicroBatchCalculator(global_batch_size=8, micro_batch_size=2,
+                             dp_size=1, rampup_batch_size=[2, 2, 12])
+    # 3 increments over 12 samples -> 4-sample windows
+    assert c.schedule(30) == [2, 2, 4, 6, 8, 8]
+
+
+def test_indivisible_ramp_step():
+    with pytest.raises(ValueError):
+        MicroBatchCalculator(global_batch_size=16, micro_batch_size=3,
+                             dp_size=1, rampup_batch_size=[4, 4, 8])
+    # decrease_batch_size_if_needed rounds down instead
+    c = MicroBatchCalculator(global_batch_size=18, micro_batch_size=4,
+                             dp_size=1, rampup_batch_size=[6, 6, 8],
+                             decrease_batch_size_if_needed=True)
+    assert c.get_current_running_global_batch_size() == 4  # 6 -> round to 4
+    c.update(100)
+    assert c.get_current_running_global_batch_size() == 16  # 18 -> 16
+
+
+def test_rebatcher_preserves_sample_order():
+    def stream():
+        i = 0
+        while True:
+            yield {"tokens": np.arange(i, i + 8)}
+            i += 8
+
+    rb = Rebatcher(stream())
+    got = []
+    for n in (2, 2, 4, 6, 8):
+        b = rb.next_batch(n)
+        assert len(b["tokens"]) == n
+        got.extend(b["tokens"].tolist())
+    assert got == list(range(22))
+
+
+@pytest.mark.slow
+def test_train_dist_rampup_cli(capsys):
+    import os
+
+    from hetu_galvatron_tpu.cli.train_dist import main
+
+    ZOO = os.path.join(os.path.dirname(__file__), "..", "..",
+                       "hetu_galvatron_tpu", "models", "configs")
+    rc = main([os.path.join(ZOO, "gpt2-small.yaml"),
+               "model.hidden_size=32", "model.num_hidden_layers=2",
+               "model.num_attention_heads=2", "model.vocab_size=64",
+               "model.seq_length=16", "model.max_position_embeddings=16",
+               "model.make_vocab_size_divisible_by=1",
+               "model.ffn_hidden_size=64",
+               "train.train_iters=6", "parallel.mixed_precision=fp32",
+               "parallel.global_train_batch_size=8", "parallel.chunks=4",
+               "parallel.global_tp_deg=4",
+               "train.rampup_batch_size=[2,2,12]"])
+    cap = capsys.readouterr()
+    log = cap.out + cap.err
+    assert rc == 0
+    assert "batch-size ramp" in log
+    assert "ramping global batch size" in log
+    assert "training done: 6 iters" in cap.out
+
+
+@pytest.mark.distributed
+@pytest.mark.slow
+def test_train_dist_rampup_pipeline_cli(capsys):
+    import os
+
+    from hetu_galvatron_tpu.cli.train_dist import main
+
+    ZOO = os.path.join(os.path.dirname(__file__), "..", "..",
+                       "hetu_galvatron_tpu", "models", "configs")
+    rc = main([os.path.join(ZOO, "gpt2-small.yaml"),
+               "model.hidden_size=32", "model.num_hidden_layers=4",
+               "model.num_attention_heads=2", "model.vocab_size=64",
+               "model.seq_length=16", "model.max_position_embeddings=16",
+               "model.make_vocab_size_divisible_by=1",
+               "model.ffn_hidden_size=64", "model.tie_word_embeddings=false",
+               "train.train_iters=5", "parallel.mixed_precision=fp32",
+               "parallel.global_train_batch_size=16", "parallel.chunks=4",
+               "parallel.pp_deg=2",
+               "train.rampup_batch_size=[4,4,16]"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "training done: 5 iters" in out
+
+
+def test_ramp_samples_zero_jumps_to_target():
+    c = MicroBatchCalculator(global_batch_size=16, micro_batch_size=2,
+                             dp_size=1, rampup_batch_size=[4, 4, 0])
+    assert c.get_current_global_batch_size() == 16
+    assert c.get() == 8

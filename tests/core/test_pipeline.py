@@ -73,6 +73,7 @@ CASES = [
 @pytest.mark.parametrize(
     "pkw", CASES,
     ids=lambda d: ",".join(f"{k}={v}" for k, v in d.items()))
+@pytest.mark.slow
 def test_pipeline_matches_single_device(pkw, cpu_devices):
     params, axes = init_causal_lm(jax.random.key(0), CFG)
     batch = _batch()

@@ -84,6 +84,7 @@ def test_dataset_deterministic_and_batch_shapes():
     assert first["tokens"].max() < TINY.padded_vocab_size
 
 
+@pytest.mark.slow
 def test_microbatch_accumulation_matches_full_batch():
     params, _ = init_causal_lm(jax.random.key(0), TINY)
     loss_fn = make_loss_fn(TINY, compute_dtype=jnp.float32)
@@ -123,6 +124,7 @@ def test_get_data_iterator_random():
     assert b["tokens"].shape == (4, TINY.seq_length)
 
 
+@pytest.mark.slow
 def test_microbatch_nonuniform_loss_mask_matches():
     """chunks>1 must equal chunks=1 even when microbatches carry very
     different numbers of valid tokens (token-weighted accumulation)."""

@@ -73,6 +73,7 @@ def test_seq2seq_batches():
     assert b["tokens"].shape[1] == b["labels"].shape[1]
 
 
+@pytest.mark.slow
 def test_t5_tp2_matches_single_device(cpu_devices):
     from hetu_galvatron_tpu.parallel.spmd import (
         make_spmd_train_step, shard_params)
@@ -152,3 +153,200 @@ def test_num_encoder_layers_zero_is_zero():
     cfg = T5.model_copy(update={"num_encoder_layers": 0})
     params, _ = init_causal_lm(jax.random.key(0), cfg)
     assert len(params["enc_layers"]) == 0
+
+
+# ---------------------------------------------------------------------------
+# pipeline parallelism over the combined enc+dec stack (BASELINE milestone 4)
+# ---------------------------------------------------------------------------
+
+TRAIN = TrainArgs(lr=1e-2, clip_grad=1.0, weight_decay=0.01,
+                  lr_decay_style="constant", lr_warmup_iters=0)
+
+
+def _ref_step(cfg, params, batch):
+    import optax
+
+    from hetu_galvatron_tpu.models.encdec import encdec_loss
+    from hetu_galvatron_tpu.runtime.optimizer import make_optimizer
+
+    tx = make_optimizer(TRAIN)
+    loss_fn = lambda p: encdec_loss(p, batch, cfg, compute_dtype=jnp.float32)
+    loss, grads = jax.value_and_grad(loss_fn)(params)
+    upd, _ = tx.update(grads, tx.init(params), params)
+    return float(loss), optax.apply_updates(params, upd)
+
+
+def _t5_pipeline_step(cfg, params, axes, batch, cpu_devices, **pkw):
+    from hetu_galvatron_tpu.runtime.hybrid_config import (
+        get_hybrid_parallel_config)
+    from hetu_galvatron_tpu.runtime.pipeline import PipelineEngine
+
+    args = CoreArgs(model=cfg.model_dump(), train=TRAIN.model_dump())
+    for k, v in pkw.items():
+        setattr(args.parallel, k, v)
+    hpc = get_hybrid_parallel_config(args, 8)
+    assert hpc.num_encoder_layers == 3
+    assert sum(hpc.pp_division) == 5  # combined enc(3) + dec(2)
+    eng = PipelineEngine(cfg, hpc, args.train, devices=cpu_devices,
+                         compute_dtype=jnp.float32)
+    sp = eng.split_params(params, axes)
+    so = eng.init_opt(sp, axes)
+    new_sp, _, metrics = eng.train_step(sp, so, batch)
+    return metrics, eng.merge_params(new_sp)
+
+
+T5_PP_CASES = [
+    dict(pp_deg=2, pipeline_type="gpipe", chunks=2),
+    dict(pp_deg=2, pipeline_type="pipedream_flush", chunks=4),
+    # pp=4 over 5 combined layers -> [1,1,1,2]: encoder-only stages with the
+    # decoder-stream passthrough, and the enc->dec boundary mid-pipeline
+    dict(pp_deg=4, pipeline_type="pipedream_flush", chunks=4),
+    dict(pp_deg=2, pipeline_type="gpipe", chunks=2, global_tp_deg=2),
+]
+
+
+@pytest.mark.distributed
+@pytest.mark.parametrize(
+    "pkw", T5_PP_CASES,
+    ids=lambda d: ",".join(f"{k}={v}" for k, v in d.items()))
+@pytest.mark.slow
+def test_t5_pipeline_matches_single_device(pkw, cpu_devices):
+    """pp>1 over the combined enc+dec stack must reproduce the single-device
+    step (the reference pipelines any arch via PipeSequential,
+    pipeline.py:1592; this engine stage-slices the (a, b) activation pair)."""
+    params, axes = init_causal_lm(jax.random.key(0), T5)
+    rng = np.random.RandomState(0)
+    batch = {
+        "enc_tokens": rng.randint(0, 64, (16, 8)),
+        "tokens": rng.randint(0, 64, (16, 6)),
+        "labels": rng.randint(0, 64, (16, 6)),
+    }
+    jbatch = {k: jnp.asarray(v) for k, v in batch.items()}
+    ref_loss, ref_params = _ref_step(T5, params, jbatch)
+    pkw = dict(pkw, global_train_batch_size=16)
+    metrics, new_params = _t5_pipeline_step(T5, params, axes, batch,
+                                            cpu_devices, **pkw)
+    assert abs(metrics["loss"] - ref_loss) < 2e-5, \
+        f"loss {metrics['loss']} != {ref_loss}"
+    # tied embedding: enc-token AND dec-token wte grads + transposed head
+    # copy must all have reconciled across stages
+    for (pa, a), (_, b) in zip(
+            jax.tree_util.tree_leaves_with_path(ref_params),
+            jax.tree_util.tree_leaves_with_path(new_params)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=5e-4, atol=3e-4,
+            err_msg=f"param {jax.tree_util.keystr(pa)}")
+
+
+@pytest.mark.distributed
+@pytest.mark.slow
+def test_t5_heterogeneous_combined_plan(cpu_devices, tmp_path):
+    """A searched-style JSON plan over the COMBINED stack: per-layer encoder
+    strategies differ from decoder strategies (tp2 encoder, dp zero3 decoder)
+    and pp_division splits mid-encoder."""
+    import json
+
+    plan = {
+        "pp_deg": 2,
+        "tp_sizes_enc": "2,2,1,1,1",    # enc 3 layers then dec 2 layers
+        "tp_consecutive_flags": "1,1,1,1,1",
+        "dp_types_enc": "0,0,0,1,1",
+        "use_sp": "0,0,0,0,0",
+        "cp_sizes_enc": "1,1,1,1,1",
+        "checkpoint": "0,1,0,0,1",
+        "global_bsz": 8,
+        "chunks": 2,
+        "pp_division": "2,3",
+        "pipeline_type": "pipedream_flush",
+        "default_dp_type": "ddp",
+        "vtp": 1, "vsp": 0, "vcp": 1, "embed_sdp": 0,
+        "num_encoder_layers": 3,
+    }
+    path = tmp_path / "t5_plan.json"
+    path.write_text(json.dumps(plan))
+    params, axes = init_causal_lm(jax.random.key(1), T5)
+    rng = np.random.RandomState(1)
+    batch = {
+        "enc_tokens": rng.randint(0, 64, (8, 8)),
+        "tokens": rng.randint(0, 64, (8, 6)),
+        "labels": rng.randint(0, 64, (8, 6)),
+    }
+    jbatch = {k: jnp.asarray(v) for k, v in batch.items()}
+    ref_loss, ref_params = _ref_step(T5, params, jbatch)
+    metrics, new_params = _t5_pipeline_step(
+        T5, params, axes, batch, cpu_devices,
+        galvatron_config_path=str(path))
+    assert abs(metrics["loss"] - ref_loss) < 2e-5
+    for (pa, a), (_, b) in zip(
+            jax.tree_util.tree_leaves_with_path(ref_params),
+            jax.tree_util.tree_leaves_with_path(new_params)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=5e-4, atol=3e-4,
+            err_msg=f"param {jax.tree_util.keystr(pa)}")
+
+
+def test_t5_flash_attention_overrides():
+    """Flash kernels (interpret mode) in BOTH t5 stacks: encoder non-causal,
+    decoder causal self-attention + non-causal cross-attention — must match
+    the XLA core. Equal enc/dec lengths so cross-attention tiles."""
+    from functools import partial as fpartial
+
+    from hetu_galvatron_tpu.ops.pallas.flash_attention import flash_sdpa
+
+    cfg = T5.model_copy(update={"num_encoder_layers": 2})
+    params, _ = init_causal_lm(jax.random.key(2), cfg)
+    rng = np.random.RandomState(2)
+    batch = {
+        "enc_tokens": jnp.asarray(rng.randint(0, 64, (2, 16))),
+        "tokens": jnp.asarray(rng.randint(0, 64, (2, 16))),
+        "labels": jnp.asarray(rng.randint(0, 64, (2, 16))),
+    }
+    base = causal_lm_loss(params, batch, cfg, compute_dtype=jnp.float32)
+    flash = fpartial(flash_sdpa, interpret=True)
+    over = {i: {"sdpa_fn": flash} for i in range(2)}
+    out = causal_lm_loss(params, batch, cfg, compute_dtype=jnp.float32,
+                         layer_overrides=over, enc_layer_overrides=over)
+    np.testing.assert_allclose(float(out), float(base), rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.distributed
+@pytest.mark.slow
+def test_t5_ring_cp_matches_xla(cpu_devices):
+    """cp=2 on every combined layer: encoder runs non-causal ring, decoder
+    self-attention runs causal ring, cross-attention falls back to the XLA
+    core (unequal q/kv lengths) — loss must match the single-device step."""
+    from hetu_galvatron_tpu.parallel.spmd import (
+        make_spmd_train_step, shard_params)
+    from hetu_galvatron_tpu.runtime.hybrid_config import (
+        get_hybrid_parallel_config)
+    from hetu_galvatron_tpu.runtime.mesh import build_mesh
+    from hetu_galvatron_tpu.runtime.optimizer import make_optimizer
+
+    from hetu_galvatron_tpu.models.encdec import encdec_loss
+
+    cfg = T5.model_copy(update={"num_encoder_layers": 2})
+    params, axes = init_causal_lm(jax.random.key(3), cfg)
+    rng = np.random.RandomState(3)
+    batch = {
+        "enc_tokens": jnp.asarray(rng.randint(0, 64, (8, 8))),
+        "tokens": jnp.asarray(rng.randint(0, 64, (8, 8))),
+        "labels": jnp.asarray(rng.randint(0, 64, (8, 8))),
+    }
+    ref_loss = float(encdec_loss(params, batch, cfg,
+                                 compute_dtype=jnp.float32))
+    args = CoreArgs(model=cfg.model_dump(), train=TRAIN.model_dump())
+    args.parallel.global_cp_deg = 2
+    args.parallel.global_train_batch_size = 8
+    hpc = get_hybrid_parallel_config(args, 8)
+    assert hpc.num_encoder_layers == 2
+    mesh = build_mesh(8, 1, devices=cpu_devices)
+    tx = make_optimizer(TRAIN)
+    step, pspecs, ospecs, batch_shd = make_spmd_train_step(
+        cfg, hpc, mesh, axes, tx, params, compute_dtype=jnp.float32,
+        donate=False)
+    sp = shard_params(params, pspecs, mesh)
+    opt = jax.jit(tx.init, out_shardings=jax.tree.map(
+        lambda s: jax.sharding.NamedSharding(mesh, s), ospecs,
+        is_leaf=lambda x: isinstance(x, jax.sharding.PartitionSpec)))(sp)
+    _, _, metrics = step(sp, opt, jax.device_put(batch, batch_shd))
+    assert abs(float(metrics["loss"]) - ref_loss) < 2e-5

@@ -52,6 +52,7 @@ def test_hardware_profiler_schemas(hw_args, cpu_devices, tmp_path):
     assert ov["overlap_coe"] >= 1.0
 
 
+@pytest.mark.slow
 def test_sp_time_profile_feeds_latency_tables(hw_args, cpu_devices):
     args = HardwareProfileArgs(num_nodes=1, num_devices_per_node=4,
                                start_mb=1, end_mb=128, scale=2,
@@ -96,6 +97,7 @@ def test_model_profiler_computation_schema(tmp_path):
     assert len(times) == 1 and len(others) == 1
 
 
+@pytest.mark.slow
 def test_model_profiler_memory_schema(cpu_devices):
     args = CoreArgs.model_validate({
         "model": TINY,

@@ -1,0 +1,178 @@
+"""CP (ring attention) in the search: cost-model terms + planner reachability.
+
+Beyond the reference, which ships context parallelism disabled in the search
+(search_engine/args_schema.py:29 disable_cp=1 with no cp term in
+layer_cost.py): here cp>1 strategies are priced — compute and activations
+shard over the ring, K/V block exchanges are charged per hop — so the
+planner can actually choose the runtime's ring attention
+(ops/ring_attention.py) for long sequences.
+"""
+
+import glob
+import json
+
+import numpy as np
+import os
+
+import pytest
+
+from hetu_galvatron_tpu.core.args_schema import SearchArgs
+from hetu_galvatron_tpu.core.cost_model.cost import (
+    CostContext,
+    layer_memory_cost,
+    layer_time_cost,
+)
+from hetu_galvatron_tpu.core.search_engine.engine import SearchEngine
+from hetu_galvatron_tpu.core.search_engine.strategies import SearchStrategy
+from hetu_galvatron_tpu.utils.strategy import config2strategy
+
+pytestmark = pytest.mark.search_engine
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "..", "fixtures")
+
+
+def _ctx():
+    return CostContext(
+        parameter_size=48.0, seq_length=32768, hidden_size=4096, layer_num=8,
+        forward_computation_time=4.0,
+        tp_activation_per_bsz_dict={1: 512.0, 2: 260.0, 4: 132.0, 8: 68.0,
+                                    "checkpoint": 28.0},
+        comm_coe_dict={"1": 0.0, "1_0": 0.0, "1_1": 0.0,
+                       "2_0": 0.0072, "2_1": 0.0065,
+                       "4_0": 0.0072, "4_1": 0.0065,
+                       "8_0": 0.0072, "8_1": 0.0065, "8": 0.0065},
+        dp_overlap_coe=1.1256, bct_overlap_coe=1.1256,
+    )
+
+
+def test_cp_divides_compute_and_activation():
+    ctx = _ctx()
+    base = SearchStrategy(pp=1, tp=1, sp=1, cp=1, dp=1)
+    cp4 = SearchStrategy(pp=1, tp=1, sp=1, cp=4, dp=1)
+    t1, _ = layer_time_cost(base, ctx, gbsz=8, chunks=8)
+    t4, _ = layer_time_cost(cp4, ctx, gbsz=8, chunks=8)
+    # compute shards 4x; ring comm gives some back but must not erase it
+    assert t4 < t1
+    assert t4 > t1 / 4
+    m1 = layer_memory_cost(base, ctx, gbsz=8, chunks=8)
+    m4 = layer_memory_cost(cp4, ctx, gbsz=8, chunks=8)
+    # activation divides by cp; model states shard over sdp=cp (ZeRO default
+    # off here -> states unchanged)
+    act1, act4 = m1 - 4 * 48.0, m4 - 4 * 48.0
+    assert abs(act4 - act1 / 4) < 1e-6
+
+
+def test_cp_ring_cost_scales_with_seq():
+    short = _ctx()
+    short.seq_length = 1024
+    long = _ctx()
+    cp8 = SearchStrategy(pp=1, tp=1, sp=1, cp=8, dp=1)
+    t_short = layer_time_cost(cp8, short, gbsz=8, chunks=8)[0]
+    t_long = layer_time_cost(cp8, long, gbsz=8, chunks=8)[0]
+    assert t_long > t_short  # ring message grows with the sequence
+
+
+def test_search_picks_cp_for_long_sequences(tmp_path):
+    """Single-sample microbatches (max_dp=1) at long sequence with tp and
+    Ulysses disabled: the planner must reach for cp>1 — and the plan must
+    load into the runtime config stack."""
+    args = SearchArgs(
+        num_nodes=1, num_devices_per_node=8, memory_constraint=36,
+        settle_bsz=8, settle_chunks=8, default_dp_type="zero2",
+        pipeline_type="pipedream_flush", fine_grained_mode=True,
+        sequence_parallel=True, async_grad_reduce=False,
+        mixed_precision="bf16",
+        disable_cp=0, disable_ulysses=1, disable_tp=1, disable_pp=1,
+        time_profile_mode="sequence", memory_profile_mode="sequence",
+        time_profiling_path=os.path.join(
+            FIXTURES, "computation_profiling_bf16_llama2-7b_all.json"),
+        memory_profiling_path=os.path.join(
+            FIXTURES, "memory_profiling_bf16_llama2-7b_all.json"),
+        allreduce_bandwidth_config_path=os.path.join(
+            FIXTURES, "allreduce_bandwidth_1nodes_8gpus_per_node.json"),
+        p2p_bandwidth_config_path=os.path.join(
+            FIXTURES, "p2p_bandwidth_1nodes_8gpus_per_node.json"),
+        overlap_coe_path=os.path.join(FIXTURES, "overlap_coefficient.json"),
+        sp_time_path=os.path.join(
+            FIXTURES, "sp_time_1nodes_8gpus_per_node.json"),
+        output_config_path=str(tmp_path),
+    )
+    eng = SearchEngine(args)
+    eng.set_model_info(
+        [{"hidden_size": 4096, "seq_len": 32768, "layer_num": 8}],
+        "llama-long")
+    eng.initialize()
+    assert any(s.cp > 1 for s in eng.layer_strategies), \
+        "cp strategies must survive enumeration with disable_cp=0"
+    throughput = eng.optimize()
+    assert throughput > 0
+    plan_path = glob.glob(os.path.join(str(tmp_path),
+                                       "galvatron_config_*.json"))[0]
+    cfg = json.load(open(plan_path))
+    layers, _, _ = config2strategy(cfg, world_size=8)
+    assert any(s.cp_size > 1 for s in layers), \
+        f"expected cp in the plan, got {cfg['cp_sizes_enc']}"
+
+
+def _tiny_engine(tmp_path, seq=8192):
+    args = SearchArgs(
+        num_nodes=1, num_devices_per_node=8, memory_constraint=36,
+        settle_bsz=16, settle_chunks=4, default_dp_type="zero2",
+        pipeline_type="pipedream_flush", fine_grained_mode=True,
+        sequence_parallel=True, async_grad_reduce=False,
+        mixed_precision="bf16", max_pp_deg=2,
+        time_profile_mode="sequence", memory_profile_mode="sequence",
+        time_profiling_path=os.path.join(
+            FIXTURES, "computation_profiling_bf16_llama2-7b_all.json"),
+        memory_profiling_path=os.path.join(
+            FIXTURES, "memory_profiling_bf16_llama2-7b_all.json"),
+        allreduce_bandwidth_config_path=os.path.join(
+            FIXTURES, "allreduce_bandwidth_1nodes_8gpus_per_node.json"),
+        p2p_bandwidth_config_path=os.path.join(
+            FIXTURES, "p2p_bandwidth_1nodes_8gpus_per_node.json"),
+        overlap_coe_path=os.path.join(FIXTURES, "overlap_coefficient.json"),
+        sp_time_path=os.path.join(
+            FIXTURES, "sp_time_1nodes_8gpus_per_node.json"),
+        output_config_path=str(tmp_path))
+    eng = SearchEngine(args)
+    eng.set_model_info(
+        [{"hidden_size": 4096, "seq_len": seq, "layer_num": 8}],
+        "llama-tiny")
+    eng.initialize()
+    return eng
+
+
+def test_pp_division_balanced_sums_and_covers(tmp_path):
+    from hetu_galvatron_tpu.core.cost_model.cost import (
+        embed_memory_cost,
+        layer_memory_cost,
+    )
+    from hetu_galvatron_tpu.utils.strategy import DPType
+
+    eng = _tiny_engine(tmp_path)
+    div = eng.pp_division_balanced(gbsz=16, chunks=4, pp=2)
+    assert sum(div) == 8 and all(d >= 1 for d in div)
+
+    # balanced division's stage-memory imbalance is no worse than even's
+    base = SearchStrategy(pp=2, tp=1, sp=1, cp=1, dp=4,
+                          dp_type=DPType.ZERO2)
+    lmem = layer_memory_cost(base, eng.contexts[0], 16, 4, 0, "gpipe")
+    other = embed_memory_cost(base.vocab_variant(), eng.contexts[0], 16, 4,
+                              pipeline_type="gpipe")
+
+    def imbalance(d):
+        stages = [d[0] * lmem + other[0], d[1] * lmem + other[1]]
+        return max(stages) - min(stages)
+
+    assert imbalance(div) <= imbalance([4, 4]) + 1e-6
+
+
+def test_check_cost_model_rows(tmp_path, capsys):
+    eng = _tiny_engine(tmp_path)
+    rows = eng.check_cost_model(gbsz=16, chunks=4)
+    assert rows, "at least one strategy should evaluate"
+    out = capsys.readouterr().out
+    assert "check_cost_model[" in out
+    for r in rows:
+        assert r["time"] > 0 and np.isfinite(r["time"])
+        assert all(np.isfinite(m) for m in r["layer_memory"])

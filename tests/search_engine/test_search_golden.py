@@ -114,6 +114,7 @@ def test_coarse_golden(tmp_path):
     assert got == ["1-1-8f-c"] * 28, got
 
 
+@pytest.mark.slow
 def test_numpy_fallback_matches_cpp(tmp_path):
     """The pure-python DP must agree with the C++ core exactly."""
     eng = _make_engine(tmp_path, settle_chunks=32, fine_grained=1)

@@ -1,0 +1,49 @@
+"""Probe whether the axon TPU tunnel is alive, without wedging it.
+
+Writes progress lines to /tmp/tpu_probe.log so the parent can observe how far
+init got. NEVER kill this process while it is between 'init:start' and
+'init:done' — killing a process inside make_c_api_client wedges the remote
+tunnel for hours (see memory: axon-tpu-tunnel-fragility).
+"""
+import json
+import sys
+import time
+
+LOG = "/tmp/tpu_probe.log"
+OUT = sys.argv[1] if len(sys.argv) > 1 else "/tmp/tpu_probe.json"
+
+
+def log(msg):
+    with open(LOG, "a") as f:
+        f.write(f"{time.time():.1f} {msg}\n")
+        f.flush()
+
+
+def main():
+    log("probe:start")
+    import jax  # noqa: E402  (sitecustomize rewrites jax_platforms to axon,cpu)
+
+    log("import:done")
+    log("init:start")
+    devs = jax.devices()
+    log(f"init:done devices={[str(d) for d in devs]}")
+    kinds = [getattr(d, "device_kind", "?") for d in devs]
+    log(f"kinds={kinds}")
+    # Run one real op end-to-end to prove the data path, not just init.
+    x = jax.numpy.ones((256, 256), dtype=jax.numpy.bfloat16)
+    y = jax.jit(lambda a: a @ a)(x)
+    y.block_until_ready()
+    log("matmul:done")
+    out = {
+        "alive": True,
+        "platform": devs[0].platform,
+        "device_kind": kinds[0],
+        "n_devices": len(devs),
+    }
+    with open(OUT, "w") as f:
+        json.dump(out, f)
+    log("probe:done " + json.dumps(out))
+
+
+if __name__ == "__main__":
+    sys.exit(main())
