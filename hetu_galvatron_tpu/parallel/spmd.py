@@ -125,13 +125,20 @@ def attention_overrides(
     to platform == tpu); everything else keeps the XLA core (GSPMD inserts
     the collectives).
 
+    Ulysses layers get the explicit head-scatter all-to-all attention
+    (ops/ulysses.py, reference _SeqAllToAll) instead of leaving GSPMD to
+    infer collectives for a sequence-sharded softmax; on TPU the local core
+    inside the a2a sandwich is the flash kernel.
+
     ``with_cross=True`` (t5 decoder layers) also sets ``cross_sdpa_fn``:
-    ring layers pin cross-attention to the XLA core (the ring kernel needs
-    equal q/kv sequence lengths; GSPMD all-gathers the encoder memory over
-    the cp axes instead), while flash layers reuse the flash kernel, which
-    handles causal=False and falls back internally on mismatched lengths."""
+    ring and ulysses layers pin cross-attention to the XLA core (the ring
+    kernel needs equal q/kv sequence lengths and the a2a sandwich assumes
+    self-attention geometry; GSPMD inserts the collectives instead), while
+    flash layers reuse the flash kernel, which handles causal=False and
+    falls back internally on mismatched lengths."""
     from hetu_galvatron_tpu.models.modules import xla_sdpa
     from hetu_galvatron_tpu.ops.ring_attention import make_ring_sdpa
+    from hetu_galvatron_tpu.ops.ulysses import make_ulysses_sdpa
 
     if use_flash is None:
         use_flash = all(d.platform == "tpu"
@@ -141,6 +148,18 @@ def attention_overrides(
         if sh.cp_axes:
             out[i] = {"sdpa_fn": make_ring_sdpa(
                 mesh, sh.cp_axes, dp_axes=sh.dp_axes, tp_axes=sh.tp_axes)}
+            if with_cross:
+                out[i]["cross_sdpa_fn"] = xla_sdpa
+        elif sh.ulysses and sh.tp_axes:
+            local = None
+            if use_flash:
+                from hetu_galvatron_tpu.ops.pallas.flash_attention import (
+                    flash_sdpa,
+                )
+
+                local = flash_sdpa
+            out[i] = {"sdpa_fn": make_ulysses_sdpa(
+                mesh, sh.tp_axes, dp_axes=sh.dp_axes, local_sdpa=local)}
             if with_cross:
                 out[i]["cross_sdpa_fn"] = xla_sdpa
         elif use_flash:

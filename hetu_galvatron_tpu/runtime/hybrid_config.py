@@ -48,6 +48,9 @@ class HybridParallelConfig:
     # combined stack, so a stage may hold encoder layers, decoder layers, or
     # the enc->dec boundary.
     num_encoder_layers: int = 0
+    # Interleaved virtual stages (beyond the reference): pp_division has
+    # pp_deg * vpp_deg entries; chunk c runs on physical group c % pp_deg.
+    vpp_deg: int = 1
 
     @property
     def enc_strategies(self) -> List[LayerStrategy]:
@@ -125,8 +128,9 @@ def get_hybrid_parallel_config(
                                 world_size)
         pipeline_type = extras["pipeline_type"]
         default_dp = DPType.from_name(extras["default_dp_type"])
+        vpp = max(extras.get("vpp_deg", 1), 1)
         pp_division = extras["pp_division"] or default_pp_division(
-            n_layers, pp_deg)
+            n_layers, pp_deg * vpp)
     else:
         pp_deg = par.pp_deg
         if world_size % pp_deg:
@@ -155,11 +159,20 @@ def get_hybrid_parallel_config(
         )
         global_bsz = par.global_train_batch_size
         pipeline_type = par.pipeline_type
-        pp_division = default_pp_division(n_layers, pp_deg)
+        vpp = max(par.virtual_pp_deg, 1)
+        if pp_deg * vpp > n_layers:
+            raise ValueError(
+                f"pp_deg {pp_deg} * virtual_pp_deg {vpp} exceeds the layer "
+                f"count {n_layers}")
+        pp_division = default_pp_division(n_layers, pp_deg * vpp)
         chunks = get_chunks(args, world_size)
 
     if sum(pp_division) != n_layers:
         raise ValueError(f"pp_division {pp_division} != layer count {n_layers}")
+    if len(pp_division) != pp_deg * vpp:
+        raise ValueError(
+            f"pp_division has {len(pp_division)} entries, expected pp_deg "
+            f"{pp_deg} * vpp_deg {vpp} = {pp_deg * vpp}")
     min_tp = min(min(s.tp_size for s in layers), vocab.vtp)
     min_cp = min(min(s.cp_size for s in layers), vocab.vcp)
     grain = world_size // pp_deg // min_tp // min_cp
@@ -171,5 +184,5 @@ def get_hybrid_parallel_config(
         layers=list(layers), vocab=vocab, pp_deg=pp_deg,
         pp_division=list(pp_division), chunks=chunks, global_bsz=global_bsz,
         pipeline_type=pipeline_type, default_dp_type=default_dp,
-        world_size=world_size, num_encoder_layers=n_enc,
+        world_size=world_size, num_encoder_layers=n_enc, vpp_deg=vpp,
     )

@@ -140,10 +140,20 @@ class PipelineEngine:
         self.tx = _pipeline_optimizer(train)
         self.stages: List[_Stage] = []
         n_enc = hpc.num_encoder_layers
+        # interleaved virtual stages: pp_division has pp*vpp chunks; chunk c
+        # runs on physical device group c % pp (Megatron round-robin), so
+        # each group hosts vpp non-contiguous model chunks and the
+        # warmup/cooldown bubble shrinks ~vpp-fold. vpp=1 degenerates to the
+        # plain one-chunk-per-group layout.
+        self.vpp = max(getattr(hpc, "vpp_deg", 1), 1)
+        group_meshes = []
+        for g in range(self.pp):
+            sub = devices[g * per_stage:(g + 1) * per_stage]
+            group_meshes.append(build_mesh(per_stage, 1, devices=sub))
+        n_chunks = self.pp * self.vpp
         lo = 0
-        for s in range(self.pp):
-            sub = devices[s * per_stage:(s + 1) * per_stage]
-            mesh = build_mesh(per_stage, 1, devices=sub)
+        for s in range(n_chunks):
+            mesh = group_meshes[s % self.pp]
             hi = lo + hpc.pp_division[s]
             # combined-stack slicing: hpc.layers = enc layers then dec layers
             enc_lo, enc_hi = min(lo, n_enc), min(hi, n_enc)
@@ -158,7 +168,7 @@ class PipelineEngine:
             self.stages.append(_Stage(
                 index=s, mesh=mesh, layer_range=(dec_lo, dec_hi),
                 shardings=shardings, vocab=vocab, has_embed=(s == 0),
-                has_head=(s == self.pp - 1),
+                has_head=(s == n_chunks - 1),
                 enc_layer_range=(enc_lo, enc_hi),
                 enc_shardings=enc_shardings, has_enc_norm=has_enc_norm))
             lo = hi
@@ -173,7 +183,7 @@ class PipelineEngine:
             lambda g: sum(
                 jnp.sum(jnp.square(x.astype(jnp.float32)))
                 for path, x in jax.tree_util.tree_leaves_with_path(g)
-                if "expert_bias" not in str(path[-1])))
+                if not path or "expert_bias" not in str(path[-1])))
         clip = train.clip_grad
         self._clip_jit = jax.jit(
             lambda sq: (jnp.sqrt(sq),
@@ -544,9 +554,10 @@ class PipelineEngine:
         loss costs no extra pass."""
         x = self._put_stage0(mb)
         inputs = []
-        for s in range(self.pp):
+        n_stages = len(self.stages)
+        for s in range(n_stages):
             inputs.append(x)
-            if s == self.pp - 1:
+            if s == n_stages - 1:
                 lbl, msk = self._put_last(mb)
                 ctx["labels"].append((lbl, msk))
                 ctx["losses"].append(None)  # filled by the backward
@@ -566,7 +577,7 @@ class PipelineEngine:
         # serialize the schedule; train_step folds them once at the end
         aux_parts = []
         grad_acc[-1] = _tree_add(grad_acc[-1], dp)
-        for s in range(self.pp - 2, -1, -1):
+        for s in range(len(self.stages) - 2, -1, -1):
             dy = self._put_cotangent(dx, s)
             dp, dx, aux = self._bwd_jits[s](stage_params[s], inputs[s], dy,
                                             seed)
@@ -593,7 +604,7 @@ class PipelineEngine:
         mcount = len(mbs)
         ctx = {"inputs": [], "labels": [], "losses": [],
                "aux": [[] for _ in range(mcount)]}
-        grad_acc: List[Any] = [None] * self.pp
+        grad_acc: List[Any] = [None] * len(self.stages)
 
         if self.hpc.pipeline_type == "gpipe":
             # all forwards, then all backwards (pipeline.py:729-905)
@@ -605,8 +616,9 @@ class PipelineEngine:
         else:
             # pipedream-flush / 1F1B (pipeline.py:386-712): warmup forwards,
             # then alternate 1 fwd / 1 bwd, then cooldown backwards. With a
-            # single controller the warmup depth is the pipeline depth.
-            warmup = min(self.pp, mcount)
+            # single controller the warmup depth is the pipeline depth —
+            # in chunks, so interleaved runs keep every group fed.
+            warmup = min(len(self.stages), mcount)
             for m in range(warmup):
                 self._fwd_microbatch(stage_params, mbs[m], ctx)
             next_fwd, next_bwd = warmup, 0
@@ -653,7 +665,7 @@ class PipelineEngine:
         gnorm_dev, scale_dev = self._clip_jit(total_sq)
 
         new_params, new_opts = [], []
-        for s in range(self.pp):
+        for s in range(len(self.stages)):
             scale_s = (scale_dev if s == 0 else jax.device_put(
                 scale_dev, NamedSharding(self.stages[s].mesh, P())))
             p, o = self._update_jits[s](stage_params[s], stage_opts[s],

@@ -114,6 +114,43 @@ class RerunErrorInjector:
         return value
 
 
+@dataclass
+class DeterminismStats:
+    """Relative-difference stats between a step and its in-place re-run
+    (reference QuickStats, rerun_state_machine.py:235/520-539)."""
+
+    checked: int = 0
+    mismatches: int = 0
+    nonfinite: int = 0  # one-sided NaN/inf re-runs (counted, not averaged)
+    max_rel_diff: float = 0.0
+    sum_rel_diff: float = 0.0
+
+    def record(self, a: float, b: float) -> None:
+        self.checked += 1
+        denom = max(abs(b), 1e-12)
+        rel = abs(a - b) / denom
+        if not math.isfinite(rel):
+            # one side non-finite: a mismatch by definition, but keep the
+            # running mean finite
+            self.mismatches += 1
+            self.nonfinite += 1
+            return
+        if rel > 0:
+            self.mismatches += 1
+        self.max_rel_diff = max(self.max_rel_diff, rel)
+        self.sum_rel_diff += rel
+
+    def summary(self) -> Dict[str, Any]:
+        finite = self.checked - self.nonfinite
+        return {
+            "checked": self.checked,
+            "mismatches": self.mismatches,
+            "nonfinite": self.nonfinite,
+            "max_rel_diff": self.max_rel_diff,
+            "mean_rel_diff": (self.sum_rel_diff / finite if finite else 0.0),
+        }
+
+
 class RerunStateMachine:
     """Wraps the host train loop's step result (reference
     should_run_forward_backward :251 / validate_result :434)."""
@@ -126,6 +163,7 @@ class RerunStateMachine:
             args.error_injection_rate, args.error_injection_type)
         self._ema: Optional[float] = None
         self._last_exit_code: Optional[int] = None
+        self.determinism_stats = DeterminismStats()
 
     @property
     def enabled(self) -> bool:
@@ -163,6 +201,35 @@ class RerunStateMachine:
             return RerunDiagnostic.CORRECT
         value = self.injector.maybe_corrupt(value, iteration, attempt=0)
         self.state = RerunState.RUNNING
+
+        if self.args.mode == "report_stats":
+            # determinism-stats mode (reference REPORT_DETERMINISM_STATS,
+            # rerun_state_machine.py:77/327/520-539): EVERY step re-runs once
+            # and the relative difference is recorded; execution always
+            # continues and no exit codes are raised. On TPU/XLA the expected
+            # difference is exactly 0 — any nonzero entry is a finding.
+            if rerun_fn is not None:
+                self.state = RerunState.RERUNNING_IN_PLACE
+                if data_iterator is not None:
+                    data_iterator.rewind()
+                rerun_value = float(rerun_fn())
+                # NaN == NaN for determinism purposes (same guard as the
+                # validate_results path): a deterministic NaN step is not a
+                # mismatch and must not poison the stats with nan rel-diffs
+                same = (rerun_value == value) or (
+                    math.isnan(rerun_value) and math.isnan(value))
+                if not (math.isnan(rerun_value) and math.isnan(value)):
+                    self.determinism_stats.record(rerun_value, value)
+                if not same:
+                    self.records.append(RerunRecord(
+                        iteration=iteration, value=value,
+                        rerun_value=rerun_value,
+                        diagnostic=RerunDiagnostic.TRANSIENT_ERROR,
+                        reason="nondeterministic re-run"))
+                self.state = RerunState.RUNNING
+            self._update_ema(value)
+            return RerunDiagnostic.CORRECT
+
         reason = self._suspicious(value)
         if reason is None:
             self._update_ema(value)
@@ -197,7 +264,7 @@ class RerunStateMachine:
         return self._last_exit_code
 
     def report(self) -> Dict[str, Any]:
-        return {
+        out = {
             "checked_iterations": len(self.records),
             "transient": sum(r.diagnostic == RerunDiagnostic.TRANSIENT_ERROR
                              for r in self.records),
@@ -205,3 +272,6 @@ class RerunStateMachine:
                               for r in self.records),
             "records": [r.__dict__ for r in self.records],
         }
+        if self.args.mode == "report_stats":
+            out["determinism"] = self.determinism_stats.summary()
+        return out

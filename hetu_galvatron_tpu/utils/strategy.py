@@ -157,6 +157,7 @@ def strategy_list2config(
     vocab: Optional[EmbeddingLMHeadStrategy] = None,
     pp_division: Optional[Sequence[int]] = None,
     num_encoder_layers: Optional[int] = None,
+    vpp_deg: Optional[int] = None,
 ) -> Dict[str, Any]:
     """Serialize per-layer strategies to the interchange dict.
 
@@ -211,6 +212,10 @@ def strategy_list2config(
         # encoder+decoder stack, encoder layers first; this key records the
         # split point so the runtime can slice.
         cfg["num_encoder_layers"] = int(num_encoder_layers)
+    if vpp_deg is not None and vpp_deg > 1:
+        # interleaved virtual stages (beyond the reference): pp_division then
+        # has pp_deg * vpp_deg entries, chunk c on physical group c % pp_deg
+        cfg["vpp_deg"] = int(vpp_deg)
     return cfg
 
 
@@ -283,6 +288,7 @@ def config2strategy(
         "default_dp_type": default_dp.short,
         "num_encoder_layers": (int(cfg["num_encoder_layers"])
                                if "num_encoder_layers" in cfg else None),
+        "vpp_deg": int(cfg.get("vpp_deg", 1)),
     }
     return strategies, vocab, extras
 

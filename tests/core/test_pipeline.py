@@ -139,3 +139,64 @@ def test_uneven_pp_division(cpu_devices):
                     jax.tree.leaves(new_params)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=5e-4, atol=3e-4)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("pipeline_type", ["gpipe", "pipedream_flush"])
+def test_interleaved_virtual_stages_match_single_device(pipeline_type,
+                                                        cpu_devices):
+    """vpp=2 over pp=2: 4 model chunks round-robin on 2 device groups
+    (chunk c on group c % pp) must reproduce the single-device step —
+    beyond the reference, which has no interleaved schedule."""
+    cfg = CFG.model_copy(update={"num_hidden_layers": 5})
+    params, axes = init_causal_lm(jax.random.key(0), cfg)
+    batch = _batch()
+    ref_loss, ref_params = _reference_step(params, batch, cfg=cfg)
+    metrics, new_params = _pipeline_step(
+        cfg, params, axes, batch, cpu_devices,
+        pp_deg=2, virtual_pp_deg=2, chunks=4, pipeline_type=pipeline_type,
+        global_train_batch_size=16)
+    assert abs(metrics["loss"] - ref_loss) < 2e-5
+    for (pa, a), (_, b) in zip(
+            jax.tree_util.tree_leaves_with_path(ref_params),
+            jax.tree_util.tree_leaves_with_path(new_params)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=5e-4, atol=3e-4,
+            err_msg=f"param {jax.tree_util.keystr(pa)}")
+
+
+@pytest.mark.slow
+def test_interleaved_tied_embeddings(cpu_devices):
+    """Tied wte with vpp=2: embed chunk and head chunk live on DIFFERENT
+    physical groups (chunk 0 -> group 0, chunk 3 -> group 1) and the grad
+    reconciliation still keeps the copies in sync."""
+    cfg = ModelArgs(
+        hidden_size=64, num_hidden_layers=4, num_attention_heads=4,
+        vocab_size=128, max_position_embeddings=32, seq_length=16,
+        tie_word_embeddings=True, make_vocab_size_divisible_by=1)
+    params, axes = init_causal_lm(jax.random.key(0), cfg)
+    batch = _batch()
+    ref_loss, ref_params = _reference_step(params, batch, cfg=cfg)
+    args = CoreArgs(model=cfg.model_dump(), train=TRAIN.model_dump())
+    args.parallel.pp_deg = 2
+    args.parallel.virtual_pp_deg = 2
+    args.parallel.chunks = 4
+    args.parallel.global_train_batch_size = 16
+    hpc = get_hybrid_parallel_config(args, 8)
+    assert len(hpc.pp_division) == 4
+    eng = PipelineEngine(cfg, hpc, args.train, devices=cpu_devices,
+                         compute_dtype=jnp.float32)
+    sp = eng.split_params(params, axes)
+    so = eng.init_opt(sp, axes)
+    new_sp, _, metrics = eng.train_step(sp, so, batch)
+    assert abs(metrics["loss"] - ref_loss) < 2e-5
+    wte = np.asarray(jax.device_get(new_sp[0]["embed"]["wte"]))
+    whead = np.asarray(jax.device_get(new_sp[-1]["head"]["whead"]))
+    np.testing.assert_allclose(wte, whead.T, rtol=1e-6, atol=1e-7)
+    merged = eng.merge_params(new_sp)
+    for (pa, a), (_, b) in zip(
+            jax.tree_util.tree_leaves_with_path(ref_params),
+            jax.tree_util.tree_leaves_with_path(merged)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=5e-4, atol=3e-4,
+            err_msg=f"param {jax.tree_util.keystr(pa)}")

@@ -93,3 +93,51 @@ def test_rerun_replays_same_data():
 
     d = m.validate_result(float("nan"), 0, rerun_fn=rerun, data_iterator=it)
     assert d == RerunDiagnostic.TRANSIENT_ERROR
+
+
+def test_report_determinism_stats_mode():
+    """report_stats mode (reference REPORT_DETERMINISM_STATS): every step
+    re-runs once, relative differences are recorded, and no exit code is
+    ever requested — execution continues."""
+    from hetu_galvatron_tpu.core.args_schema import RerunArgs
+    from hetu_galvatron_tpu.runtime.rerun_machine import RerunStateMachine
+
+    args = RerunArgs(enable=True, mode="report_stats")
+    m = RerunStateMachine(args)
+    # deterministic step: rerun reproduces exactly
+    for it in range(3):
+        d = m.validate_result(1.0 + it, it, rerun_fn=lambda i=it: 1.0 + i)
+    rep = m.report()
+    assert rep["determinism"]["checked"] == 3
+    assert rep["determinism"]["mismatches"] == 0
+    assert m.exit_code_requested() is None
+
+    # nondeterministic step: mismatch captured with its relative magnitude
+    m2 = RerunStateMachine(args)
+    m2.validate_result(1.0, 0, rerun_fn=lambda: 1.001)
+    rep2 = m2.report()
+    assert rep2["determinism"]["mismatches"] == 1
+    assert abs(rep2["determinism"]["max_rel_diff"] - 1e-3) < 1e-6
+    assert m2.exit_code_requested() is None  # never exits in stats mode
+    assert rep2["checked_iterations"] == 1  # mismatch recorded for the log
+
+
+def test_report_stats_nan_handling():
+    """A deterministic NaN re-run is not a mismatch; a one-sided NaN is,
+    without poisoning the running mean."""
+    from hetu_galvatron_tpu.core.args_schema import RerunArgs
+    from hetu_galvatron_tpu.runtime.rerun_machine import RerunStateMachine
+
+    m = RerunStateMachine(RerunArgs(enable=True, mode="report_stats"))
+    m.validate_result(float("nan"), 0, rerun_fn=lambda: float("nan"))
+    rep = m.report()
+    assert rep["checked_iterations"] == 0  # deterministic nan != mismatch
+    assert rep["determinism"]["mismatches"] == 0
+
+    m2 = RerunStateMachine(RerunArgs(enable=True, mode="report_stats"))
+    m2.validate_result(1.0, 0, rerun_fn=lambda: float("nan"))
+    m2.validate_result(2.0, 1, rerun_fn=lambda: 2.0)
+    rep2 = m2.report()
+    d = rep2["determinism"]
+    assert d["mismatches"] == 1 and d["nonfinite"] == 1
+    assert d["mean_rel_diff"] == 0.0  # finite mean unpoisoned

@@ -1,0 +1,120 @@
+"""Explicit Ulysses all-to-all attention (ops/ulysses.py, reference
+_SeqAllToAll): numerics vs the XLA core, GQA divisibility fallback, and
+HLO-level evidence that the lowering emits head-scatter all-to-alls."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from hetu_galvatron_tpu.models.modules import xla_sdpa
+from hetu_galvatron_tpu.ops.ulysses import make_ulysses_sdpa
+
+pytestmark = [pytest.mark.kernels, pytest.mark.parallel]
+
+
+def _mesh(cpu_devices, sp=4):
+    import numpy as _np
+
+    return Mesh(_np.array(cpu_devices[:sp * 2]).reshape(2, sp), ("dp", "sp"))
+
+
+def _qkv(B=2, S=16, N=4, D=8, K=None, seed=0):
+    ks = jax.random.split(jax.random.key(seed), 3)
+    K = K or N
+    return (jax.random.normal(ks[0], (B, S, N, D)),
+            jax.random.normal(ks[1], (B, S, K, D)),
+            jax.random.normal(ks[2], (B, S, K, D)))
+
+
+def test_ulysses_matches_xla_core(cpu_devices):
+    mesh = _mesh(cpu_devices)
+    sdpa = make_ulysses_sdpa(mesh, ("sp",), dp_axes=("dp",))
+    q, k, v = _qkv()
+    for causal in (True, False):
+        ref = xla_sdpa(q, k, v, causal=causal)
+        out = sdpa(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+
+def test_ulysses_gqa_groups(cpu_devices):
+    """kv heads divisible by sp: the a2a path handles GQA."""
+    mesh = _mesh(cpu_devices)
+    sdpa = make_ulysses_sdpa(mesh, ("sp",), dp_axes=("dp",))
+    q, k, v = _qkv(N=8, K=4)
+    ref = xla_sdpa(q, k, v, causal=True)
+    out = sdpa(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ulysses_kv_heads_below_sp_replicate(cpu_devices):
+    """kv heads < sp degree: kv heads replicate up to sp so the head
+    scatter stays whole-headed (reference repeat_interleave,
+    attention_impl.py:278-417) — numerics unchanged."""
+    mesh = _mesh(cpu_devices)
+    sdpa = make_ulysses_sdpa(mesh, ("sp",), dp_axes=("dp",))
+    q, k, v = _qkv(N=4, K=2)  # K=2 < sp=4, sp % K == 0 -> replicate
+    ref = xla_sdpa(q, k, v, causal=True)
+    out = sdpa(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ulysses_truly_indivisible_falls_back(cpu_devices):
+    """Head counts that neither divide nor divide into sp: XLA core
+    fallback (GSPMD chooses the collectives)."""
+    mesh = _mesh(cpu_devices)
+    sdpa = make_ulysses_sdpa(mesh, ("sp",), dp_axes=("dp",))
+    q, k, v = _qkv(N=6, K=3)  # 3 % 4 != 0 and 4 % 3 != 0
+    ref = xla_sdpa(q, k, v, causal=True)
+    out = sdpa(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ulysses_gradients(cpu_devices):
+    mesh = _mesh(cpu_devices)
+    sdpa = make_ulysses_sdpa(mesh, ("sp",), dp_axes=("dp",))
+    q, k, v = _qkv()
+
+    def loss(fn):
+        return lambda q_, k_, v_: jnp.sum(
+            jnp.square(fn(q_, k_, v_, causal=True)))
+
+    gref = jax.grad(loss(xla_sdpa), argnums=(0, 1, 2))(q, k, v)
+    g = jax.grad(loss(sdpa), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g, gref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_ulysses_lowering_emits_all_to_all(cpu_devices):
+    """The round-2 verdict's perf landmine: nobody had verified the Ulysses
+    path lowers to head-scatter all-to-alls rather than all-gathers. Compile
+    the jitted attention over the mesh and check the collective is there."""
+    mesh = _mesh(cpu_devices)
+    sdpa = make_ulysses_sdpa(mesh, ("sp",), dp_axes=("dp",))
+    q, k, v = _qkv()
+    shd = NamedSharding(mesh, P("dp", "sp", None, None))
+    qs, ks, vs = (jax.device_put(t, shd) for t in (q, k, v))
+
+    f = jax.jit(lambda a, b, c: sdpa(a, b, c, causal=True))
+    hlo = f.lower(qs, ks, vs).compile().as_text()
+    assert "all-to-all" in hlo, "expected explicit all-to-all collectives"
+
+
+def test_ulysses_gqa_ratio_unsplittable_falls_back(cpu_devices):
+    """N=6, K=2, sp=4: replication would give K=4 which no longer divides
+    N — the decision must happen BEFORE mutating k/v so the XLA fallback
+    sees the true GQA ratio."""
+    mesh = _mesh(cpu_devices)
+    sdpa = make_ulysses_sdpa(mesh, ("sp",), dp_axes=("dp",))
+    q, k, v = _qkv(N=6, K=2)
+    ref = xla_sdpa(q, k, v, causal=True)
+    out = sdpa(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)

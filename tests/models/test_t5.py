@@ -350,3 +350,30 @@ def test_t5_ring_cp_matches_xla(cpu_devices):
         is_leaf=lambda x: isinstance(x, jax.sharding.PartitionSpec)))(sp)
     _, _, metrics = step(sp, opt, jax.device_put(batch, batch_shd))
     assert abs(float(metrics["loss"]) - ref_loss) < 2e-5
+
+
+@pytest.mark.distributed
+@pytest.mark.slow
+def test_t5_interleaved_virtual_stages(cpu_devices):
+    """vpp=2 x pp=2 over the combined enc+dec stack: 4 chunks round-robin
+    on 2 device groups, enc->dec boundary inside a chunk."""
+    params, axes = init_causal_lm(jax.random.key(0), T5)
+    rng = np.random.RandomState(5)
+    batch = {
+        "enc_tokens": rng.randint(0, 64, (16, 8)),
+        "tokens": rng.randint(0, 64, (16, 6)),
+        "labels": rng.randint(0, 64, (16, 6)),
+    }
+    jbatch = {k: jnp.asarray(v) for k, v in batch.items()}
+    ref_loss, ref_params = _ref_step(T5, params, jbatch)
+    metrics, new_params = _t5_pipeline_step(
+        T5, params, axes, batch, cpu_devices,
+        pp_deg=2, virtual_pp_deg=2, chunks=4,
+        pipeline_type="pipedream_flush", global_train_batch_size=16)
+    assert abs(metrics["loss"] - ref_loss) < 2e-5
+    for (pa, a), (_, b) in zip(
+            jax.tree_util.tree_leaves_with_path(ref_params),
+            jax.tree_util.tree_leaves_with_path(new_params)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=5e-4, atol=3e-4,
+            err_msg=f"param {jax.tree_util.keystr(pa)}")
