@@ -71,8 +71,11 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
     def _finalize():
         l = jnp.maximum(l_ref[...], 1e-20)
         o_ref[0, 0] = (acc_ref[...] / l[:, None]).astype(o_ref.dtype)
-        # logsumexp per row, consumed by the backward kernels
-        lse_ref[0, 0] = m_ref[...] + jnp.log(l)
+        # logsumexp per row, consumed by the backward kernels; stored with a
+        # trailing singleton lane dim — Mosaic requires the last two block
+        # dims to be (mult-of-8, mult-of-128) or equal to the array dims, so
+        # a rank-3 (1, 1, block_q) lse block cannot lower on hardware
+        lse_ref[0, 0] = (m_ref[...] + jnp.log(l))[:, None]
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
@@ -113,18 +116,23 @@ def flash_attention_hmajor(
         out_specs=[
             pl.BlockSpec((1, 1, block_q, D),
                          lambda b, n, qi, ki: (b, n, qi, 0)),
-            pl.BlockSpec((1, 1, block_q),
-                         lambda b, n, qi, ki: (b, n, qi)),
+            pl.BlockSpec((1, 1, block_q, 1),
+                         lambda b, n, qi, ki: (b, n, qi, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B, N, S, D), q.dtype),
-            jax.ShapeDtypeStruct((B, N, S), jnp.float32),
+            jax.ShapeDtypeStruct((B, N, S, 1), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q,), jnp.float32),
             pltpu.VMEM((block_q,), jnp.float32),
             pltpu.VMEM((block_q, D), jnp.float32),
         ],
+        # only the k-block axis carries loop state (the online softmax);
+        # everything else may be reordered/partitioned by Mosaic
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
         interpret=interpret,
     )(q, k, v)
 
@@ -153,7 +161,7 @@ def _flash_bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         k = k_ref[0, 0].astype(jnp.float32)
         v = v_ref[0, 0].astype(jnp.float32)
         do = do_ref[0, 0].astype(jnp.float32)
-        lse = lse_ref[0, 0]
+        lse = lse_ref[0, 0]  # (block_q, 1): broadcasts over block_k
         delta = delta_ref[0, 0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
@@ -163,14 +171,14 @@ def _flash_bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             kpos = kb * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(qpos >= kpos, s, NEG_INF)
-        p = jnp.exp(s - lse[:, None])
+        p = jnp.exp(s - lse)
         p = jnp.where(s == NEG_INF, 0.0, p)
         dv_acc[...] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * scale
+        ds = p * (dp - delta) * scale
         dk_acc[...] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -200,7 +208,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         k = k_ref[0, 0].astype(jnp.float32)
         v = v_ref[0, 0].astype(jnp.float32)
         do = do_ref[0, 0].astype(jnp.float32)
-        lse = lse_ref[0, 0]
+        lse = lse_ref[0, 0]  # (block_q, 1): broadcasts over block_k
         delta = delta_ref[0, 0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
@@ -210,11 +218,11 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             kpos = kb * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(qpos >= kpos, s, NEG_INF)
-        p = jnp.exp(s - lse[:, None])
+        p = jnp.exp(s - lse)
         p = jnp.where(s == NEG_INF, 0.0, p)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * scale
+        ds = p * (dp - delta) * scale
         dq_acc[...] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -243,7 +251,9 @@ def flash_attention_bwd_hmajor(
     num_q = S // block_q
     num_k = S // block_k
     scale = 1.0 / math.sqrt(D)
-    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    # (B, N, S, 1): same trailing-singleton layout as lse (Mosaic tiling)
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1,
+                    keepdims=True)
 
     dkdv = pl.pallas_call(
         functools.partial(_flash_bwd_dkdv_kernel, block_q=block_q,
@@ -259,10 +269,10 @@ def flash_attention_bwd_hmajor(
                          lambda b, kh, kb, g, qb: (b, kh, kb, 0)),
             pl.BlockSpec((1, 1, block_q, D),
                          lambda b, kh, kb, g, qb: (b, kh * G + g, qb, 0)),
-            pl.BlockSpec((1, 1, block_q),
-                         lambda b, kh, kb, g, qb: (b, kh * G + g, qb)),
-            pl.BlockSpec((1, 1, block_q),
-                         lambda b, kh, kb, g, qb: (b, kh * G + g, qb)),
+            pl.BlockSpec((1, 1, block_q, 1),
+                         lambda b, kh, kb, g, qb: (b, kh * G + g, qb, 0)),
+            pl.BlockSpec((1, 1, block_q, 1),
+                         lambda b, kh, kb, g, qb: (b, kh * G + g, qb, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_k, D),
@@ -278,6 +288,10 @@ def flash_attention_bwd_hmajor(
             pltpu.VMEM((block_k, D), jnp.float32),
             pltpu.VMEM((block_k, D), jnp.float32),
         ],
+        # dk/dv accumulate across the (g, qb) axes; kb tiles are independent
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary", "arbitrary")),
         interpret=interpret,
     )(q, k, v, do, lse, delta)
 
@@ -295,42 +309,69 @@ def flash_attention_bwd_hmajor(
                          lambda b, n, qb, kb: (b, n // G, kb, 0)),
             pl.BlockSpec((1, 1, block_q, D),
                          lambda b, n, qb, kb: (b, n, qb, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda b, n, qb, kb: (b, n, qb)),
-            pl.BlockSpec((1, 1, block_q), lambda b, n, qb, kb: (b, n, qb)),
+            pl.BlockSpec((1, 1, block_q, 1),
+                         lambda b, n, qb, kb: (b, n, qb, 0)),
+            pl.BlockSpec((1, 1, block_q, 1),
+                         lambda b, n, qb, kb: (b, n, qb, 0)),
         ],
         out_specs=pl.BlockSpec((1, 1, block_q, D),
                                lambda b, n, qb, kb: (b, n, qb, 0)),
         out_shape=jax.ShapeDtypeStruct((B, N, S, D), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        # dq accumulates across k blocks only
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
         interpret=interpret,
     )(q, k, v, do, lse, delta)
     return dq, dkdv[0], dkdv[1]
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _flash_with_vjp(q, k, v, causal, interpret):
+# default tile sizes, overridable per call (swept on hardware by
+# tools/tpu_flash_check.py)
+DEFAULT_BLOCK_Q = 256
+DEFAULT_BLOCK_K = 512
+
+
+def fit_block(default: int, seq: int, floor: int = 128) -> int:
+    """Largest block <= default that divides seq (halving from default, so
+    the result keeps the mult-of-128 lane alignment Mosaic wants). Returns 0
+    if nothing >= floor divides seq — caller falls back to the XLA core."""
+    b = min(default, seq)
+    while b >= floor:
+        if seq % b == 0:
+            return b
+        b //= 2
+    return 0
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_with_vjp(q, k, v, causal, interpret, block_q, block_k):
     qh = q.transpose(0, 2, 1, 3)
     kh = k.transpose(0, 2, 1, 3)
     vh = v.transpose(0, 2, 1, 3)
     out, _ = flash_attention_hmajor(qh, kh, vh, causal=causal,
-                                    interpret=interpret)
+                                    interpret=interpret,
+                                    block_q=block_q, block_k=block_k)
     return out.transpose(0, 2, 1, 3)
 
 
-def _flash_fwd(q, k, v, causal, interpret):
+def _flash_fwd(q, k, v, causal, interpret, block_q, block_k):
     qh = q.transpose(0, 2, 1, 3)
     kh = k.transpose(0, 2, 1, 3)
     vh = v.transpose(0, 2, 1, 3)
     out, lse = flash_attention_hmajor(qh, kh, vh, causal=causal,
-                                      interpret=interpret)
+                                      interpret=interpret,
+                                      block_q=block_q, block_k=block_k)
     return out.transpose(0, 2, 1, 3), (qh, kh, vh, out, lse)
 
 
-def _flash_bwd(causal, interpret, res, g):
+def _flash_bwd(causal, interpret, block_q, block_k, res, g):
     qh, kh, vh, out, lse = res
     dq, dk, dv = flash_attention_bwd_hmajor(
         qh, kh, vh, out, lse, g.transpose(0, 2, 1, 3),
-        causal=causal, interpret=interpret)
+        causal=causal, interpret=interpret,
+        block_q=block_q, block_k=block_k)
     return (dq.transpose(0, 2, 1, 3), dk.transpose(0, 2, 1, 3),
             dv.transpose(0, 2, 1, 3))
 
@@ -338,12 +379,19 @@ def _flash_bwd(causal, interpret, res, g):
 _flash_with_vjp.defvjp(_flash_fwd, _flash_bwd)
 
 
-def flash_sdpa(q, k, v, *, causal: bool = True, interpret: bool = False):
+def flash_sdpa(q, k, v, *, causal: bool = True, interpret: bool = False,
+               block_q: int | None = None, block_k: int | None = None):
     """Drop-in sdpa_fn for modules.apply_attention: [B, S, N, D] layout in
     and out; fully differentiable — forward and backward both run as fused
     Pallas kernels (backward recomputes p per tile from the saved
-    logsumexp), so neither direction materializes [S, S]."""
-    return _flash_with_vjp(q, k, v, causal, interpret)
+    logsumexp), so neither direction materializes [S, S].
+
+    Block defaults are clamped to divisors of S (e.g. S=768 runs 256-wide
+    k blocks even though the tuned default is 512)."""
+    S = q.shape[1]
+    return _flash_with_vjp(q, k, v, causal, interpret,
+                           block_q or fit_block(DEFAULT_BLOCK_Q, S) or S,
+                           block_k or fit_block(DEFAULT_BLOCK_K, S) or S)
 
 
 def make_flash_sdpa(mesh, dp_axes=(), tp_axes=(), *, interpret: bool = False):
@@ -360,16 +408,18 @@ def make_flash_sdpa(mesh, dp_axes=(), tp_axes=(), *, interpret: bool = False):
 
     def sdpa(q, k, v, *, causal=True):
         S = q.shape[1]
-        bq = min(256, S)
-        # shapes the kernel can't tile (non-block-divisible sequence, or
-        # cross-attention with different q/kv lengths): use the XLA core
-        if S % bq or k.shape[1] != S:
+        bq = fit_block(DEFAULT_BLOCK_Q, S)
+        bk = fit_block(DEFAULT_BLOCK_K, S)
+        # shapes the kernel can't tile (no lane-aligned block divides the
+        # sequence, or cross-attention with different q/kv lengths): XLA core
+        if not bq or not bk or k.shape[1] != S:
             from hetu_galvatron_tpu.models.modules import xla_sdpa
 
             return xla_sdpa(q, k, v, causal=causal)
         # nondiff args of a custom_vjp must stay positional
         fn = jax.shard_map(
-            lambda a, b, c: _flash_with_vjp(a, b, c, causal, interpret),
+            lambda a, b, c: _flash_with_vjp(a, b, c, causal, interpret,
+                                            bq, bk),
             mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
             check_vma=False)
         return fn(q, k, v)

@@ -160,13 +160,15 @@ def get_hybrid_parallel_config(
         global_bsz = par.global_train_batch_size
         pipeline_type = par.pipeline_type
         vpp = max(par.virtual_pp_deg, 1)
-        if pp_deg * vpp > n_layers:
-            raise ValueError(
-                f"pp_deg {pp_deg} * virtual_pp_deg {vpp} exceeds the layer "
-                f"count {n_layers}")
         pp_division = default_pp_division(n_layers, pp_deg * vpp)
         chunks = get_chunks(args, world_size)
 
+    # guard both branches: a JSON plan with pp*vpp > layers would otherwise
+    # slip through as zero-layer chunks from default_pp_division
+    if pp_deg * vpp > n_layers:
+        raise ValueError(
+            f"pp_deg {pp_deg} * virtual_pp_deg {vpp} exceeds the layer "
+            f"count {n_layers}")
     if sum(pp_division) != n_layers:
         raise ValueError(f"pp_division {pp_division} != layer count {n_layers}")
     if len(pp_division) != pp_deg * vpp:

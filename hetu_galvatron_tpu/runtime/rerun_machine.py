@@ -212,7 +212,11 @@ class RerunStateMachine:
                 self.state = RerunState.RERUNNING_IN_PLACE
                 if data_iterator is not None:
                     data_iterator.rewind()
-                rerun_value = float(rerun_fn())
+                # injector applies to the re-run too (attempt=1), matching
+                # the validate_results path — a persistent-fault drill must
+                # reproduce on the re-run, not read as nondeterminism
+                rerun_value = self.injector.maybe_corrupt(
+                    float(rerun_fn()), iteration, attempt=1)
                 # NaN == NaN for determinism purposes (same guard as the
                 # validate_results path): a deterministic NaN step is not a
                 # mismatch and must not poison the stats with nan rel-diffs

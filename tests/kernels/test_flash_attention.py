@@ -47,11 +47,17 @@ def test_flash_multiple_q_blocks():
                                rtol=2e-5, atol=2e-5)
 
 
-def test_flash_rejects_bad_block():
-    # 384 is not a multiple of the 256 default block
+def test_flash_fits_blocks_to_seq():
+    # 384 is not a multiple of the 256/512 defaults: the wrapper clamps to
+    # the largest lane-aligned divisor (128) instead of raising
     q, k, v = _qkv(B=1, S=384, N=2, K=2)
+    out = flash_sdpa(q, k, v, causal=True, interpret=True)
+    ref = xla_sdpa(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+    # an explicitly requested non-divisor block still raises
     with pytest.raises(ValueError, match="must divide"):
-        flash_sdpa(q, k, v, interpret=True)
+        flash_sdpa(q, k, v, interpret=True, block_q=256)
 
 
 def test_flash_gradients_match():
