@@ -47,6 +47,9 @@ class ModelArgs(BaseModel):
     rope_theta: float = 10000.0
     tie_word_embeddings: bool = True
     use_flash_attn: bool = True
+    # Pallas fused CE kernel for the single-device loss path (distributed
+    # runs keep the GSPMD vocab-parallel CE; see modules.cross_entropy_loss)
+    use_fused_ce: bool = False
     attention_dropout: float = 0.0
     hidden_dropout: float = 0.0
     make_vocab_size_divisible_by: int = 128

@@ -154,6 +154,7 @@ def causal_lm_loss(
     enc_remat_flags: Optional[Sequence[bool]] = None,
     enc_layer_overrides: Optional[Dict[int, Dict[str, Any]]] = None,
     enc_boundary_fn: Optional[Callable[[int, jax.Array], jax.Array]] = None,
+    fused_ce: Optional[bool] = None,
 ) -> jax.Array:
     """batch: tokens [B,S], labels [B,S], optional loss_mask [B,S] -> scalar.
 
@@ -161,7 +162,12 @@ def causal_lm_loss(
     (dataloader.py:558 _loss_func + train_dist.py forward_backward wiring).
     t5 batches route to the encoder-decoder loss; the ``enc_*`` knobs index
     the encoder stack and are only meaningful there.
+
+    ``fused_ce`` overrides ``cfg.use_fused_ce``; the distributed builder
+    passes False on multi-device meshes (the Pallas CE is a custom call
+    GSPMD cannot partition over a vocab-sharded head).
     """
+    fused = cfg.use_fused_ce if fused_ce is None else fused_ce
     if cfg.model_type == "t5":
         from hetu_galvatron_tpu.models.encdec import encdec_loss
 
@@ -171,14 +177,16 @@ def causal_lm_loss(
                            boundary_fn=boundary_fn,
                            enc_boundary_fn=enc_boundary_fn,
                            layer_overrides=layer_overrides,
-                           enc_layer_overrides=enc_layer_overrides)
+                           enc_layer_overrides=enc_layer_overrides,
+                           fused_ce=fused)
     logits, aux = forward_causal_lm(
         params, batch["tokens"], cfg,
         compute_dtype=compute_dtype, remat_flags=remat_flags,
         layer_overrides=layer_overrides, boundary_fn=boundary_fn,
         with_aux=True,
     )
-    ce = M.cross_entropy_loss(logits, batch["labels"], batch.get("loss_mask"))
+    ce = M.cross_entropy_loss(logits, batch["labels"], batch.get("loss_mask"),
+                              fused=fused)
     return ce + aux
 
 

@@ -251,6 +251,7 @@ def encdec_loss(
     enc_boundary_fn=None,
     layer_overrides=None,
     enc_layer_overrides=None,
+    fused_ce: bool = False,
 ) -> jax.Array:
     """batch: enc_tokens [B,S], tokens (decoder input) [B,T], labels [B,T],
     optional loss_mask."""
@@ -263,4 +264,4 @@ def encdec_loss(
                             layer_overrides=layer_overrides,
                             enc_layer_overrides=enc_layer_overrides)
     return M.cross_entropy_loss(logits, batch["labels"],
-                                batch.get("loss_mask"))
+                                batch.get("loss_mask"), fused=fused_ce)

@@ -416,6 +416,7 @@ def cross_entropy_loss(
     labels: jax.Array,
     loss_mask: Optional[jax.Array] = None,
     z_loss: float = 0.0,
+    fused: bool = False,
 ) -> jax.Array:
     """Stable mean CE over masked tokens; fp32 throughout.
 
@@ -423,13 +424,24 @@ def cross_entropy_loss(
     through logsumexp/take with XLA-inserted collectives, replacing the
     reference's hand-written fused_vocab_parallel_cross_entropy
     (tensor_parallel/triton_cross_entropy.py:219-270).
+
+    ``fused=True`` routes the per-token NLL through the Pallas online
+    logsumexp+gather kernel (ops/pallas/cross_entropy.py) — single-device
+    only (a Pallas call is a custom call GSPMD cannot partition); untileable
+    shapes silently use the XLA path.
     """
-    logits = logits.astype(jnp.float32)
-    lse = jax.scipy.special.logsumexp(logits, axis=-1)
-    gold = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
-    nll = lse - gold
-    if z_loss:
-        nll = nll + z_loss * jnp.square(lse)
+    nll = None
+    if fused:
+        from hetu_galvatron_tpu.ops.pallas.cross_entropy import fused_ce_nll
+
+        nll = fused_ce_nll(logits, labels, z_loss=z_loss)
+    if nll is None:
+        logits = logits.astype(jnp.float32)
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+        nll = lse - gold
+        if z_loss:
+            nll = nll + z_loss * jnp.square(lse)
     if loss_mask is None:
         return jnp.mean(nll)
     loss_mask = loss_mask.astype(jnp.float32)

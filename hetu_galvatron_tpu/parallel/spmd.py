@@ -267,12 +267,16 @@ def make_spmd_train_step(
             enc_layer_overrides=enc_overrides,
             enc_boundary_fn=enc_boundary)
 
+    # the Pallas fused CE is a custom call GSPMD cannot partition over a
+    # vocab-sharded head: force the XLA vocab-parallel CE on real meshes
+    fused_ce = cfg.use_fused_ce and mesh.size == 1
+
     def loss_fn(p, batch):
         return causal_lm_loss(
             p, batch, cfg, compute_dtype=compute_dtype,
             remat_flags=remat if any(remat) else None,
             layer_overrides=layer_overrides, boundary_fn=boundary,
-            **enc_kwargs)
+            fused_ce=fused_ce, **enc_kwargs)
 
     step = make_train_step(loss_fn, tx, chunks=chunks)
 

@@ -59,6 +59,43 @@ def main():
                      "secs": round(time.time() - t0, 1)}
         print(f"{name}: fwd {err:.4f} bwd {gerr:.4f}", file=sys.stderr)
 
+    # -- fused cross-entropy: Mosaic parity + timing ------------------------
+    if os.environ.get("FLASH_CE", "1") != "0":
+        from hetu_galvatron_tpu.ops.pallas.cross_entropy import fused_ce_nll
+
+        T, V = 4096, 50304
+        logits = jnp.asarray(rng.randn(T, V), jnp.bfloat16)
+        labels = jnp.asarray(rng.randint(0, V, (T,)), jnp.int32)
+
+        def ref_nll(x):
+            x = x.astype(jnp.float32)
+            lse = jax.scipy.special.logsumexp(x, axis=-1)
+            gold = jnp.take_along_axis(x, labels[:, None], axis=-1)[:, 0]
+            return lse - gold
+
+        def ce_bench(fn, iters=30):
+            f = jax.jit(jax.grad(lambda x: jnp.mean(fn(x))))
+            r = f(logits)
+            jax.block_until_ready(r)
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                r = f(logits)
+            jax.block_until_ready(r)
+            float(jnp.sum(r).astype(jnp.float32))
+            return (time.perf_counter() - t0) / iters * 1e3
+
+        try:
+            nll = fused_ce_nll(logits, labels)
+            err = float(jnp.max(jnp.abs(nll - ref_nll(logits))))
+            ms_f, ms_x = ce_bench(lambda x: fused_ce_nll(x, labels)), \
+                ce_bench(ref_nll)
+            out["fused_ce"] = {"maxerr": err, "flash_ms": round(ms_f, 3),
+                               "xla_ms": round(ms_x, 3),
+                               "speedup": round(ms_x / ms_f, 3)}
+        except Exception as e:
+            out["fused_ce"] = {"error": f"{type(e).__name__}: {str(e)[:200]}"}
+        print(f"fused_ce: {out['fused_ce']}", file=sys.stderr)
+
     # -- timing sweep -------------------------------------------------------
     shape = os.environ.get("FLASH_SHAPE", "8,1024,12,64")
     B, S, N, D = (int(x) for x in shape.split(","))
