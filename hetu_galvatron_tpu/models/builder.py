@@ -15,7 +15,7 @@ module wrappers, so one traced program covers any searched strategy.
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -154,7 +154,7 @@ def causal_lm_loss(
     enc_remat_flags: Optional[Sequence[bool]] = None,
     enc_layer_overrides: Optional[Dict[int, Dict[str, Any]]] = None,
     enc_boundary_fn: Optional[Callable[[int, jax.Array], jax.Array]] = None,
-    fused_ce: Optional[bool] = None,
+    fused_ce: Union[None, bool, Callable] = None,
 ) -> jax.Array:
     """batch: tokens [B,S], labels [B,S], optional loss_mask [B,S] -> scalar.
 
@@ -163,9 +163,10 @@ def causal_lm_loss(
     t5 batches route to the encoder-decoder loss; the ``enc_*`` knobs index
     the encoder stack and are only meaningful there.
 
-    ``fused_ce`` overrides ``cfg.use_fused_ce``; the distributed builder
-    passes False on multi-device meshes (the Pallas CE is a custom call
-    GSPMD cannot partition over a vocab-sharded head).
+    ``fused_ce`` overrides ``cfg.use_fused_ce``: True runs the Pallas CE
+    kernel directly (single device); on multi-device meshes the distributed
+    builder passes a shard_map nll callable from ``make_vocab_parallel_ce``
+    instead (a bare Pallas call is a custom call GSPMD cannot partition).
     """
     fused = cfg.use_fused_ce if fused_ce is None else fused_ce
     if cfg.model_type == "t5":

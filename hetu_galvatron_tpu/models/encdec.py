@@ -251,7 +251,7 @@ def encdec_loss(
     enc_boundary_fn=None,
     layer_overrides=None,
     enc_layer_overrides=None,
-    fused_ce: bool = False,
+    fused_ce=False,  # bool, or a shard_map nll callable (see builder)
 ) -> jax.Array:
     """batch: enc_tokens [B,S], tokens (decoder input) [B,T], labels [B,T],
     optional loss_mask."""

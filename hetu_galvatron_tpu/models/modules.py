@@ -416,7 +416,7 @@ def cross_entropy_loss(
     labels: jax.Array,
     loss_mask: Optional[jax.Array] = None,
     z_loss: float = 0.0,
-    fused: bool = False,
+    fused=False,
 ) -> jax.Array:
     """Stable mean CE over masked tokens; fp32 throughout.
 
@@ -426,12 +426,15 @@ def cross_entropy_loss(
     (tensor_parallel/triton_cross_entropy.py:219-270).
 
     ``fused=True`` routes the per-token NLL through the Pallas online
-    logsumexp+gather kernel (ops/pallas/cross_entropy.py) — single-device
-    only (a Pallas call is a custom call GSPMD cannot partition); untileable
-    shapes silently use the XLA path.
+    logsumexp+gather kernel (ops/pallas/cross_entropy.py) on one device;
+    distributed callers pass a callable instead (a shard_map nll_fn from
+    ``make_vocab_parallel_ce``, matched to the head's sharding). Untileable
+    shapes silently use the XLA path (both forms return None for them).
     """
     nll = None
-    if fused:
+    if callable(fused):
+        nll = fused(logits, labels, z_loss=z_loss)
+    elif fused:
         from hetu_galvatron_tpu.ops.pallas.cross_entropy import fused_ce_nll
 
         nll = fused_ce_nll(logits, labels, z_loss=z_loss)

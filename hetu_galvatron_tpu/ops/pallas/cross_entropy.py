@@ -136,35 +136,43 @@ def _ce_bwd_call(logits, labels2d, lse, a, b, *, block_t, block_v,
     )(logits, labels2d, lse, a, b)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
-def _ce_rows(logits, labels2d, z_loss, block_t, block_v, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def _ce_lse_gold(logits, labels2d, block_t, block_v, interpret):
+    """Differentiable (lse[T,1], gold[T,1]) via the fused kernels. The nll
+    (and any z-loss / cross-shard combine) is plain JAX on top, so its
+    gradient flows through this VJP: d logits = softmax * d_lse
+    + onehot * d_gold, which the backward kernel emits per vocab tile."""
+    return _ce_fwd_call(logits, labels2d, block_t=block_t,
+                        block_v=block_v, interpret=interpret)
+
+
+def _ce_lse_gold_fwd(logits, labels2d, block_t, block_v, interpret):
     lse, gold = _ce_fwd_call(logits, labels2d, block_t=block_t,
                              block_v=block_v, interpret=interpret)
-    nll = lse[:, 0] - gold[:, 0]
-    if z_loss:
-        nll = nll + z_loss * jnp.square(lse[:, 0])
-    return nll
+    return (lse, gold), (logits, labels2d, lse)
 
 
-def _ce_rows_fwd(logits, labels2d, z_loss, block_t, block_v, interpret):
-    lse, gold = _ce_fwd_call(logits, labels2d, block_t=block_t,
-                             block_v=block_v, interpret=interpret)
-    nll = lse[:, 0] - gold[:, 0]
-    if z_loss:
-        nll = nll + z_loss * jnp.square(lse[:, 0])
-    return nll, (logits, labels2d, lse)
-
-
-def _ce_rows_bwd(z_loss, block_t, block_v, interpret, res, g):
+def _ce_lse_gold_bwd(block_t, block_v, interpret, res, g):
     logits, labels2d, lse = res
-    g2 = g[:, None].astype(jnp.float32)
-    a = g2 * (1.0 + 2.0 * z_loss * lse) if z_loss else g2
-    dx = _ce_bwd_call(logits, labels2d, lse, a, g2, block_t=block_t,
-                      block_v=block_v, interpret=interpret)
+    d_lse, d_gold = g
+    dx = _ce_bwd_call(logits, labels2d, lse,
+                      d_lse.astype(jnp.float32),
+                      -d_gold.astype(jnp.float32),
+                      block_t=block_t, block_v=block_v, interpret=interpret)
     return dx, np.zeros(labels2d.shape, dtype=jax.dtypes.float0)
 
 
-_ce_rows.defvjp(_ce_rows_fwd, _ce_rows_bwd)
+_ce_lse_gold.defvjp(_ce_lse_gold_fwd, _ce_lse_gold_bwd)
+
+
+def _fit_blocks(T: int, V: int, block_t: int):
+    bv = fit_vocab_block(V)
+    bt = block_t
+    while bt > 8 and T % bt:
+        bt //= 2
+    if not bv or T % bt:
+        return None
+    return bt, bv
 
 
 def fused_ce_nll(logits: jax.Array, labels: jax.Array, *,
@@ -176,16 +184,86 @@ def fused_ce_nll(logits: jax.Array, labels: jax.Array, *,
     V = logits.shape[-1]
     lead = logits.shape[:-1]
     T = int(np.prod(lead)) if lead else 1
-    bv = fit_vocab_block(V)
-    bt = block_t
-    while bt > 8 and T % bt:
-        bt //= 2
-    if not bv or T % bt:
+    fit = _fit_blocks(T, V, block_t)
+    if fit is None:
         return None
+    bt, bv = fit
     # Mosaic only exists on TPU; anywhere else (CPU tests, smoke runs) the
     # kernel runs in interpret mode so the flag is safe on any backend
     interpret = interpret or jax.default_backend() != "tpu"
-    nll = _ce_rows(logits.reshape(T, V),
-                   labels.reshape(T, 1).astype(jnp.int32),
-                   float(z_loss), bt, bv, interpret)
+    lse, gold = _ce_lse_gold(logits.reshape(T, V),
+                             labels.reshape(T, 1).astype(jnp.int32),
+                             bt, bv, interpret)
+    nll = lse[:, 0] - gold[:, 0]
+    if z_loss:
+        nll = nll + z_loss * jnp.square(lse[:, 0])
     return nll.reshape(lead)
+
+
+def make_vocab_parallel_ce(mesh, vocab_sharding, *, z_loss: float = 0.0,
+                           interpret: bool = False, block_t: int = 256):
+    # NOTE: the returned nll_fn accepts a per-call z_loss override so
+    # cross_entropy_loss's z_loss parameter behaves identically whether
+    # `fused` is True (kernel direct) or this callable (see modules.py).
+    """Distributed fused CE: per-token NLL over logits sharded by the
+    embedding/LM-head strategy — the TPU counterpart of the reference's
+    vocab-parallel Triton CE (triton_cross_entropy.py:219-270), which
+    reduces per-shard (max, sumexp, gold) across the TP group.
+
+    Under shard_map each shard runs the fused kernel on its local
+    [B_l, S_l, V_l] logits; when the vocab dim is sharded (vtp without
+    vsp), local gold/lse combine with a pmax/psum logsumexp merge. With
+    vsp (ulysses-style: sequence sharded, head replicated) no collective
+    is needed. Returns ``nll_fn(logits, labels) -> nll`` or None when the
+    local shapes cannot tile.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    sh = vocab_sharding
+    seq_axes = tuple(sh.cp_axes) + (tuple(sh.tp_axes) if sh.ulysses else ())
+    vocab_axes = () if sh.ulysses else tuple(sh.tp_axes)
+    n_vocab_shards = int(np.prod([mesh.shape[a] for a in vocab_axes])) \
+        if vocab_axes else 1
+    n_seq = int(np.prod([mesh.shape[a] for a in seq_axes])) if seq_axes else 1
+    n_dp = int(np.prod([mesh.shape[a] for a in sh.dp_axes])) \
+        if sh.dp_axes else 1
+    logits_spec = P(sh.dp_axes or None, seq_axes or None, vocab_axes or None)
+    labels_spec = P(sh.dp_axes or None, seq_axes or None)
+
+    def nll_fn(logits, labels, z_loss=z_loss):
+        B, S, V = logits.shape
+        if V % n_vocab_shards or S % n_seq or B % n_dp:
+            return None
+        fit = _fit_blocks((B // n_dp) * (S // n_seq), V // n_vocab_shards,
+                          block_t)
+        if fit is None:
+            return None
+        bt, bv = fit
+        interp = interpret or jax.default_backend() != "tpu"
+
+        def local(lg, lb):
+            Bl, Sl, Vl = lg.shape
+            offset = jnp.int32(0)
+            for ax in vocab_axes:  # major-to-minor, matching P's layout
+                offset = offset * mesh.shape[ax] + jax.lax.axis_index(ax)
+            lab = lb.reshape(-1, 1).astype(jnp.int32) - offset * Vl
+            lse, gold = _ce_lse_gold(lg.reshape(-1, Vl), lab, bt, bv, interp)
+            if vocab_axes:
+                # logsumexp merge across vocab shards; m is a numerical
+                # anchor only (lse is m-independent) so it takes no
+                # gradient — and pmax has no JVP rule, so stop_gradient
+                # must come BEFORE it (pmax then only ever sees constants)
+                m = jax.lax.pmax(jax.lax.stop_gradient(lse), vocab_axes)
+                lse = m + jnp.log(jax.lax.psum(jnp.exp(lse - m), vocab_axes))
+                gold = jax.lax.psum(gold, vocab_axes)
+            nll = lse[:, 0] - gold[:, 0]
+            if z_loss:
+                nll = nll + z_loss * jnp.square(lse[:, 0])
+            return nll.reshape(Bl, Sl)
+
+        return jax.shard_map(local, mesh=mesh,
+                             in_specs=(logits_spec, labels_spec),
+                             out_specs=labels_spec,
+                             check_vma=False)(logits, labels)
+
+    return nll_fn

@@ -267,9 +267,18 @@ def make_spmd_train_step(
             enc_layer_overrides=enc_overrides,
             enc_boundary_fn=enc_boundary)
 
-    # the Pallas fused CE is a custom call GSPMD cannot partition over a
-    # vocab-sharded head: force the XLA vocab-parallel CE on real meshes
-    fused_ce = cfg.use_fused_ce and mesh.size == 1
+    # Fused CE on a mesh: a bare Pallas call is a custom call GSPMD cannot
+    # partition, so distributed runs get the shard_map vocab-parallel
+    # wrapper matched to the head's sharding (pmax/psum logsumexp merge
+    # across vocab shards — the reference's Triton vocab-parallel CE
+    # semantics); single-device runs use the kernel directly.
+    fused_ce = cfg.use_fused_ce
+    if fused_ce and mesh.size > 1:
+        from hetu_galvatron_tpu.ops.pallas.cross_entropy import (
+            make_vocab_parallel_ce,
+        )
+
+        fused_ce = make_vocab_parallel_ce(mesh, vocab)
 
     def loss_fn(p, batch):
         return causal_lm_loss(
