@@ -230,7 +230,8 @@ def train(args) -> Dict[str, Any]:
 
     if hpc.pp_deg > 1:
         eng = PipelineEngine(cfg, hpc, args.train, devices=state.devices,
-                             compute_dtype=compute_dtype)
+                             compute_dtype=compute_dtype,
+                             dcn_slices=args.parallel.dcn_slices)
         sp = eng.split_params(params, axes)
         so = eng.init_opt(sp, axes)
         sp, so, start_iter = maybe_resume(sp, so)
@@ -241,7 +242,8 @@ def train(args) -> Dict[str, Any]:
             run_loop(sp, so, lambda sp_, so_, b: eng.train_step(
                 sp_, so_, b, num_microbatches=calc.num_micro_batches))
     else:
-        mesh = build_mesh(world, 1, devices=state.devices)
+        mesh = build_mesh(world, 1, devices=state.devices,
+                          dcn_slices=args.parallel.dcn_slices)
         # donation halves live model-state memory but is only safe when the
         # rerun machine will never re-call the step on pre-update buffers
         step, pspecs, ospecs, batch_shd = make_spmd_train_step(

@@ -141,6 +141,18 @@ class ParallelArgs(BaseModel):
     # world
     num_devices: int = 0  # 0 => use every visible chip
     dp_axis_on_dcn: bool = True  # outermost dp/pp on DCN for multi-host pods
+    # multi-host runtime init (reference _initialize_distributed,
+    # runtime/initialize.py:114-160, reads torchrun's RANK/WORLD_SIZE; the
+    # TPU equivalent is jax.distributed.initialize, auto-detecting on pods).
+    # 0 processes => single-process; unset fields fall back to the
+    # COORDINATOR_ADDRESS/NUM_PROCESSES/PROCESS_ID env (launcher-set) or,
+    # on Cloud TPU pods, to the metadata service autodetection.
+    num_processes: int = 0
+    coordinator_address: Optional[str] = None
+    process_id: Optional[int] = None
+    # DCN topology: number of ICI slices (pods) the job spans; >1 arranges
+    # the mesh so pp + outer dp axes cross DCN and tp/cp stay ICI-local
+    dcn_slices: int = 1
 
     @model_validator(mode="after")
     def _check(self):

@@ -15,6 +15,7 @@ The reference's env-based RANK/WORLD_SIZE handshake and NCCL init
 from __future__ import annotations
 
 import logging
+import os
 import random
 from dataclasses import dataclass, field
 from typing import Any, List, Optional
@@ -104,6 +105,49 @@ def _make_writers(args: CoreArgs):
     return tb, wb
 
 
+def initialize_distributed(args: CoreArgs) -> bool:
+    """Multi-host runtime init — the TPU-native leg of the reference's
+    ``_initialize_distributed`` (runtime/initialize.py:114-160): where the
+    reference reads torchrun's RANK/WORLD_SIZE and calls
+    ``dist.init_process_group(nccl)``, a TPU pod joins the JAX coordination
+    service (``jax.distributed.initialize``), after which ``jax.devices()``
+    spans every host's chips and GSPMD collectives ride ICI/DCN.
+
+    Triggered by parallel.num_processes > 1 (explicit) or the
+    COORDINATOR_ADDRESS env (launcher-set); on Cloud TPU pods all arguments
+    autodetect from the metadata service. Returns True when the
+    coordination service was (already) initialized. Safe to call once per
+    process; subsequent calls are no-ops.
+    """
+    import jax
+
+    par = args.parallel
+    env_addr = os.environ.get("COORDINATOR_ADDRESS")
+    want = par.num_processes > 1 or env_addr is not None
+    if not want:
+        return False
+    if jax.distributed.is_initialized():
+        return True
+    kwargs = {}
+    addr = par.coordinator_address or env_addr
+    if addr:
+        kwargs["coordinator_address"] = addr
+    # env mirrors every config field (NUM_PROCESSES/PROCESS_ID), so a
+    # launcher can drive the whole handshake without touching the YAML
+    nproc = par.num_processes
+    if nproc <= 1 and os.environ.get("NUM_PROCESSES") is not None:
+        nproc = int(os.environ["NUM_PROCESSES"])
+    if nproc > 1:
+        kwargs["num_processes"] = nproc
+    pid = par.process_id
+    if pid is None and os.environ.get("PROCESS_ID") is not None:
+        pid = int(os.environ["PROCESS_ID"])
+    if pid is not None:
+        kwargs["process_id"] = pid
+    jax.distributed.initialize(**kwargs)
+    return True
+
+
 def initialize(args: CoreArgs, devices: Optional[List[Any]] = None
                ) -> RunState:
     """Validate + seed + discover devices; returns (and stores) the run
@@ -112,6 +156,8 @@ def initialize(args: CoreArgs, devices: Optional[List[Any]] = None
     global _STATE
     import jax
 
+    if devices is None:
+        initialize_distributed(args)
     devices = list(devices if devices is not None else jax.devices())
     world = (args.parallel.num_devices if args.parallel.num_devices > 0
              else len(devices))

@@ -29,6 +29,7 @@ Logical param axes (see models/modules.py init_*) map per layer:
 
 from __future__ import annotations
 
+import math
 from dataclasses import dataclass
 from typing import Optional, Sequence, Tuple
 
@@ -54,17 +55,47 @@ def _log2(n: int) -> int:
     return k
 
 
-def build_mesh(
+def dcn_factor_shape(global_shape: Tuple[int, ...], dcn_slices: int
+                     ) -> Tuple[int, ...]:
+    """Factor ``dcn_slices`` over the LEADING mesh axes (pp first, then the
+    outer binary d-axes): pipeline stages and outer-dp replicas cross DCN
+    while tp/cp stay on the inner, ICI-local axes — the reference's
+    'consecutive ranks on NVLink' locality (comm_groups.py:96-100) lifted to
+    the pod level. Returns the per-axis DCN factors; raises when the slices
+    cannot divide the leading axes."""
+    left = dcn_slices
+    out = []
+    for dim in global_shape:
+        f = math.gcd(left, dim)
+        out.append(f)
+        left //= f
+    if left != 1:
+        raise ValueError(
+            f"dcn_slices {dcn_slices} does not factor over the leading mesh "
+            f"axes {global_shape} (pp * outer-dp must absorb the slices)")
+    return tuple(out)
+
+
+def device_array(
     world_size: int,
     pp_deg: int = 1,
     devices: Optional[Sequence] = None,
-) -> Mesh:
-    """One global mesh: ('pp', 'd0', ..., 'd{k-1}') with binary d-axes.
+    dcn_slices: int = 1,
+) -> np.ndarray:
+    """Device ndarray of shape ``(pp, 2, ..., 2)`` behind :func:`build_mesh`
+    — also used by the pipeline engine to carve DCN-aligned stage groups.
 
-    ``devices`` defaults to jax.devices(). Device order: pp outermost (stage
-    boundaries cross the slowest links), then d0..dk with dk fastest-varying
-    (tp-adjacent chips are ICI neighbours, the reference's "consecutive"
-    locality, comm_groups.py:96-100).
+    Order: pp outermost (stage boundaries cross the slowest links), then
+    d0..dk with dk fastest-varying (tp-adjacent chips are ICI neighbours,
+    the reference's "consecutive" locality, comm_groups.py:96-100).
+
+    ``dcn_slices > 1`` (multi-pod): devices are arranged with
+    ``mesh_utils.create_hybrid_device_mesh`` so slice boundaries land on the
+    leading axes (pp, then outer d) and every inner axis stays within one
+    ICI domain (TPU pods granule by ``slice_index``; multi-process hosts
+    without it granule by process). Falls back to the plain enumeration
+    order when the devices carry no multi-process topology (tests /
+    virtual platforms).
     """
     devices = list(devices if devices is not None else jax.devices())
     if len(devices) < world_size:
@@ -77,8 +108,35 @@ def build_mesh(
     stage = world_size // pp_deg
     k = _log2(stage)
     shape = (pp_deg,) + (2,) * k
-    names = ("pp",) + tuple(f"d{i}" for i in range(k))
-    return Mesh(np.asarray(devices).reshape(shape), names)
+    if dcn_slices > 1:
+        n_proc = len({getattr(d, "process_index", 0) for d in devices})
+        if n_proc > 1:
+            from jax.experimental import mesh_utils
+
+            dcn_shape = dcn_factor_shape(shape, dcn_slices)
+            ici_shape = tuple(g // f for g, f in zip(shape, dcn_shape))
+            # TPU pods carry slice_index; other multi-process platforms
+            # (multi-host CPU/GPU) granule by process instead
+            by_slice = all(hasattr(d, "slice_index") for d in devices)
+            return mesh_utils.create_hybrid_device_mesh(
+                ici_shape, dcn_shape, devices=devices,
+                process_is_granule=not by_slice)
+        # single-process (virtual CPU tests): topology is synthetic anyway;
+        # plain enumeration already puts the leading axes outermost
+    return np.asarray(devices).reshape(shape)
+
+
+def build_mesh(
+    world_size: int,
+    pp_deg: int = 1,
+    devices: Optional[Sequence] = None,
+    dcn_slices: int = 1,
+) -> Mesh:
+    """One global mesh: ('pp', 'd0', ..., 'd{k-1}') with binary d-axes over
+    the :func:`device_array` arrangement (see there for ordering/DCN)."""
+    arr = device_array(world_size, pp_deg, devices, dcn_slices)
+    names = ("pp",) + tuple(f"d{i}" for i in range(arr.ndim - 1))
+    return Mesh(arr, names)
 
 
 def stage_axes(mesh: Mesh) -> Tuple[str, ...]:

@@ -54,6 +54,7 @@ from hetu_galvatron_tpu.runtime.hybrid_config import HybridParallelConfig
 from hetu_galvatron_tpu.runtime.mesh import (
     LayerSharding,
     build_mesh,
+    device_array,
     lower_strategy,
     lower_vocab_strategy,
 )
@@ -114,6 +115,7 @@ class PipelineEngine:
         devices: Optional[List] = None,
         *,
         compute_dtype=jnp.bfloat16,
+        dcn_slices: int = 1,
     ):
         self.cfg = cfg
         self.hpc = hpc
@@ -135,7 +137,12 @@ class PipelineEngine:
         if len(devices) < hpc.world_size:
             raise ValueError(
                 f"need {hpc.world_size} devices, have {len(devices)}")
-        devices = devices[:hpc.world_size]
+        # DCN-aware global arrangement BEFORE carving stage groups: with
+        # dcn_slices > 1 the pp axis (and outer dp) land on slice
+        # boundaries, so each stage's submesh stays ICI-local
+        devices = list(device_array(
+            hpc.world_size, self.pp, devices[:hpc.world_size],
+            dcn_slices).flat)
         per_stage = hpc.world_size // self.pp
         self.tx = _pipeline_optimizer(train)
         self.stages: List[_Stage] = []

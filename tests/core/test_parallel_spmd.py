@@ -235,3 +235,41 @@ def test_multi_step_trajectory_matches_single_device(cpu_devices):
     for a, b in zip(jax.tree.leaves(ref_p), jax.tree.leaves(sp)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-3, atol=1e-3)
+
+
+def test_dcn_factor_shape():
+    """dcn slices factor over pp first, then the outer binary d-axes, so
+    tp/cp-bearing inner axes never cross DCN (reference locality,
+    comm_groups.py:96-100, lifted to pod level)."""
+    from hetu_galvatron_tpu.runtime.mesh import dcn_factor_shape
+
+    assert dcn_factor_shape((1, 2, 2, 2), 2) == (1, 2, 1, 1)
+    assert dcn_factor_shape((2, 2, 2, 2), 2) == (2, 1, 1, 1)
+    assert dcn_factor_shape((2, 2, 2, 2), 4) == (2, 2, 1, 1)
+    assert dcn_factor_shape((6, 2, 2), 4) == (2, 2, 1)  # pp 6 = 2 dcn x 3 ici
+    with pytest.raises(ValueError, match="does not factor"):
+        dcn_factor_shape((1, 2, 2), 8)
+
+
+def test_build_mesh_dcn_single_process_fallback(cpu_devices):
+    """Virtual CPU devices carry no pod topology: dcn_slices falls back to
+    enumeration order (leading axes are outermost either way) and the mesh
+    still lowers strategies normally."""
+    from hetu_galvatron_tpu.runtime.mesh import build_mesh
+
+    mesh = build_mesh(8, 1, devices=cpu_devices, dcn_slices=2)
+    assert mesh.axis_names == ("pp", "d0", "d1", "d2")
+    assert mesh.shape["pp"] == 1
+    s = lower_strategy(
+        LayerStrategy(pp_deg=1, tp_size=2, dp_size=4), mesh)
+    assert s.tp_axes and s.dp_axes
+
+
+def test_initialize_distributed_noop_single_process(monkeypatch):
+    """num_processes<=1 and no COORDINATOR_ADDRESS => no coordination
+    service; initialize() keeps working single-process."""
+    from hetu_galvatron_tpu.runtime.initialize import initialize_distributed
+
+    monkeypatch.delenv("COORDINATOR_ADDRESS", raising=False)
+    args = _args()
+    assert initialize_distributed(args) is False
