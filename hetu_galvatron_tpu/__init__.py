@@ -53,4 +53,8 @@ def __getattr__(name):
         )
 
         return get_hybrid_parallel_config
+    if name == "generate":
+        from hetu_galvatron_tpu.models.generate import generate
+
+        return generate
     raise AttributeError(name)

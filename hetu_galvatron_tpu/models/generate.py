@@ -1,0 +1,200 @@
+"""Autoregressive generation with a KV cache (single device).
+
+The reference ships only inference-context stubs in its attention layer
+(transformer/attention.py inference params); this module provides a working
+TPU-native decode path: static-shape KV cache buffers, a `lax.scan` decode
+loop (one compiled step reused for every position), greedy or
+temperature/top-k sampling, and EOS masking — no data-dependent Python
+control flow, so the whole generate() jits.
+
+The transformer math is NOT re-implemented here: both prefill and the
+decode step run `modules.apply_decoder_layer` with an `sdpa_fn` closure
+that captures (and, when decoding, updates) the rope-applied k/v — the
+same hook the distributed layer uses for flash/ring/Ulysses attention, so
+any change to the block stays in one place.
+
+Scope: dense causal decoder families (gpt/llama/qwen/mistral: pre-norm,
+learned or rope positions, GQA, biases). MoE and encoder-decoder decode are
+out of scope here.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from hetu_galvatron_tpu.core.args_schema import ModelArgs
+from hetu_galvatron_tpu.models import modules as M
+
+Params = Dict[str, Any]
+
+
+def _check_supported(cfg: ModelArgs, params: Params) -> None:
+    if cfg.post_norm or cfg.model_type == "bert":
+        raise NotImplementedError("generate(): causal decoder families only")
+    if cfg.model_type == "t5":
+        raise NotImplementedError("generate(): t5 decode not implemented")
+    if any("moe" in lp for lp in params["layers"]):
+        raise NotImplementedError("generate(): dense layers only")
+
+
+def _cached_sdpa(q, ck, cv, pos):
+    """q [B,1,Nq,D] against the full cache [B,T,Nkv,D]; positions > pos are
+    masked (static T => one compiled shape for the whole decode scan)."""
+    B, _, nq, D = q.shape
+    T, nkv = ck.shape[1], ck.shape[2]
+    G = nq // nkv
+    qg = q.reshape(B, nkv, G, D).astype(jnp.float32)
+    s = jnp.einsum("bkgd,btkd->bkgt", qg, ck.astype(jnp.float32))
+    s = s / jnp.sqrt(jnp.float32(D))
+    mask = jnp.arange(T)[None, None, None, :] <= pos
+    s = jnp.where(mask, s, jnp.float32(jnp.finfo(jnp.float32).min))
+    w = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bkgt,btkd->bkgd", w, cv.astype(jnp.float32))
+    return out.reshape(B, 1, nq, D).astype(q.dtype)
+
+
+def _embed_at(p: Params, tokens: jax.Array, pos, cfg: ModelArgs,
+              compute_dtype):
+    """Token embedding for one decode step at absolute position ``pos``."""
+    x = jnp.take(p["wte"], tokens[:, None], axis=0)  # [B,1,H]
+    if "wpe" in p:
+        x = x + jax.lax.dynamic_slice_in_dim(p["wpe"], pos, 1)[None]
+    return x.astype(compute_dtype)
+
+
+def init_kv_cache(cfg: ModelArgs, batch: int, max_len: int,
+                  dtype=jnp.bfloat16):
+    n = cfg.num_hidden_layers
+    shape = (batch, max_len, cfg.kv_heads, cfg.head_dim)
+    return [{"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+            for _ in range(n)]
+
+
+def prefill(params: Params, tokens: jax.Array, cfg: ModelArgs, max_len: int,
+            *, compute_dtype=jnp.bfloat16):
+    """Run the prompt through the stack, filling the cache; returns
+    (cache, logits_last [B, V])."""
+    B, S0 = tokens.shape
+    rope = None
+    if cfg.position_embedding_type == "rope":
+        rope = M.rope_cos_sin(S0, cfg.head_dim, cfg.rope_theta)
+    cache = init_kv_cache(cfg, B, max_len, compute_dtype)
+    x = M.apply_embedding(params["embed"], tokens, cfg,
+                          compute_dtype=compute_dtype)
+    for i, lp in enumerate(params["layers"]):
+        cell = {}
+
+        def sdpa(q, k, v, *, causal=True, cell=cell):
+            cell["k"], cell["v"] = k, v  # rope-applied, pre-attention
+            return M.xla_sdpa(q, k, v, causal=causal)
+
+        x = M.apply_decoder_layer(lp, x, cfg, rope=rope, sdpa_fn=sdpa,
+                                  compute_dtype=compute_dtype)
+        cache[i] = {
+            "k": jax.lax.dynamic_update_slice_in_dim(
+                cache[i]["k"], cell["k"].astype(cache[i]["k"].dtype), 0,
+                axis=1),
+            "v": jax.lax.dynamic_update_slice_in_dim(
+                cache[i]["v"], cell["v"].astype(cache[i]["v"].dtype), 0,
+                axis=1),
+        }
+    x = M.apply_norm(params["prenorm"], x, cfg)
+    logits = M.apply_lm_head(params["head"], x[:, -1:], cfg,
+                             wte=params["embed"]["wte"],
+                             compute_dtype=compute_dtype)
+    return cache, logits[:, 0]
+
+
+def decode_step(params: Params, cache, tokens: jax.Array, pos, cfg: ModelArgs,
+                *, rope_full=None, compute_dtype=jnp.bfloat16):
+    """One token per sequence at absolute position ``pos`` (a traced
+    scalar); returns (cache, logits [B, V])."""
+    x = _embed_at(params["embed"], tokens, pos, cfg, compute_dtype)
+    step_rope = None
+    if rope_full is not None:
+        cos, sin = rope_full
+        step_rope = (jax.lax.dynamic_slice_in_dim(cos, pos, 1),
+                     jax.lax.dynamic_slice_in_dim(sin, pos, 1))
+    for i, lp in enumerate(params["layers"]):
+        cell = {}
+
+        def sdpa(q, k, v, *, causal=True, i=i, cell=cell):
+            ck = jax.lax.dynamic_update_slice_in_dim(
+                cache[i]["k"], k.astype(cache[i]["k"].dtype), pos, axis=1)
+            cv = jax.lax.dynamic_update_slice_in_dim(
+                cache[i]["v"], v.astype(cache[i]["v"].dtype), pos, axis=1)
+            cell["k"], cell["v"] = ck, cv
+            return _cached_sdpa(q, ck, cv, pos)
+
+        x = M.apply_decoder_layer(lp, x, cfg, rope=step_rope, sdpa_fn=sdpa,
+                                  compute_dtype=compute_dtype)
+        cache[i] = {"k": cell["k"], "v": cell["v"]}
+    x = M.apply_norm(params["prenorm"], x, cfg)
+    logits = M.apply_lm_head(params["head"], x, cfg,
+                             wte=params["embed"]["wte"],
+                             compute_dtype=compute_dtype)
+    return cache, logits[:, 0]
+
+
+def generate(
+    params: Params,
+    tokens: jax.Array,  # [B, S0] prompt
+    cfg: ModelArgs,
+    max_new_tokens: int,
+    *,
+    temperature: float = 0.0,  # 0 => greedy
+    top_k: Optional[int] = None,
+    eos_id: Optional[int] = None,
+    key: Optional[jax.Array] = None,
+    compute_dtype=jnp.bfloat16,
+) -> jax.Array:
+    """Returns [B, S0 + max_new_tokens]; after EOS a sequence keeps emitting
+    ``eos_id``. Fully jittable (static shapes; scan over positions)."""
+    _check_supported(cfg, params)
+    B, S0 = tokens.shape
+    total = S0 + max_new_tokens
+    if total > cfg.max_position_embeddings and "wpe" in params["embed"]:
+        raise ValueError(f"{total} exceeds max_position_embeddings")
+    rope_full = None
+    if cfg.position_embedding_type == "rope":
+        rope_full = M.rope_cos_sin(total, cfg.head_dim, cfg.rope_theta)
+    if key is None:
+        key = jax.random.key(0)
+
+    cache, logits = prefill(params, tokens, cfg, total,
+                            compute_dtype=compute_dtype)
+    # vocab-padding columns (padded_vocab_size > vocab_size) hold untrained
+    # head weights: never sample them
+    valid = jnp.arange(cfg.padded_vocab_size) < cfg.vocab_size
+
+    def pick(logits, k):
+        logits = jnp.where(valid, logits, jnp.finfo(logits.dtype).min)
+        if temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1).astype(tokens.dtype)
+        logits = logits / temperature
+        if top_k:
+            kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
+            logits = jnp.where(logits < kth,
+                               jnp.finfo(logits.dtype).min, logits)
+        return jax.random.categorical(k, logits, axis=-1).astype(tokens.dtype)
+
+    def body(carry, _):
+        cache, logits, pos, done, k = carry
+        k, sub = jax.random.split(k)
+        nxt = pick(logits, sub)
+        if eos_id is not None:
+            nxt = jnp.where(done, jnp.asarray(eos_id, nxt.dtype), nxt)
+            done = done | (nxt == eos_id)
+        cache, logits = decode_step(params, cache, nxt, pos, cfg,
+                                    rope_full=rope_full,
+                                    compute_dtype=compute_dtype)
+        return (cache, logits, pos + 1, done, k), nxt
+
+    done0 = jnp.zeros((B,), bool)
+    (_, logits, _, done, _), toks = jax.lax.scan(
+        body, (cache, logits, jnp.int32(S0), done0, key), None,
+        length=max_new_tokens)
+    return jnp.concatenate([tokens, toks.T], axis=1)

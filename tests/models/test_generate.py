@@ -1,0 +1,98 @@
+"""KV-cache generation vs naive full-forward decoding (the cache path must
+reproduce the exact greedy chain the training forward implies)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hetu_galvatron_tpu.core.args_schema import ModelArgs
+from hetu_galvatron_tpu.models.builder import forward_causal_lm, init_causal_lm
+from hetu_galvatron_tpu.models.generate import generate
+
+pytestmark = pytest.mark.model
+
+
+def _cfg(**kw):
+    base = dict(
+        hidden_size=64, num_hidden_layers=2, num_attention_heads=4,
+        vocab_size=128, max_position_embeddings=64, seq_length=32,
+        hidden_act="swiglu", normalization="rmsnorm",
+        position_embedding_type="rope", tie_word_embeddings=False,
+        add_bias_linear=False, add_qkv_bias=False,
+        make_vocab_size_divisible_by=1, ffn_hidden_size=128)
+    base.update(kw)
+    return ModelArgs(**base)
+
+
+def _naive_greedy(params, tokens, cfg, n, dtype=jnp.float32):
+    """Re-run the FULL training forward on the growing sequence each step."""
+    for _ in range(n):
+        logits = forward_causal_lm(params, tokens, cfg, compute_dtype=dtype)
+        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(tokens.dtype)
+        tokens = jnp.concatenate([tokens, nxt[:, None]], axis=1)
+    return tokens
+
+
+@pytest.mark.parametrize("kw", [
+    dict(),  # llama-style: rope + rmsnorm + swiglu, MHA
+    dict(num_attention_heads=4, num_key_value_heads=2),  # GQA
+    dict(position_embedding_type="learned", normalization="layernorm",
+         hidden_act="gelu", add_bias_linear=True, add_qkv_bias=True,
+         tie_word_embeddings=True),  # gpt2-style
+], ids=["llama", "gqa", "gpt2"])
+def test_cached_greedy_matches_naive(kw):
+    cfg = _cfg(**kw)
+    params, _ = init_causal_lm(jax.random.key(0), cfg)
+    prompt = jnp.asarray(
+        np.random.RandomState(0).randint(0, 128, (2, 8)), jnp.int32)
+    want = _naive_greedy(params, prompt, cfg, 12)
+    got = generate(params, prompt, cfg, 12, compute_dtype=jnp.float32)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_generate_jits_and_eos_masks():
+    cfg = _cfg()
+    params, _ = init_causal_lm(jax.random.key(1), cfg)
+    prompt = jnp.asarray(
+        np.random.RandomState(1).randint(0, 128, (2, 4)), jnp.int32)
+    fn = jax.jit(lambda p, t: generate(p, t, cfg, 8, eos_id=5,
+                                       compute_dtype=jnp.float32))
+    out = np.asarray(fn(params, prompt))
+    assert out.shape == (2, 12)
+    # after the first eos, every later token must be eos
+    for row in out:
+        hits = np.where(row[4:] == 5)[0]
+        if hits.size:
+            assert (row[4 + hits[0]:] == 5).all()
+
+
+def test_generate_sampling_shapes_and_topk():
+    cfg = _cfg()
+    params, _ = init_causal_lm(jax.random.key(2), cfg)
+    prompt = jnp.zeros((3, 2), jnp.int32)
+    out = generate(params, prompt, cfg, 5, temperature=0.8, top_k=10,
+                   key=jax.random.key(3), compute_dtype=jnp.float32)
+    assert out.shape == (3, 7)
+    assert (np.asarray(out) >= 0).all() and (np.asarray(out) < 128).all()
+
+
+def test_generate_never_samples_vocab_padding():
+    """padded_vocab_size > vocab_size: the padding columns hold untrained
+    head weights and must be masked out of both argmax and sampling."""
+    cfg = _cfg(vocab_size=100, make_vocab_size_divisible_by=128)
+    assert cfg.padded_vocab_size == 128
+    params, _ = init_causal_lm(jax.random.key(4), cfg)
+    prompt = jnp.zeros((4, 2), jnp.int32)
+    for temp in (0.0, 1.5):
+        out = np.asarray(generate(params, prompt, cfg, 6, temperature=temp,
+                                  key=jax.random.key(5),
+                                  compute_dtype=jnp.float32))
+        assert (out < 100).all(), out.max()
+
+
+def test_generate_rejects_unsupported():
+    cfg = _cfg(model_type="bert", position_embedding_type="learned",
+               normalization="layernorm", hidden_act="gelu")
+    params, _ = init_causal_lm(jax.random.key(0), _cfg())
+    with pytest.raises(NotImplementedError):
+        generate(params, jnp.zeros((1, 2), jnp.int32), cfg, 2)
