@@ -157,26 +157,28 @@ def main():
     flops_tok = model_flops_per_token(cfg, seq)
     tx = make_optimizer(TrainArgs(lr=1e-4, lr_decay_style="constant"))
 
-    def build_step(use_flash: bool):
+    def build_step(use_flash: bool, cfg_local=None):
+        cfg_local = cfg_local or cfg
         overrides = None
         if use_flash:
             from hetu_galvatron_tpu.ops.pallas.flash_attention import flash_sdpa
 
             overrides = {i: {"sdpa_fn": flash_sdpa}
-                         for i in range(cfg.num_hidden_layers)}
-        loss_fn = make_loss_fn(cfg, compute_dtype=jnp.bfloat16,
+                         for i in range(cfg_local.num_hidden_layers)}
+        loss_fn = make_loss_fn(cfg_local, compute_dtype=jnp.bfloat16,
                                layer_overrides=overrides)
         return jax.jit(make_train_step(loss_fn, tx), donate_argnums=(0, 1))
 
-    def measure(use_flash: bool, bsz: int):
+    def measure(use_flash: bool, bsz: int, cfg_local=None):
         """Compile + warm + time one (attention impl, bsz) config.
         Returns tokens/sec, or raises (OOM / Mosaic failure)."""
-        step = build_step(use_flash)
-        params, _ = init_causal_lm(jax.random.key(0), cfg)
+        cfg_local = cfg_local or cfg
+        step = build_step(use_flash, cfg_local)
+        params, _ = init_causal_lm(jax.random.key(0), cfg_local)
         params = jax.device_put(params, dev)
         opt = jax.jit(tx.init)(params)
         data = np.random.RandomState(0).randint(
-            0, cfg.padded_vocab_size, (bsz, seq + 1))
+            0, cfg_local.padded_vocab_size, (bsz, seq + 1))
         batch = jax.device_put(
             jax.tree.map(jnp.asarray, make_batch(data)), dev)
         for _ in range(3):  # warmup + compile
@@ -200,13 +202,13 @@ def main():
     # the bound is loosened to 10x the guessed peak.
     bound = peak * (10.0 if peak_assumed else 1.0)
 
-    def measure_checked(use_flash: bool, bsz: int):
-        tps, loss = measure(use_flash, bsz)
+    def measure_checked(use_flash: bool, bsz: int, cfg_local=None):
+        tps, loss = measure(use_flash, bsz, cfg_local)
         if tps * flops_tok > bound:
             print(f"warning: bsz {bsz} measured {tps:,.0f} tok/s "
                   "(implausible; async-timing glitch); remeasuring",
                   file=sys.stderr)
-            tps, loss = measure(use_flash, bsz)
+            tps, loss = measure(use_flash, bsz, cfg_local)
             if tps * flops_tok > bound:
                 raise RuntimeError(
                     f"bsz {bsz}: repeated implausible timing "
@@ -277,10 +279,10 @@ def main():
     # the loop's final state (a mid-sweep flash fallback must not relabel
     # an earlier flash-measured winner)
     tokens_per_sec, bsz, loss, best_flash = best
-    mfu = tokens_per_sec * flops_tok / peak * 100.0
 
-    # A/B the attention impls at the winning bsz (evidence that the Pallas
-    # kernel beats — or at least matches — the XLA core on hardware)
+    # A/B the attention impls at the winning bsz FIRST, both legs with the
+    # plain CE, so flash_speedup isolates the attention kernel (the fused-CE
+    # leg below may later replace the headline throughput)
     ab = None
     if best_flash and os.environ.get("BENCH_AB", "1") != "0":
         try:
@@ -292,6 +294,26 @@ def main():
                   file=sys.stderr)
         except Exception as e:
             print(f"warning: XLA A/B leg failed: {e}", file=sys.stderr)
+
+    # fused Pallas cross-entropy leg at the winning config: adopt it for the
+    # headline if it wins (it is a first-class config of the framework)
+    fused_ce = False
+    ce_ab = None
+    if on_tpu and os.environ.get("BENCH_CE", "1") != "0":
+        try:
+            cfg_ce = cfg.model_copy(update={"use_fused_ce": True})
+            ce_tps, ce_loss = measure_checked(best_flash, bsz, cfg_ce)
+            ce_ab = {"fused_ce_tokens_per_sec": round(ce_tps, 1),
+                     "fused_ce_speedup": round(ce_tps / tokens_per_sec, 3)}
+            print(f"bench CE A/B: fused {ce_tps:,.0f} vs plain "
+                  f"{tokens_per_sec:,.0f} tok/s "
+                  f"({ce_ab['fused_ce_speedup']}x)", file=sys.stderr)
+            if ce_tps > tokens_per_sec:
+                tokens_per_sec, loss, fused_ce = ce_tps, ce_loss, True
+        except Exception as e:
+            print(f"warning: fused-CE leg failed: {e}", file=sys.stderr)
+
+    mfu = tokens_per_sec * flops_tok / peak * 100.0
 
     # count from abstract shapes — no need to re-materialize 125M weights
     params_n = param_count(jax.eval_shape(
@@ -307,6 +329,7 @@ def main():
         "peak_flops": peak,
         "peak_assumed": peak_assumed,
         "flash_attention": best_flash,
+        "fused_ce": fused_ce,
         "bsz": bsz,
         "seq": seq,
         "loss": round(loss, 4),
@@ -317,6 +340,8 @@ def main():
         out["flash_error"] = flash_error
     if ab:
         out.update(ab)
+    if ce_ab:
+        out.update(ce_ab)
     if _WATCHDOG is not None:
         _WATCHDOG.cancel()
     print(json.dumps(out))

@@ -1,0 +1,122 @@
+"""Offline HF <-> framework checkpoint converter CLI.
+
+Capability parity with the reference's converter entry points
+(tools/checkpoint_convert_h2g.py / tools/checkpoint_convert_g2h.py): the
+conversion math itself lives in runtime/checkpoint.py (hf_to_params /
+params_to_hf, covering gpt2/llama/qwen2/mistral/mixtral/bert/t5 families);
+this CLI wraps it in file IO::
+
+    python -m hetu_galvatron_tpu.cli.checkpoint_convert h2g \
+        <model.yaml> hf_path=<hf_dir> out=<ckpt_root> [step=0]
+    python -m hetu_galvatron_tpu.cli.checkpoint_convert g2h \
+        <model.yaml> ckpt=<ckpt_root_or_step_dir> out=<hf_dir>
+
+h2g reads an HF checkpoint directory (*.safetensors preferred, else
+pytorch_model*.bin) and writes a framework checkpoint (orbax step dir) that
+``cli.train_dist`` resumes from under ANY parallel plan — orbax reshards on
+restore, so there is no per-tp-rank slicing step like the reference's
+(llama_adapter.py:51-163). g2h restores a step dir and writes
+``model.safetensors`` in the HF layout.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def _load_hf_state_dict(path: str):
+    """HF checkpoint dir -> {name: np.ndarray} (fp32)."""
+    import numpy as np
+
+    sd = {}
+    if os.path.isdir(path):
+        st_files = sorted(f for f in os.listdir(path)
+                          if f.endswith(".safetensors"))
+        bin_files = sorted(f for f in os.listdir(path)
+                           if f.startswith("pytorch_model")
+                           and f.endswith(".bin"))
+        if st_files:
+            from safetensors import safe_open
+
+            for fname in st_files:
+                with safe_open(os.path.join(path, fname), framework="np") as f:
+                    for k in f.keys():
+                        sd[k] = f.get_tensor(k)
+        elif bin_files:
+            import torch
+
+            for fname in bin_files:
+                part = torch.load(os.path.join(path, fname),
+                                  map_location="cpu", weights_only=True)
+                sd.update(part)
+        else:
+            raise FileNotFoundError(
+                f"no *.safetensors or pytorch_model*.bin under {path}")
+    else:
+        raise FileNotFoundError(path)
+
+    def to_np(v):
+        if hasattr(v, "detach"):  # torch tensor (bf16-safe upcast)
+            return v.detach().to("cpu").float().numpy()
+        return np.asarray(v)
+
+    return {k: to_np(v) for k, v in sd.items()}
+
+
+def main(argv=None) -> int:
+    from hetu_galvatron_tpu.core.arguments import args_from_cli
+    from hetu_galvatron_tpu.utils.hf_config_adapter import resolve_model_config
+
+    argv = list(argv if argv is not None else sys.argv[1:])
+    if not argv or argv[0] not in ("h2g", "g2h"):
+        print("usage: checkpoint_convert h2g|g2h <model.yaml> key=value ...",
+              file=sys.stderr)
+        return 2
+    direction, argv = argv[0], argv[1:]
+    kv = dict(a.split("=", 1) for a in argv if "=" in a and "." not in
+              a.split("=", 1)[0])
+    passthrough = [a for a in argv if a.split("=", 1)[0] not in
+                   ("hf_path", "out", "ckpt", "step")]
+    args = args_from_cli(passthrough, mode="train_dist")
+    cfg = resolve_model_config(args).model
+
+    if direction == "h2g":
+        from hetu_galvatron_tpu.runtime.checkpoint import (
+            hf_to_params,
+            save_checkpoint,
+        )
+
+        sd = _load_hf_state_dict(kv["hf_path"])
+        params = hf_to_params(sd, cfg)
+        step = int(kv.get("step", 0))
+        out = save_checkpoint(kv["out"], step, params)
+        print(f"wrote {out}")
+        return 0
+
+    from hetu_galvatron_tpu.models.builder import init_causal_lm
+    from hetu_galvatron_tpu.runtime.checkpoint import (
+        latest_checkpoint,
+        load_checkpoint,
+        params_to_hf,
+    )
+
+    import jax
+
+    ckpt = kv["ckpt"]
+    if not os.path.basename(ckpt).startswith("step_"):
+        ckpt = latest_checkpoint(ckpt) or ckpt
+    target, _ = init_causal_lm(jax.random.key(0), cfg)
+    params, _, step = load_checkpoint(ckpt, target)
+    sd = params_to_hf(params, cfg)
+    os.makedirs(kv["out"], exist_ok=True)
+    from safetensors.numpy import save_file
+
+    out_path = os.path.join(kv["out"], "model.safetensors")
+    save_file(sd, out_path)
+    print(f"wrote {out_path} (step {step}, {len(sd)} tensors)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -60,7 +60,9 @@ class ModelProfiler:
     # -- computation --------------------------------------------------------
 
     def _forward_ms(self, cfg: ModelArgs, bsz: int,
-                    warmup: int = 2, iters: int = 5) -> float:
+                    warmup: int = 2, iters: Optional[int] = None) -> float:
+        if iters is None:  # more reps on hardware: amortized-loop timing
+            iters = 20 if self.devices[0].platform == "tpu" else 5
         params, _ = init_causal_lm(jax.random.key(0), cfg)
         tokens = jnp.zeros((bsz, cfg.seq_length), jnp.int32)
         if cfg.model_type == "t5":
@@ -74,16 +76,25 @@ class ModelProfiler:
         else:
             fwd = jax.jit(lambda p, t: forward_causal_lm(
                 p, t, cfg, compute_dtype=jnp.bfloat16))
+        # Sync on a HOST TRANSFER of one output element, never
+        # block_until_ready: through the axon tunnel block_until_ready has
+        # been observed returning before queued dispatches executed, which
+        # made per-iteration timings pure noise (sub-dispatch-latency
+        # "forward times"). Queue all iters back-to-back and divide: the
+        # device serializes them, so total/iters is the per-step time with
+        # dispatch overhead amortized instead of sampled.
+        def sync(o):
+            leaf = jax.tree_util.tree_leaves(o)[0]
+            return float(leaf.reshape(-1)[0].astype(jnp.float32))
+
         for _ in range(warmup):
             out = fwd(params, tokens)
-        jax.block_until_ready(out)
-        samples = []
+        sync(out)
+        t0 = time.perf_counter()
         for _ in range(iters):
-            t0 = time.perf_counter()
             out = fwd(params, tokens)
-            jax.block_until_ready(out)
-            samples.append((time.perf_counter() - t0) * 1000.0)
-        return float(np.median(samples))
+        sync(out)
+        return (time.perf_counter() - t0) * 1000.0 / iters
 
     def profile_computation(self) -> Dict[str, float]:
         """Per-layer + "other" forward ms per (bsz, seq) grid point

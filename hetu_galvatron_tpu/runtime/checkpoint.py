@@ -106,6 +106,7 @@ def load_checkpoint(
     the stored plan matches (the reference asserts equality on resume,
     hybrid_parallel_config.py:132-144); by default a mismatch is allowed —
     orbax reshards into the new plan's shardings."""
+    ckpt_dir = os.path.abspath(ckpt_dir)  # orbax rejects relative paths
     meta = json.load(open(os.path.join(ckpt_dir, "meta.json")))
     if strict_plan and hpc is not None:
         stored = meta.get("hybrid_parallel_config")
