@@ -178,6 +178,9 @@ def train(args) -> Dict[str, Any]:
     exit_code = None
     consumed_box = [0]  # ramped-run sample counter (survives maybe_resume)
 
+    use_dropout = (cfg.hidden_dropout > 0.0 or cfg.attention_dropout > 0.0)
+    drop_key = jax.random.key(args.train.seed) if use_dropout else None
+
     def run_loop(sp, so, step_fn):
         """Shared iteration driver for both execution paths. step_fn(sp, so,
         raw_batch) -> (sp, so, metrics)."""
@@ -194,6 +197,12 @@ def train(args) -> Dict[str, Any]:
                 consumed_box[0] += calc.current_running_global_batch_size
             else:
                 batch = next(data_iter)
+            if use_dropout:
+                # per-iteration rng; captured by the batch so a rerun-machine
+                # re-execution replays the SAME dropout mask (deterministic
+                # fault attribution)
+                batch = dict(batch)
+                batch["dropout_rng"] = jax.random.fold_in(drop_key, it)
             # keep pre-update state alive only when the rerun machine may
             # re-execute the step for fault attribution
             prev = (sp, so) if rerun.enabled else None
@@ -268,7 +277,13 @@ def train(args) -> Dict[str, Any]:
             return step_cache[ch]
 
         def spmd_step(sp, so, raw):
+            raw = dict(raw)
+            # the rng key is per-step scalar data: placed replicated, not
+            # under the [B, ...] batch sharding
+            rng = raw.pop("dropout_rng", None)
             b = jax.device_put(jax.tree.map(jnp.asarray, raw), batch_shd)
+            if rng is not None:
+                b["dropout_rng"] = rng
             fn = step if calc is None else get_step(calc.num_micro_batches)
             return fn(sp, so, b)
 

@@ -45,6 +45,11 @@ class ModelArgs(BaseModel):
     layernorm_epsilon: float = 1e-5
     position_embedding_type: Literal["learned", "rope"] = "learned"
     rope_theta: float = 10000.0
+    # HF-style rope_scaling dict: {"rope_type": "linear"|"llama3",
+    # "factor": ..., and for llama3 "low_freq_factor"/"high_freq_factor"/
+    # "original_max_position_embeddings"} — llama-3.1+ checkpoints need it
+    # for >8k contexts (BASELINE milestone 5)
+    rope_scaling: Optional[Dict[str, Any]] = None
     tie_word_embeddings: bool = True
     use_flash_attn: bool = True
     # Pallas fused CE kernel for the single-device loss path (distributed

@@ -94,8 +94,13 @@ def forward_causal_lm(
     boundary_fn: Optional[Callable[[int, jax.Array], jax.Array]] = None,
     logits_fp32: bool = True,
     with_aux: bool = False,
+    dropout_rng: Optional[jax.Array] = None,
 ) -> jax.Array:
     """tokens [B, S] -> logits [B, S, V].
+
+    ``dropout_rng`` (training only) enables cfg.attention_dropout /
+    cfg.hidden_dropout; ``None`` (the default) is eval semantics — dropout
+    layers are the identity, so existing callers are unchanged.
 
     ``remat_flags[i]`` turns on `jax.checkpoint` for layer i (the reference's
     per-layer checkpoint_flags_enc, parallel.py:213-243). ``layer_overrides``
@@ -111,13 +116,19 @@ def forward_causal_lm(
     S = tokens.shape[1]
     rope = None
     if cfg.position_embedding_type == "rope":
-        rope = M.rope_cos_sin(S, cfg.head_dim, cfg.rope_theta)
-    x = M.apply_embedding(params["embed"], tokens, cfg, compute_dtype=compute_dtype)
+        rope = M.rope_cos_sin(S, cfg.head_dim, cfg.rope_theta,
+                              scaling=cfg.rope_scaling)
+    x = M.apply_embedding(
+        params["embed"], tokens, cfg, compute_dtype=compute_dtype,
+        dropout_rng=(jax.random.fold_in(dropout_rng, 1 << 20)
+                     if dropout_rng is not None else None))
     aux_total = jnp.zeros((), jnp.float32)
     for i, lp in enumerate(params["layers"]):
         if boundary_fn is not None:
             x = boundary_fn(i, x)
         kwargs: Dict[str, Any] = dict(rope=rope, compute_dtype=compute_dtype)
+        if dropout_rng is not None:
+            kwargs["dropout_rng"] = jax.random.fold_in(dropout_rng, i)
         if layer_overrides and i in layer_overrides:
             kwargs.update(layer_overrides[i])
         if "moe" in lp:
@@ -184,7 +195,7 @@ def causal_lm_loss(
         params, batch["tokens"], cfg,
         compute_dtype=compute_dtype, remat_flags=remat_flags,
         layer_overrides=layer_overrides, boundary_fn=boundary_fn,
-        with_aux=True,
+        with_aux=True, dropout_rng=batch.get("dropout_rng"),
     )
     ce = M.cross_entropy_loss(logits, batch["labels"], batch.get("loss_mask"),
                               fused=fused)

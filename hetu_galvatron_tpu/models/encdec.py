@@ -61,6 +61,7 @@ def apply_cross_attention(
     cfg: ModelArgs,
     sdpa_fn: Callable[..., jax.Array] = M.xla_sdpa,
     compute_dtype=jnp.bfloat16,
+    dropout_rng=None,
 ) -> jax.Array:
     B, T, H = x.shape
     hd = cfg.head_dim
@@ -79,7 +80,19 @@ def apply_cross_attention(
     k, v = jnp.split(kv.astype(compute_dtype), 2, axis=-1)
     k = k.reshape(B, memory.shape[1], nkv, hd)
     v = v.reshape(B, memory.shape[1], nkv, hd)
-    out = sdpa_fn(q, k, v, causal=False)  # decoder sees the whole source
+    # decoder sees the whole source; probability dropout mirrors
+    # modules.apply_attention (HF T5Attention drops attention weights in
+    # BOTH self- and cross-attention)
+    if dropout_rng is not None and cfg.attention_dropout > 0.0:
+        if sdpa_fn is not M.xla_sdpa:
+            raise NotImplementedError(
+                "attention_dropout > 0 is only supported with the XLA "
+                "attention core (see modules.apply_attention)")
+        out = M.xla_sdpa(q, k, v, causal=False,
+                         dropout_rate=cfg.attention_dropout,
+                         dropout_rng=dropout_rng)
+    else:
+        out = sdpa_fn(q, k, v, causal=False)
     y = jnp.einsum("btf,fh->bth", out.reshape(B, T, nq * hd),
                    p["wo"].astype(compute_dtype),
                    preferred_element_type=jnp.float32)
@@ -114,6 +127,7 @@ def apply_cross_decoder_layer(
     sdpa_fn: Callable[..., jax.Array] = M.xla_sdpa,
     cross_sdpa_fn: Optional[Callable[..., jax.Array]] = None,
     compute_dtype=jnp.bfloat16,
+    dropout_rng=None,
 ) -> jax.Array:
     """Pre-norm: causal self-attention -> cross-attention -> MLP.
 
@@ -122,15 +136,26 @@ def apply_cross_decoder_layer(
     (parallel/spmd.py attention_overrides) passes a non-causal-capable kernel
     here (flash handles causal=False; ring layers fall back to the XLA core
     because the decoder/encoder sequence lengths differ)."""
+    r_attn = r_xattn = r1 = r2 = r3 = None
+    if dropout_rng is not None:
+        r_attn, r_xattn, r1, r2, r3 = jax.random.split(dropout_rng, 5)
+
+    def drop_h(y, rng):
+        return M.dropout(y, cfg.hidden_dropout, rng)
+
     h = M.apply_norm(p["ln1"], x, cfg)
-    x = x + M.apply_attention(p["attn"], h, cfg, rope=rope, sdpa_fn=sdpa_fn,
-                              compute_dtype=compute_dtype, causal=True)
+    x = x + drop_h(M.apply_attention(p["attn"], h, cfg, rope=rope,
+                                     sdpa_fn=sdpa_fn,
+                                     compute_dtype=compute_dtype, causal=True,
+                                     dropout_rng=r_attn), r1)
     h = M.apply_norm(p["lnx"], x, cfg)
-    x = x + apply_cross_attention(p["cross"], h, memory, cfg,
-                                  sdpa_fn=cross_sdpa_fn or sdpa_fn,
-                                  compute_dtype=compute_dtype)
+    x = x + drop_h(apply_cross_attention(p["cross"], h, memory, cfg,
+                                         sdpa_fn=cross_sdpa_fn or sdpa_fn,
+                                         compute_dtype=compute_dtype,
+                                         dropout_rng=r_xattn), r2)
     h = M.apply_norm(p["ln2"], x, cfg)
-    x = x + M.apply_mlp(p["mlp"], h, cfg, compute_dtype=compute_dtype)
+    x = x + drop_h(M.apply_mlp(p["mlp"], h, cfg,
+                               compute_dtype=compute_dtype), r3)
     return x
 
 
@@ -180,6 +205,7 @@ def forward_encdec(
     layer_overrides=None,
     enc_layer_overrides=None,
     logits_fp32: bool = True,
+    dropout_rng=None,
 ) -> jax.Array:
     """(enc_tokens [B,S], dec_tokens [B,T]) -> logits [B,T,V].
 
@@ -192,20 +218,29 @@ def forward_encdec(
     rope_enc = rope_dec = None
     if cfg.position_embedding_type == "rope":
         rope_enc = M.rope_cos_sin(enc_tokens.shape[1], cfg.head_dim,
-                                  cfg.rope_theta)
+                                  cfg.rope_theta, scaling=cfg.rope_scaling)
         rope_dec = M.rope_cos_sin(dec_tokens.shape[1], cfg.head_dim,
-                                  cfg.rope_theta)
+                                  cfg.rope_theta, scaling=cfg.rope_scaling)
 
     if enc_remat_flags is None and remat_flags:
         enc_remat_flags = [bool(remat_flags[0])] * len(params["enc_layers"])
+    # disjoint fold_in streams: encoder layers, decoder layers, embeddings
+    r_embed_e = r_embed_d = None
+    if dropout_rng is not None:
+        r_embed_e = jax.random.fold_in(dropout_rng, 1 << 20)
+        r_embed_d = jax.random.fold_in(dropout_rng, (1 << 20) + 1)
     mem = M.apply_embedding(params["embed"], enc_tokens, cfg,
-                            compute_dtype=compute_dtype)
+                            compute_dtype=compute_dtype,
+                            dropout_rng=r_embed_e)
     for i, lp in enumerate(params["enc_layers"]):
         if enc_boundary_fn is not None:
             mem = enc_boundary_fn(i, mem)
         kwargs: Dict[str, Any] = dict(rope=rope_enc,
                                       compute_dtype=compute_dtype,
                                       causal=False)
+        if dropout_rng is not None:
+            kwargs["dropout_rng"] = jax.random.fold_in(
+                dropout_rng, (1 << 21) + i)
         if enc_layer_overrides and i in enc_layer_overrides:
             kwargs.update(enc_layer_overrides[i])
         kwargs.pop("cross_sdpa_fn", None)  # encoder blocks have no cross-attn
@@ -218,11 +253,14 @@ def forward_encdec(
     mem = M.apply_norm(params["enc_norm"], mem, cfg)
 
     x = M.apply_embedding(params["embed"], dec_tokens, cfg,
-                          compute_dtype=compute_dtype)
+                          compute_dtype=compute_dtype,
+                          dropout_rng=r_embed_d)
     for i, lp in enumerate(params["layers"]):
         if boundary_fn is not None:
             x = boundary_fn(i, x)
         kwargs = dict(rope=rope_dec, compute_dtype=compute_dtype)
+        if dropout_rng is not None:
+            kwargs["dropout_rng"] = jax.random.fold_in(dropout_rng, i)
         if layer_overrides and i in layer_overrides:
             kwargs.update(layer_overrides[i])
         fn = lambda p, h, m, kw=kwargs: apply_cross_decoder_layer(
@@ -262,6 +300,7 @@ def encdec_loss(
                             boundary_fn=boundary_fn,
                             enc_boundary_fn=enc_boundary_fn,
                             layer_overrides=layer_overrides,
-                            enc_layer_overrides=enc_layer_overrides)
+                            enc_layer_overrides=enc_layer_overrides,
+                            dropout_rng=batch.get("dropout_rng"))
     return M.cross_entropy_loss(logits, batch["labels"],
                                 batch.get("loss_mask"), fused=fused_ce)

@@ -80,7 +80,8 @@ def prefill(params: Params, tokens: jax.Array, cfg: ModelArgs, max_len: int,
     B, S0 = tokens.shape
     rope = None
     if cfg.position_embedding_type == "rope":
-        rope = M.rope_cos_sin(S0, cfg.head_dim, cfg.rope_theta)
+        rope = M.rope_cos_sin(S0, cfg.head_dim, cfg.rope_theta,
+                              scaling=cfg.rope_scaling)
     cache = init_kv_cache(cfg, B, max_len, compute_dtype)
     x = M.apply_embedding(params["embed"], tokens, cfg,
                           compute_dtype=compute_dtype)
@@ -160,7 +161,8 @@ def generate(
         raise ValueError(f"{total} exceeds max_position_embeddings")
     rope_full = None
     if cfg.position_embedding_type == "rope":
-        rope_full = M.rope_cos_sin(total, cfg.head_dim, cfg.rope_theta)
+        rope_full = M.rope_cos_sin(total, cfg.head_dim, cfg.rope_theta,
+                                   scaling=cfg.rope_scaling)
     if key is None:
         key = jax.random.key(0)
 

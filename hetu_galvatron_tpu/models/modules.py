@@ -97,14 +97,40 @@ def apply_norm(p: Params, x: jax.Array, cfg: ModelArgs) -> jax.Array:
 # ---------------------------------------------------------------------------
 
 
+def _scale_inv_freq(inv_freq: jax.Array, scaling: Optional[dict]) -> jax.Array:
+    """HF-style ``rope_scaling``: "linear" divides frequencies by ``factor``;
+    "llama3" keeps high-frequency bands, divides low-frequency bands by
+    ``factor``, and smoothly interpolates between the two wavelength
+    thresholds (the public llama-3.1 rope recipe; parity-tested against
+    transformers' _compute_llama3_parameters)."""
+    if not scaling:
+        return inv_freq
+    rope_type = scaling.get("rope_type", scaling.get("type", "linear"))
+    factor = float(scaling.get("factor", 1.0))
+    if rope_type == "linear":
+        return inv_freq / factor
+    if rope_type == "llama3":
+        low = float(scaling["low_freq_factor"])
+        high = float(scaling["high_freq_factor"])
+        orig = float(scaling["original_max_position_embeddings"])
+        wavelen = 2.0 * jnp.pi / inv_freq
+        smooth = (orig / wavelen - low) / (high - low)
+        smooth = jnp.clip(smooth, 0.0, 1.0)
+        return (1.0 - smooth) * inv_freq / factor + smooth * inv_freq
+    raise ValueError(f"unsupported rope_scaling type {rope_type!r} "
+                     "(supported: linear, llama3)")
+
+
 def rope_cos_sin(
-    seq_len: int, head_dim: int, theta: float, dtype=jnp.float32
+    seq_len: int, head_dim: int, theta: float, dtype=jnp.float32,
+    scaling: Optional[dict] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Precompute RoPE tables [seq, head_dim//2] (reference
     rotary_pos_embedding.py builds the same inv-freq table)."""
     inv_freq = 1.0 / (
         theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
     )
+    inv_freq = _scale_inv_freq(inv_freq, scaling)
     t = jnp.arange(seq_len, dtype=jnp.float32)
     freqs = jnp.outer(t, inv_freq)  # [S, D/2]
     return jnp.cos(freqs).astype(dtype), jnp.sin(freqs).astype(dtype)
@@ -146,14 +172,27 @@ def init_attention(key: jax.Array, cfg: ModelArgs) -> Tuple[Params, Axes]:
     return p, a
 
 
+def dropout(x: jax.Array, rate: float, rng: Optional[jax.Array]) -> jax.Array:
+    """Inverted dropout; identity when ``rng is None`` (eval) or rate 0.
+    The reference inherits torch's nn.Dropout semantics; here the rng is
+    threaded explicitly so training steps stay pure functions."""
+    if rng is None or rate <= 0.0:
+        return x
+    keep = jax.random.bernoulli(rng, 1.0 - rate, x.shape)
+    return jnp.where(keep, x / (1.0 - rate), jnp.zeros_like(x))
+
+
 def xla_sdpa(
-    q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool = True
+    q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool = True,
+    dropout_rate: float = 0.0, dropout_rng: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Reference attention core on XLA: [B,S,N,D] x [B,T,K,D] -> [B,S,N,D].
 
     GQA handled by reshaping q into [B,S,K,G,D] groups. Softmax in fp32.
     Swapped out for the Pallas flash kernel / ring attention by the strategy
     dispatch (reference attention.py:664-720 has the same three-way switch).
+    ``dropout_rate`` applies attention-probability dropout (reference
+    attention.py passes attention_dropout into its cores).
     """
     B, S, N, D = q.shape
     K = k.shape[2]
@@ -168,6 +207,7 @@ def xla_sdpa(
         kpos = jnp.arange(k.shape[1])[None, :]
         scores = jnp.where(qpos >= kpos, scores, jnp.finfo(jnp.float32).min)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    probs = dropout(probs, dropout_rate, dropout_rng)
     out = jnp.einsum("bkgst,btkd->bskgd", probs, v,
                      preferred_element_type=jnp.float32)
     return out.reshape(B, S, N, D).astype(q.dtype)
@@ -181,6 +221,7 @@ def apply_attention(
     sdpa_fn: Callable[..., jax.Array] = xla_sdpa,
     compute_dtype=jnp.bfloat16,
     causal: bool = True,
+    dropout_rng: Optional[jax.Array] = None,
 ) -> jax.Array:
     B, S, H = x.shape
     hd = cfg.head_dim
@@ -199,7 +240,25 @@ def apply_attention(
         cos, sin = rope
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
-    out = sdpa_fn(q, k, v, causal=causal)
+    if dropout_rng is not None and cfg.attention_dropout > 0.0:
+        # probability dropout lives inside the attention core; none of the
+        # kernel paths (Pallas flash, ring, Ulysses a2a) has a dropout
+        # variant (the reference's exists only inside the external CUDA
+        # flash-attn ops). Silently swapping an installed kernel for the
+        # score-materializing XLA core would be an OOM/perf cliff on the
+        # long-context plans those kernels exist for — refuse loudly.
+        if sdpa_fn is not xla_sdpa:
+            raise NotImplementedError(
+                "attention_dropout > 0 is only supported with the XLA "
+                "attention core; the installed flash/ring/Ulysses kernel "
+                "has no dropout variant. Set model.attention_dropout=0 "
+                "(hidden_dropout works with every kernel) or disable the "
+                "attention override for these layers")
+        out = xla_sdpa(q, k, v, causal=causal,
+                       dropout_rate=cfg.attention_dropout,
+                       dropout_rng=dropout_rng)
+    else:
+        out = sdpa_fn(q, k, v, causal=causal)
     out = out.reshape(B, S, nq * hd)
     y = jnp.einsum("bsf,fh->bsh", out, p["wo"].astype(compute_dtype),
                    preferred_element_type=jnp.float32)
@@ -294,31 +353,46 @@ def apply_decoder_layer(
     sdpa_fn: Callable[..., jax.Array] = xla_sdpa,
     compute_dtype=jnp.bfloat16,
     causal: Optional[bool] = None,
+    dropout_rng: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Pre-norm residual block (reference GalvatronDecoderLayer,
     modules.py:233). Encoder families (bert, t5 encoder stack) run the same
     block with bidirectional attention; ``causal=None`` derives from the
-    model family."""
+    model family. ``dropout_rng`` enables attention/hidden dropout
+    (HF semantics: sublayer output dropped before the residual add)."""
     if causal is None:
         causal = cfg.model_type != "bert"
+    r_attn = r_res1 = r_res2 = None
+    if dropout_rng is not None:
+        r_attn, r_res1, r_res2 = jax.random.split(dropout_rng, 3)
+
+    def drop_h(y, rng):
+        return dropout(y, cfg.hidden_dropout, rng)
+
     if cfg.post_norm:
         # HF BertLayer: residual-then-norm (attention.output.LayerNorm,
         # output.LayerNorm)
         x = apply_norm(
             p["ln1"],
-            x + apply_attention(p["attn"], x, cfg, rope=rope,
-                                sdpa_fn=sdpa_fn,
-                                compute_dtype=compute_dtype, causal=causal),
+            x + drop_h(apply_attention(p["attn"], x, cfg, rope=rope,
+                                       sdpa_fn=sdpa_fn,
+                                       compute_dtype=compute_dtype,
+                                       causal=causal, dropout_rng=r_attn),
+                       r_res1),
             cfg)
         return apply_norm(
             p["ln2"],
-            x + apply_mlp(p["mlp"], x, cfg, compute_dtype=compute_dtype),
+            x + drop_h(apply_mlp(p["mlp"], x, cfg,
+                                 compute_dtype=compute_dtype), r_res2),
             cfg)
     h = apply_norm(p["ln1"], x, cfg)
-    x = x + apply_attention(p["attn"], h, cfg, rope=rope, sdpa_fn=sdpa_fn,
-                            compute_dtype=compute_dtype, causal=causal)
+    x = x + drop_h(apply_attention(p["attn"], h, cfg, rope=rope,
+                                   sdpa_fn=sdpa_fn,
+                                   compute_dtype=compute_dtype, causal=causal,
+                                   dropout_rng=r_attn), r_res1)
     h = apply_norm(p["ln2"], x, cfg)
-    x = x + apply_mlp(p["mlp"], h, cfg, compute_dtype=compute_dtype)
+    x = x + drop_h(apply_mlp(p["mlp"], h, cfg, compute_dtype=compute_dtype),
+                   r_res2)
     return x
 
 
@@ -345,13 +419,16 @@ def init_embedding(key: jax.Array, cfg: ModelArgs) -> Tuple[Params, Axes]:
 
 
 def apply_embedding(p: Params, tokens: jax.Array, cfg: ModelArgs,
-                    compute_dtype=jnp.bfloat16) -> jax.Array:
+                    compute_dtype=jnp.bfloat16,
+                    dropout_rng: Optional[jax.Array] = None) -> jax.Array:
     x = jnp.take(p["wte"], tokens, axis=0)
     if "wpe" in p:
         S = tokens.shape[1]
         x = x + p["wpe"][:S][None, :, :]
     if "ln" in p:
         x = apply_norm(p["ln"], x, cfg)
+    # HF GPT2Model.drop / BertEmbeddings.dropout: after sum (+LN for bert)
+    x = dropout(x, cfg.hidden_dropout, dropout_rng)
     return x.astype(compute_dtype)
 
 

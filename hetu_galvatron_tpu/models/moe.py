@@ -320,11 +320,17 @@ def apply_moe_decoder_layer(
     rope=None,
     sdpa_fn=M.xla_sdpa,
     compute_dtype=jnp.bfloat16,
+    dropout_rng=None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Pre-norm block with an MoE FFN; returns (x, aux_loss)."""
+    r_attn = r_res1 = r_res2 = None
+    if dropout_rng is not None:
+        r_attn, r_res1, r_res2 = jax.random.split(dropout_rng, 3)
     h = M.apply_norm(p["ln1"], x, cfg)
-    x = x + M.apply_attention(p["attn"], h, cfg, rope=rope, sdpa_fn=sdpa_fn,
-                              compute_dtype=compute_dtype)
+    x = x + M.dropout(
+        M.apply_attention(p["attn"], h, cfg, rope=rope, sdpa_fn=sdpa_fn,
+                          compute_dtype=compute_dtype, dropout_rng=r_attn),
+        cfg.hidden_dropout, r_res1)
     h = M.apply_norm(p["ln2"], x, cfg)
     y, aux = apply_moe_mlp(p["moe"], h, cfg, compute_dtype=compute_dtype)
-    return x + y, aux
+    return x + M.dropout(y, cfg.hidden_dropout, r_res2), aux

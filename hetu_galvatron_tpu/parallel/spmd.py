@@ -292,10 +292,35 @@ def make_spmd_train_step(
     nshd = lambda tree: jax.tree.map(
         lambda s: NamedSharding(mesh, s), tree,
         is_leaf=lambda x: isinstance(x, P))
-    train_step = jax.jit(
-        step,
-        in_shardings=(nshd(pspecs), nshd(opt_specs), batch_shd),
-        out_shardings=(nshd(pspecs), nshd(opt_specs), None),
-        donate_argnums=(0, 1) if donate else (),
-    )
+    use_dropout = cfg.hidden_dropout > 0.0 or cfg.attention_dropout > 0.0
+    if use_dropout:
+        # the rng key can't ride inside the batch at the jit boundary: the
+        # batch in-sharding is ONE NamedSharding broadcast over every leaf,
+        # and a scalar key has no batch axes. Jit a 4-arg step (key
+        # replicated) and keep the public 3-arg contract with a wrapper that
+        # pops the "dropout_rng" the trainer put in the batch dict.
+        jitted = jax.jit(
+            lambda p, o, b, rng: step(p, o, {**b, "dropout_rng": rng}),
+            in_shardings=(nshd(pspecs), nshd(opt_specs), batch_shd,
+                          NamedSharding(mesh, P())),
+            out_shardings=(nshd(pspecs), nshd(opt_specs), None),
+            donate_argnums=(0, 1) if donate else (),
+        )
+
+        def train_step(params, opt_state, batch):
+            batch = dict(batch)
+            rng = batch.pop("dropout_rng", None)
+            if rng is None:
+                raise ValueError(
+                    "cfg enables dropout but the batch has no 'dropout_rng' "
+                    "key; train_loop adds it automatically — manual callers "
+                    "must pass one per step")
+            return jitted(params, opt_state, batch, rng)
+    else:
+        train_step = jax.jit(
+            step,
+            in_shardings=(nshd(pspecs), nshd(opt_specs), batch_shd),
+            out_shardings=(nshd(pspecs), nshd(opt_specs), None),
+            donate_argnums=(0, 1) if donate else (),
+        )
     return train_step, pspecs, opt_specs, batch_shd

@@ -132,6 +132,13 @@ class PipelineEngine:
             raise ValueError(
                 "PipelineEngine needs pp_deg >= 2; use make_spmd_train_step "
                 "for pp=1")
+        if cfg.hidden_dropout > 0.0 or cfg.attention_dropout > 0.0:
+            # per-stage jitted programs do not thread a dropout rng yet; an
+            # explicit refusal beats silently training without dropout
+            raise NotImplementedError(
+                "PipelineEngine does not support dropout yet; set "
+                "hidden_dropout/attention_dropout to 0 or run the pp=1 "
+                "SPMD path (make_spmd_train_step threads the rng)")
         self.is_t5 = cfg.model_type == "t5"
         devices = list(devices if devices is not None else jax.devices())
         if len(devices) < hpc.world_size:
@@ -324,7 +331,8 @@ class PipelineEngine:
                                   compute_dtype=self.compute_dtype)
         rope = None
         if cfg.position_embedding_type == "rope":
-            rope = M.rope_cos_sin(x.shape[1], cfg.head_dim, cfg.rope_theta)
+            rope = M.rope_cos_sin(x.shape[1], cfg.head_dim, cfg.rope_theta,
+                                  scaling=cfg.rope_scaling)
         from hetu_galvatron_tpu.parallel.spmd import attention_overrides
 
         overrides = attention_overrides(
@@ -384,8 +392,10 @@ class PipelineEngine:
             a, b = carry
         rope_enc = rope_dec = None
         if cfg.position_embedding_type == "rope":
-            rope_enc = M.rope_cos_sin(a.shape[1], cfg.head_dim, cfg.rope_theta)
-            rope_dec = M.rope_cos_sin(b.shape[1], cfg.head_dim, cfg.rope_theta)
+            rope_enc = M.rope_cos_sin(a.shape[1], cfg.head_dim, cfg.rope_theta,
+                                      scaling=cfg.rope_scaling)
+            rope_dec = M.rope_cos_sin(b.shape[1], cfg.head_dim, cfg.rope_theta,
+                                      scaling=cfg.rope_scaling)
         use_flash = None if cfg.use_flash_attn else False
         enc_over = attention_overrides(st.enc_shardings, st.mesh,
                                        use_flash=use_flash)

@@ -55,7 +55,15 @@ def make_train_step(
     grad_fn = jax.value_and_grad(loss_fn)
 
     def step(params, opt_state, batch):
+        # a "dropout_rng" key rides in the batch dict (so every execution
+        # path — single-device, SPMD, chunked — keeps one step signature);
+        # it is per-step data, not a [B, ...] array, so the microbatch
+        # reshape must not touch it
+        batch = dict(batch)
+        rng = batch.pop("dropout_rng", None)
         if chunks <= 1:
+            if rng is not None:
+                batch["dropout_rng"] = rng
             loss, grads = grad_fn(params, batch)
         else:
             bsz = batch["tokens"].shape[0]
@@ -66,6 +74,8 @@ def make_train_step(
             mbs = jax.tree.map(
                 lambda x: x.reshape((chunks, x.shape[0] // chunks) + x.shape[1:]),
                 batch)
+            if rng is not None:
+                mbs["dropout_rng"] = jax.random.split(rng, chunks)
             # token-weighted accumulation: each microbatch's masked-mean loss
             # is weighted by its share of valid tokens so chunks>1 matches
             # chunks=1 exactly even under non-uniform loss masks
@@ -123,8 +133,13 @@ def train_loop(
     opt_state = tx.init(params)
     device_losses = []
     put = device_put or (lambda b: jax.tree.map(jnp.asarray, b))
+    use_dropout = (args.model.hidden_dropout > 0.0
+                   or args.model.attention_dropout > 0.0)
+    drop_key = jax.random.key(args.train.seed) if use_dropout else None
     for it in range(args.train.train_iters):
         batch = put(next(data_iter))
+        if use_dropout:
+            batch["dropout_rng"] = jax.random.fold_in(drop_key, it)
         params, opt_state, metrics = train_step(params, opt_state, batch)
         # keep losses on device — a float() here would block async dispatch
         # and serialize host batch-prep against device compute

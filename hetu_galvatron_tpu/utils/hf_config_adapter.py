@@ -25,6 +25,7 @@ _FIELD_MAP = {
     "max_position_embeddings": ["max_position_embeddings", "n_positions", "n_ctx"],
     "layernorm_epsilon": ["rms_norm_eps", "layer_norm_epsilon", "layer_norm_eps"],
     "rope_theta": ["rope_theta"],
+    "rope_scaling": ["rope_scaling"],
     "tie_word_embeddings": ["tie_word_embeddings"],
     "num_experts": ["num_local_experts", "num_experts"],
     "moe_topk": ["num_experts_per_tok"],

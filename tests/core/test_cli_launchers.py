@@ -217,3 +217,14 @@ def test_checkpoint_convert_cli_roundtrip(tmp_path, capsys):
 
     for k, v in back.items():
         np.testing.assert_allclose(v, sd_np[k], atol=1e-6, err_msg=k)
+
+
+def test_train_dist_cli_with_dropout(capsys):
+    """Dropout rides the batch dict through the CLI's spmd step (the rng is
+    per-step data and must not be placed under the batch sharding)."""
+    from hetu_galvatron_tpu.cli.train_dist import main
+
+    rc = main([os.path.join(ZOO, "gpt2-small.yaml")] + TINY_OVERRIDES + [
+        "model.hidden_dropout=0.1", "model.attention_dropout=0.1"])
+    assert rc == 0
+    assert "training done" in capsys.readouterr().out

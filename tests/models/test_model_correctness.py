@@ -199,3 +199,46 @@ def test_param_count_gpt2_small():
     n = param_count(params)
     # 124M-class (padded vocab 50304)
     assert 1.2e8 < n < 1.3e8
+
+
+def test_llama3_rope_scaling_parity_vs_hf():
+    """llama-3.1-style rope_scaling (llama3 recipe) + linear scaling: the
+    scaled inv-freq table matches transformers' ROPE_INIT_FUNCTIONS and the
+    full model matches HF logits (BASELINE milestone 5 model family)."""
+    torch = pytest.importorskip("torch")
+    from transformers import LlamaConfig, LlamaForCausalLM
+    from transformers.modeling_rope_utils import ROPE_INIT_FUNCTIONS
+
+    from hetu_galvatron_tpu.models.modules import _scale_inv_freq
+    from hetu_galvatron_tpu.runtime.checkpoint import hf_to_params
+
+    sc = {"rope_type": "llama3", "factor": 8.0, "low_freq_factor": 1.0,
+          "high_freq_factor": 4.0, "original_max_position_embeddings": 8192}
+    hf_cfg = LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=176,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=16384, rms_norm_eps=1e-5,
+        rope_theta=500000.0, rope_scaling=dict(sc),
+        tie_word_embeddings=False, attention_bias=False, mlp_bias=False,
+    )
+    inv_ref, _ = ROPE_INIT_FUNCTIONS["llama3"](hf_cfg, "cpu")
+    base = 1.0 / (500000.0 ** (np.arange(0, 16, 2, dtype=np.float64) / 16.0))
+    ours = _scale_inv_freq(jnp.asarray(base, jnp.float32), sc)
+    np.testing.assert_allclose(np.asarray(ours), inv_ref.numpy(), rtol=1e-6)
+    lin = _scale_inv_freq(jnp.asarray(base, jnp.float32),
+                          {"rope_type": "linear", "factor": 4.0})
+    np.testing.assert_allclose(np.asarray(lin), base / 4.0, rtol=1e-6)
+
+    cfg = TINY_LLAMA.model_copy(update={
+        "rope_theta": 500000.0, "rope_scaling": sc,
+        "max_position_embeddings": 16384})
+    torch.manual_seed(0)
+    hf = LlamaForCausalLM(hf_cfg).eval()
+    params = hf_to_params(hf.state_dict(), cfg)
+    tokens_np = np.random.RandomState(0).randint(0, 128, (2, 16))
+    with torch.no_grad():
+        ref = hf(torch.tensor(tokens_np)).logits.numpy()
+    ours_logits = forward_causal_lm(params, jnp.asarray(tokens_np), cfg,
+                                    compute_dtype=jnp.float32)
+    np.testing.assert_allclose(np.asarray(ours_logits), ref,
+                               rtol=2e-4, atol=2e-4)
