@@ -57,6 +57,12 @@ class ModelArgs(BaseModel):
     use_fused_ce: bool = False
     attention_dropout: float = 0.0
     hidden_dropout: float = 0.0
+    # gemma-family numerics: RMSNorm computes x * (1 + scale) (zero-centered
+    # weights), embeddings are scaled by sqrt(hidden_size), and head_dim may
+    # differ from hidden/heads
+    norm_zero_centered: bool = False
+    scale_embeddings: bool = False
+    head_dim_override: Optional[int] = None
     make_vocab_size_divisible_by: int = 128
     untie_streams: bool = False
     # MoE
@@ -91,6 +97,10 @@ class ModelArgs(BaseModel):
 
     @property
     def head_dim(self) -> int:
+        # decoupled head dim (gemma-7b: 16 heads x 256 over hidden 3072);
+        # None derives the usual hidden/heads
+        if self.head_dim_override is not None:
+            return self.head_dim_override
         return self.hidden_size // self.num_attention_heads
 
     @property

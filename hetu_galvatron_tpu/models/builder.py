@@ -120,15 +120,15 @@ def forward_causal_lm(
                               scaling=cfg.rope_scaling)
     x = M.apply_embedding(
         params["embed"], tokens, cfg, compute_dtype=compute_dtype,
-        dropout_rng=(jax.random.fold_in(dropout_rng, 1 << 20)
-                     if dropout_rng is not None else None))
+        dropout_rng=M.fold_dropout_rng(dropout_rng, cfg,
+                                       M.DROPOUT_STREAM_EMBED))
     aux_total = jnp.zeros((), jnp.float32)
     for i, lp in enumerate(params["layers"]):
         if boundary_fn is not None:
             x = boundary_fn(i, x)
         kwargs: Dict[str, Any] = dict(rope=rope, compute_dtype=compute_dtype)
         if dropout_rng is not None:
-            kwargs["dropout_rng"] = jax.random.fold_in(dropout_rng, i)
+            kwargs["dropout_rng"] = M.fold_dropout_rng(dropout_rng, cfg, i)
         if layer_overrides and i in layer_overrides:
             kwargs.update(layer_overrides[i])
         if "moe" in lp:

@@ -225,10 +225,9 @@ def forward_encdec(
     if enc_remat_flags is None and remat_flags:
         enc_remat_flags = [bool(remat_flags[0])] * len(params["enc_layers"])
     # disjoint fold_in streams: encoder layers, decoder layers, embeddings
-    r_embed_e = r_embed_d = None
-    if dropout_rng is not None:
-        r_embed_e = jax.random.fold_in(dropout_rng, 1 << 20)
-        r_embed_d = jax.random.fold_in(dropout_rng, (1 << 20) + 1)
+    r_embed_e = M.fold_dropout_rng(dropout_rng, cfg,
+                                   M.DROPOUT_STREAM_EMBED_ENC)
+    r_embed_d = M.fold_dropout_rng(dropout_rng, cfg, M.DROPOUT_STREAM_EMBED)
     mem = M.apply_embedding(params["embed"], enc_tokens, cfg,
                             compute_dtype=compute_dtype,
                             dropout_rng=r_embed_e)
@@ -239,8 +238,8 @@ def forward_encdec(
                                       compute_dtype=compute_dtype,
                                       causal=False)
         if dropout_rng is not None:
-            kwargs["dropout_rng"] = jax.random.fold_in(
-                dropout_rng, (1 << 21) + i)
+            kwargs["dropout_rng"] = M.fold_dropout_rng(
+                dropout_rng, cfg, M.DROPOUT_STREAM_ENC + i)
         if enc_layer_overrides and i in enc_layer_overrides:
             kwargs.update(enc_layer_overrides[i])
         kwargs.pop("cross_sdpa_fn", None)  # encoder blocks have no cross-attn
@@ -260,7 +259,7 @@ def forward_encdec(
             x = boundary_fn(i, x)
         kwargs = dict(rope=rope_dec, compute_dtype=compute_dtype)
         if dropout_rng is not None:
-            kwargs["dropout_rng"] = jax.random.fold_in(dropout_rng, i)
+            kwargs["dropout_rng"] = M.fold_dropout_rng(dropout_rng, cfg, i)
         if layer_overrides and i in layer_overrides:
             kwargs.update(layer_overrides[i])
         fn = lambda p, h, m, kw=kwargs: apply_cross_decoder_layer(

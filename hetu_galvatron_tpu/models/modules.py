@@ -65,7 +65,9 @@ def compute_dtype_of(mixed_precision: str) -> jnp.dtype:
 
 
 def init_norm(cfg: ModelArgs) -> Tuple[Params, Axes]:
-    p: Params = {"scale": jnp.ones((cfg.hidden_size,), jnp.float32)}
+    # zero-centered (gemma) weights store the offset from 1, so init is 0
+    init = 0.0 if cfg.norm_zero_centered else 1.0
+    p: Params = {"scale": jnp.full((cfg.hidden_size,), init, jnp.float32)}
     a: Axes = {"scale": ("embed",)}
     if cfg.normalization == "layernorm":
         p["bias"] = jnp.zeros((cfg.hidden_size,), jnp.float32)
@@ -81,14 +83,17 @@ def apply_norm(p: Params, x: jax.Array, cfg: ModelArgs) -> jax.Array:
         return x
     dtype = x.dtype
     x = x.astype(jnp.float32)
+    scale = p["scale"]
+    if cfg.norm_zero_centered:
+        scale = 1.0 + scale  # gemma RMSNorm: x * (1 + weight)
     if cfg.normalization == "rmsnorm":
         var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
-        y = x * jax.lax.rsqrt(var + cfg.layernorm_epsilon) * p["scale"]
+        y = x * jax.lax.rsqrt(var + cfg.layernorm_epsilon) * scale
     else:
         mean = jnp.mean(x, axis=-1, keepdims=True)
         var = jnp.mean(jnp.square(x - mean), axis=-1, keepdims=True)
         y = (x - mean) * jax.lax.rsqrt(var + cfg.layernorm_epsilon)
-        y = y * p["scale"] + p["bias"]
+        y = y * scale + p["bias"]
     return y.astype(dtype)
 
 
@@ -170,6 +175,25 @@ def init_attention(key: jax.Array, cfg: ModelArgs) -> Tuple[Params, Axes]:
         p["bo"] = jnp.zeros((h,), jnp.float32)
         a["bo"] = ("embed",)
     return p, a
+
+
+# fold_in stream bases partitioning one per-step dropout key into disjoint
+# substreams: decoder layers use their index i directly; these bases keep
+# embeddings / encoder layers clear of that range
+DROPOUT_STREAM_EMBED = 1 << 20        # (decoder-side) embedding
+DROPOUT_STREAM_EMBED_ENC = (1 << 20) + 1  # encoder-side embedding (t5)
+DROPOUT_STREAM_ENC = 1 << 21          # + j for encoder layer j
+
+
+def fold_dropout_rng(rng: Optional[jax.Array], cfg: ModelArgs,
+                     idx: int) -> Optional[jax.Array]:
+    """None-propagating fold_in, also None when both dropout rates are 0 —
+    the single place the per-step key is partitioned (builder, encdec, and
+    the pipeline stage programs all route through here)."""
+    if rng is None or (cfg.hidden_dropout <= 0.0
+                       and cfg.attention_dropout <= 0.0):
+        return None
+    return jax.random.fold_in(rng, idx)
 
 
 def dropout(x: jax.Array, rate: float, rng: Optional[jax.Array]) -> jax.Array:
@@ -427,6 +451,9 @@ def apply_embedding(p: Params, tokens: jax.Array, cfg: ModelArgs,
         x = x + p["wpe"][:S][None, :, :]
     if "ln" in p:
         x = apply_norm(p["ln"], x, cfg)
+    if cfg.scale_embeddings:
+        # gemma: hidden states enter the stack scaled by sqrt(hidden)
+        x = x * jnp.sqrt(jnp.float32(cfg.hidden_size)).astype(x.dtype)
     # HF GPT2Model.drop / BertEmbeddings.dropout: after sum (+LN for bert)
     x = dropout(x, cfg.hidden_dropout, dropout_rng)
     return x.astype(compute_dtype)

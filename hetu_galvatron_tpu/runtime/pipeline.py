@@ -132,13 +132,6 @@ class PipelineEngine:
             raise ValueError(
                 "PipelineEngine needs pp_deg >= 2; use make_spmd_train_step "
                 "for pp=1")
-        if cfg.hidden_dropout > 0.0 or cfg.attention_dropout > 0.0:
-            # per-stage jitted programs do not thread a dropout rng yet; an
-            # explicit refusal beats silently training without dropout
-            raise NotImplementedError(
-                "PipelineEngine does not support dropout yet; set "
-                "hidden_dropout/attention_dropout to 0 or run the pp=1 "
-                "SPMD path (make_spmd_train_step threads the rng)")
         self.is_t5 = cfg.model_type == "t5"
         devices = list(devices if devices is not None else jax.devices())
         if len(devices) < hpc.world_size:
@@ -320,15 +313,23 @@ class PipelineEngine:
     # ------------------------------------------------------------------
 
     def _stage_apply(self, st: _Stage, sp: Params, x: jax.Array,
-                     labels=None, loss_mask=None):
+                     labels=None, loss_mask=None, dropout_rng=None):
         """Non-head stages return (x, stage_aux); the head stage returns
-        ce_loss + its own aux (MoE auxiliary losses contribute per stage)."""
+        ce_loss + its own aux (MoE auxiliary losses contribute per stage).
+        ``dropout_rng`` is the per-(microbatch, stage) key; the schedule
+        passes the SAME key to a microbatch's forward and backward so the
+        backward's remat recomputation reuses the forward's masks."""
         from hetu_galvatron_tpu.models.moe import apply_moe_decoder_layer
 
         cfg = self.cfg
+
+        def layer_rng(j):
+            return M.fold_dropout_rng(dropout_rng, cfg, j)
+
         if st.has_embed:
             x = M.apply_embedding(sp["embed"], x, cfg,
-                                  compute_dtype=self.compute_dtype)
+                                  compute_dtype=self.compute_dtype,
+                                  dropout_rng=layer_rng(M.DROPOUT_STREAM_EMBED))
         rope = None
         if cfg.position_embedding_type == "rope":
             rope = M.rope_cos_sin(x.shape[1], cfg.head_dim, cfg.rope_theta,
@@ -346,10 +347,12 @@ class PipelineEngine:
             if "moe" in lp:
                 fn = partial(apply_moe_decoder_layer, cfg=cfg, rope=rope,
                              compute_dtype=self.compute_dtype,
+                             dropout_rng=layer_rng(j),
                              **overrides.get(j, {}))
             else:
                 base = partial(M.apply_decoder_layer, cfg=cfg, rope=rope,
                                compute_dtype=self.compute_dtype,
+                               dropout_rng=layer_rng(j),
                                **overrides.get(j, {}))
                 fn = lambda p, h, b=base: (b(p, h),
                                            jnp.zeros((), jnp.float32))
@@ -372,7 +375,7 @@ class PipelineEngine:
         return M.cross_entropy_loss(logits, labels, loss_mask) + aux_total
 
     def _stage_apply_t5(self, st: _Stage, sp: Params, carry,
-                        labels=None, loss_mask=None):
+                        labels=None, loss_mask=None, dropout_rng=None):
         """Encoder-decoder stage program. ``carry`` is (enc_tokens,
         dec_tokens) on the embed stage, else the (a, b) activation pair —
         a = encoder stream / memory [B,S,H], b = decoder stream [B,T,H].
@@ -382,12 +385,18 @@ class PipelineEngine:
         from hetu_galvatron_tpu.parallel.spmd import attention_overrides
 
         cfg = self.cfg
+
+        def layer_rng(j):
+            return M.fold_dropout_rng(dropout_rng, cfg, j)
+
         if st.has_embed:
             enc_tok, dec_tok = carry
             a = M.apply_embedding(sp["embed"], enc_tok, cfg,
-                                  compute_dtype=self.compute_dtype)
+                                  compute_dtype=self.compute_dtype,
+                                  dropout_rng=layer_rng(M.DROPOUT_STREAM_EMBED))
             b = M.apply_embedding(sp["embed"], dec_tok, cfg,
-                                  compute_dtype=self.compute_dtype)
+                                  compute_dtype=self.compute_dtype,
+                                  dropout_rng=layer_rng(M.DROPOUT_STREAM_EMBED_ENC))
         else:
             a, b = carry
         rope_enc = rope_dec = None
@@ -406,7 +415,8 @@ class PipelineEngine:
             a = jax.lax.with_sharding_constraint(
                 a, NamedSharding(st.mesh, sh.act_spec()))
             kwargs = dict(rope=rope_enc, compute_dtype=self.compute_dtype,
-                          causal=False, **enc_over.get(j, {}))
+                          causal=False, dropout_rng=layer_rng(M.DROPOUT_STREAM_ENC + j),
+                          **enc_over.get(j, {}))
             kwargs.pop("cross_sdpa_fn", None)
             fn = partial(M.apply_decoder_layer, cfg=cfg, **kwargs)
             if sh.checkpoint:
@@ -419,7 +429,7 @@ class PipelineEngine:
             b = jax.lax.with_sharding_constraint(
                 b, NamedSharding(st.mesh, sh.act_spec()))
             kwargs = dict(rope=rope_dec, compute_dtype=self.compute_dtype,
-                          **dec_over.get(j, {}))
+                          dropout_rng=layer_rng(j), **dec_over.get(j, {}))
             fn = partial(apply_cross_decoder_layer, cfg=cfg, **kwargs)
             if sh.checkpoint:
                 fn = jax.checkpoint(fn)
@@ -455,20 +465,22 @@ class PipelineEngine:
             return None  # head fwd is fused into its value_and_grad backward
         apply = self._stage_apply_t5 if self.is_t5 else self._stage_apply
 
-        def f(sp, x):
-            y, _ = apply(st, sp, x)
+        def f(sp, x, rng):
+            y, _ = apply(st, sp, x, dropout_rng=rng)
             return y
         return jax.jit(f)
 
     def _make_bwd(self, st: _Stage) -> Callable:
         """(dparams, dx) by recomputing the stage forward (per-stage remat).
         The head stage returns the (unweighted) loss alongside grads so the
-        forward never runs separately just for the metric."""
+        forward never runs separately just for the metric. ``rng`` is the
+        same per-(microbatch, stage) key the forward ran with, so the remat
+        recomputation reuses the identical dropout masks."""
         apply = self._stage_apply_t5 if self.is_t5 else self._stage_apply
         if st.has_head:
-            def g(sp, x, labels, mask, seed):
+            def g(sp, x, labels, mask, seed, rng):
                 def lf(sp_, x_):
-                    return apply(st, sp_, x_, labels, mask)
+                    return apply(st, sp_, x_, labels, mask, dropout_rng=rng)
                 loss, (dp, dx) = jax.value_and_grad(
                     lambda sp_, x_: lf(sp_, x_), argnums=(0, 1))(sp, x)
                 dp = jax.tree.map(lambda t: seed * t, dp)
@@ -476,11 +488,11 @@ class PipelineEngine:
                 return dp, dx, loss
             return jax.jit(g)
 
-        def g(sp, x, dy, seed):
+        def g(sp, x, dy, seed, rng):
             # cotangents: dy for the activation, seed (the microbatch weight)
             # for this stage's MoE aux loss which enters the total directly
             (_, aux), vjp = jax.vjp(
-                lambda sp_, x_: apply(st, sp_, x_), sp, x)
+                lambda sp_, x_: apply(st, sp_, x_, dropout_rng=rng), sp, x)
             dp, dx = vjp((dy, seed))
             return dp, dx, aux
         return jax.jit(g)
@@ -565,7 +577,12 @@ class PipelineEngine:
                 else st.vocab.act_spec())
         return jax.device_put(dx, NamedSharding(st.mesh, spec))
 
-    def _fwd_microbatch(self, stage_params, mb, ctx):
+    def _mb_rng(self, ctx, m: int, s: int):
+        """Per-(microbatch, stage) dropout key — identical for the forward
+        and the backward's remat recomputation of the same microbatch."""
+        return jax.random.fold_in(jax.random.fold_in(ctx["rng"], m), s)
+
+    def _fwd_microbatch(self, stage_params, mb, ctx, m):
         """Run one microbatch up to the head stage's input; the head's
         forward happens fused with its backward (value_and_grad), so the
         loss costs no extra pass."""
@@ -579,7 +596,8 @@ class PipelineEngine:
                 ctx["labels"].append((lbl, msk))
                 ctx["losses"].append(None)  # filled by the backward
             else:
-                y = self._fwd_jits[s](stage_params[s], x)
+                y = self._fwd_jits[s](stage_params[s], x,
+                                      self._mb_rng(ctx, m, s))
                 x = self._transfer(y, s + 1)
         ctx["inputs"].append(inputs)
 
@@ -588,16 +606,18 @@ class PipelineEngine:
         inputs = ctx["inputs"][m]
         lbl, msk = ctx["labels"][m]
         seed = jnp.asarray(w, jnp.float32)
+        n_stages = len(self.stages)
         dp, dx, loss = self._bwd_jits[-1](stage_params[-1], inputs[-1], lbl,
-                                          msk, seed)
+                                          msk, seed,
+                                          self._mb_rng(ctx, m, n_stages - 1))
         # keep loss/aux as lazy device scalars — any host sync here would
         # serialize the schedule; train_step folds them once at the end
         aux_parts = []
         grad_acc[-1] = _tree_add(grad_acc[-1], dp)
-        for s in range(len(self.stages) - 2, -1, -1):
+        for s in range(n_stages - 2, -1, -1):
             dy = self._put_cotangent(dx, s)
             dp, dx, aux = self._bwd_jits[s](stage_params[s], inputs[s], dy,
-                                            seed)
+                                            seed, self._mb_rng(ctx, m, s))
             if self.cfg.num_experts:
                 aux_parts.append(aux)
             grad_acc[s] = _tree_add(grad_acc[s], dp)
@@ -617,16 +637,31 @@ class PipelineEngine:
         ``num_microbatches`` overrides the plan's chunk count (batch-size
         ramp at fixed micro size — the stage jits see the same shapes, so a
         ramp costs zero recompiles here)."""
+        batch = dict(batch)
+        # per-step dropout key (popped BEFORE microbatch slicing: it is
+        # per-step data, not a [B, ...] array). With dropout rates at 0 the
+        # key is dead code at trace time, so a constant placeholder is free —
+        # but a dropout-ENABLED cfg must get a fresh key per step, else every
+        # step reuses identical masks (matching parallel/spmd.py's refusal).
+        step_rng = batch.pop("dropout_rng", None)
+        if step_rng is None:
+            if (self.cfg.hidden_dropout > 0.0
+                    or self.cfg.attention_dropout > 0.0):
+                raise ValueError(
+                    "cfg enables dropout but the batch has no 'dropout_rng' "
+                    "key; train_loop/cli add it automatically — manual "
+                    "callers must pass one per step")
+            step_rng = jax.random.key(0)
         mbs, weights = self._microbatches(batch, num_microbatches)
         mcount = len(mbs)
         ctx = {"inputs": [], "labels": [], "losses": [],
-               "aux": [[] for _ in range(mcount)]}
+               "aux": [[] for _ in range(mcount)], "rng": step_rng}
         grad_acc: List[Any] = [None] * len(self.stages)
 
         if self.hpc.pipeline_type == "gpipe":
             # all forwards, then all backwards (pipeline.py:729-905)
             for m in range(mcount):
-                self._fwd_microbatch(stage_params, mbs[m], ctx)
+                self._fwd_microbatch(stage_params, mbs[m], ctx, m)
             for m in range(mcount):
                 self._bwd_microbatch(stage_params, m, weights[m], ctx,
                                      grad_acc)
@@ -637,14 +672,15 @@ class PipelineEngine:
             # in chunks, so interleaved runs keep every group fed.
             warmup = min(len(self.stages), mcount)
             for m in range(warmup):
-                self._fwd_microbatch(stage_params, mbs[m], ctx)
+                self._fwd_microbatch(stage_params, mbs[m], ctx, m)
             next_fwd, next_bwd = warmup, 0
             while next_bwd < mcount:
                 self._bwd_microbatch(stage_params, next_bwd,
                                      weights[next_bwd], ctx, grad_acc)
                 next_bwd += 1
                 if next_fwd < mcount:
-                    self._fwd_microbatch(stage_params, mbs[next_fwd], ctx)
+                    self._fwd_microbatch(stage_params, mbs[next_fwd], ctx,
+                                         next_fwd)
                     next_fwd += 1
 
         # tied-embedding grad sum across first/last stages (pipeline.py:1042);

@@ -26,14 +26,23 @@ _FIELD_MAP = {
     "layernorm_epsilon": ["rms_norm_eps", "layer_norm_epsilon", "layer_norm_eps"],
     "rope_theta": ["rope_theta"],
     "rope_scaling": ["rope_scaling"],
+    # decoupled head dim (gemma-7b, mistral-nemo, ...); None skipped
+    "head_dim_override": ["head_dim"],
     "tie_word_embeddings": ["tie_word_embeddings"],
     "num_experts": ["num_local_experts", "num_experts"],
     "moe_topk": ["num_experts_per_tok"],
 }
 
-_ROPE_FAMILIES = {"llama", "qwen2", "mistral", "mixtral", "qwen", "gemma"}
-_RMS_FAMILIES = {"llama", "qwen2", "mistral", "mixtral", "qwen", "gemma", "t5"}
+_GEMMA_FAMILIES = {"gemma"}
+_ROPE_FAMILIES = {"llama", "qwen2", "mistral", "mixtral",
+                  "qwen"} | _GEMMA_FAMILIES
+_RMS_FAMILIES = _ROPE_FAMILIES | {"t5"}
 _SWIGLU_FAMILIES = {"llama", "qwen2", "mistral", "mixtral", "qwen"}
+# gemma-2/3 add sandwich norms, logit softcapping, query_pre_attn_scalar,
+# alternating sliding windows (v3: q/k-norm, dual rope) — none of which this
+# stack implements; mapping them through gemma-1 numerics would silently
+# produce wrong logits, so they are refused by name
+_UNSUPPORTED_FAMILIES = {"gemma2", "gemma3", "gemma3_text"}
 
 
 def _cfg_to_dict(config: Any) -> Dict[str, Any]:
@@ -50,6 +59,12 @@ def populate_model_args_from_hf(
     """Build ModelArgs from a HF config object/dict, auto-detecting family."""
     d = _cfg_to_dict(config)
     family = str(d.get("model_type", "gpt2")).lower()
+    if family in _UNSUPPORTED_FAMILIES:
+        raise NotImplementedError(
+            f"model family {family!r} has architecture features this stack "
+            "does not implement (sandwich norms, logit softcapping, "
+            "alternating sliding windows); refusing rather than producing "
+            "silently-wrong numerics")
     values: Dict[str, Any] = dict(base.model_dump() if base else {})
     for ours, theirs in _FIELD_MAP.items():
         for key in theirs:
@@ -67,6 +82,12 @@ def populate_model_args_from_hf(
         )
     values["normalization"] = "rmsnorm" if family in _RMS_FAMILIES else "layernorm"
     values["hidden_act"] = "swiglu" if family in _SWIGLU_FAMILIES else "gelu"
+    if family in _GEMMA_FAMILIES:
+        # gemma numerics: gated-gelu MLP, RMSNorm x*(1+w), sqrt(H)-scaled
+        # embeddings (head_dim comes via the shared field map)
+        values["hidden_act"] = "geglu"
+        values["norm_zero_centered"] = True
+        values["scale_embeddings"] = True
     if family == "bert":
         # HF bert uses erf gelu everywhere (BertIntermediate + the MLM
         # transform); our "gelu" is the tanh approximation (gpt2's gelu_new)

@@ -174,7 +174,12 @@ def test_encdec_dropout_paths():
     assert float(l1) != pytest.approx(float(l_eval), abs=1e-6)
 
 
-def test_pipeline_engine_rejects_dropout():
+@pytest.mark.distributed
+def test_pipeline_engine_dropout_rng_deterministic():
+    """pp>1 dropout: the same per-step key gives the same loss (the
+    backward's remat recomputation reuses the forward's masks), a different
+    key gives a different loss, and a dropout-off cfg through the engine
+    still matches the single-device loss."""
     from hetu_galvatron_tpu.runtime.hybrid_config import (
         get_hybrid_parallel_config,
     )
@@ -182,10 +187,36 @@ def test_pipeline_engine_rejects_dropout():
 
     args = CoreArgs(model=CFG.model_dump())
     args.parallel.pp_deg = 2
+    args.parallel.chunks = 2
     args.parallel.global_train_batch_size = 4
     hpc = get_hybrid_parallel_config(args, 4)
-    with pytest.raises(NotImplementedError, match="dropout"):
-        PipelineEngine(CFG, hpc, TrainArgs(), devices=jax.devices("cpu")[:4])
+    tr = TrainArgs(lr=1e-3, lr_decay_style="constant")
+    eng = PipelineEngine(CFG, hpc, tr, devices=jax.devices("cpu")[:4],
+                         compute_dtype=jnp.float32)
+    params, axes = init_causal_lm(jax.random.key(0), CFG)
+    sp = eng.split_params(params, axes)
+    so = eng.init_opt(sp, axes)
+    raw = {k: np.asarray(v) for k, v in _batch(bsz=4).items()}
+
+    b1 = dict(raw)
+    b1["dropout_rng"] = jax.random.key(11)
+    _, _, m1 = eng.train_step(sp, so, b1)
+    _, _, m1b = eng.train_step(sp, so, dict(b1))
+    b2 = dict(raw)
+    b2["dropout_rng"] = jax.random.key(12)
+    _, _, m2 = eng.train_step(sp, so, b2)
+    assert m1["loss"] == pytest.approx(m1b["loss"], rel=1e-6)
+    assert m1["loss"] != pytest.approx(m2["loss"], abs=1e-6)
+
+    # dropout-off cfg through the engine matches the single-device loss
+    eng0 = PipelineEngine(EVAL_CFG, hpc, tr, devices=jax.devices("cpu")[:4],
+                          compute_dtype=jnp.float32)
+    sp0 = eng0.split_params(params, axes)
+    so0 = eng0.init_opt(sp0, axes)
+    _, _, m0 = eng0.train_step(sp0, so0, dict(raw))
+    ref = float(causal_lm_loss(params, _batch(bsz=4), EVAL_CFG,
+                               compute_dtype=jnp.float32))
+    assert m0["loss"] == pytest.approx(ref, rel=1e-4)
 
 
 def test_attention_dropout_refuses_custom_kernels():

@@ -242,3 +242,40 @@ def test_llama3_rope_scaling_parity_vs_hf():
                                     compute_dtype=jnp.float32)
     np.testing.assert_allclose(np.asarray(ours_logits), ref,
                                rtol=2e-4, atol=2e-4)
+
+
+def test_gemma_logit_parity_vs_hf():
+    """Gemma family numerics: zero-centered RMSNorm (x * (1+w)), sqrt(H)
+    embedding scaling, gated-gelu MLP, decoupled head_dim — logits must
+    match HF GemmaForCausalLM through the config adapter + converter."""
+    torch = pytest.importorskip("torch")
+    from transformers import GemmaConfig, GemmaForCausalLM
+
+    from hetu_galvatron_tpu.runtime.checkpoint import hf_to_params
+    from hetu_galvatron_tpu.utils.hf_config_adapter import (
+        populate_model_args_from_hf,
+    )
+
+    hf_cfg = GemmaConfig(
+        vocab_size=128, hidden_size=48, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, max_position_embeddings=32, rms_norm_eps=1e-6,
+        rope_theta=10000.0, attention_dropout=0.0,
+        hidden_act="gelu_pytorch_tanh", hidden_activation="gelu_pytorch_tanh",
+    )
+    cfg = populate_model_args_from_hf(hf_cfg)
+    cfg = cfg.model_copy(update={"seq_length": 16,
+                                 "make_vocab_size_divisible_by": 1})
+    assert cfg.norm_zero_centered and cfg.scale_embeddings
+    assert cfg.head_dim == 16 and cfg.hidden_act == "geglu"
+    assert cfg.tie_word_embeddings
+
+    torch.manual_seed(0)
+    hf = GemmaForCausalLM(hf_cfg).eval()
+    params = hf_to_params(hf.state_dict(), cfg)
+    tokens_np = np.random.RandomState(0).randint(0, 128, (2, 16))
+    with torch.no_grad():
+        ref = hf(torch.tensor(tokens_np)).logits.numpy()
+    ours = forward_causal_lm(params, jnp.asarray(tokens_np), cfg,
+                             compute_dtype=jnp.float32)
+    np.testing.assert_allclose(np.asarray(ours), ref, rtol=5e-4, atol=5e-4)

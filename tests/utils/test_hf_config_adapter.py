@@ -125,3 +125,21 @@ def test_dense_layer_configs():
 def test_model_name_sanitized():
     m = populate_model_args_from_hf(LLAMA_CFG)
     assert "/" not in model_name(m)
+
+
+def test_gemma2_refused_and_decoupled_head_dim_generic():
+    from hetu_galvatron_tpu.utils.hf_config_adapter import (
+        populate_model_args_from_hf,
+    )
+
+    with pytest.raises(NotImplementedError, match="gemma2"):
+        populate_model_args_from_hf({"model_type": "gemma2",
+                                     "hidden_size": 64})
+    # decoupled head_dim comes through the shared field map for ANY family
+    # (mistral-nemo: 5120 hidden, 32 heads, head_dim 128)
+    cfg = populate_model_args_from_hf({
+        "model_type": "mistral", "hidden_size": 5120,
+        "num_hidden_layers": 2, "num_attention_heads": 32,
+        "num_key_value_heads": 8, "intermediate_size": 14336,
+        "vocab_size": 1024, "head_dim": 128, "max_position_embeddings": 64})
+    assert cfg.head_dim == 128
