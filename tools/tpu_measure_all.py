@@ -1,0 +1,169 @@
+"""Opportunistic TPU measurement suite: wait for the axon tunnel, then run
+every pending hardware measurement back-to-back in one tunnel-up window.
+
+The tunnel can wedge for hours (see PERF.md incident log), so measurements
+are batched: the suite polls with throwaway probe subprocesses (abandoned on
+timeout, NEVER killed — killing a process inside device init wedges the
+remote side), and once the chip answers it runs each step as its own
+subprocess so a crash or hang in one step cannot take down the rest. A step
+that exceeds its deadline is abandoned and the suite STOPS (the abandoned
+child still holds the chip).
+
+Steps:
+  1. gpt2-small per-layer forward time, batch mode (bsz 1..8, seq 1024)
+  2. gpt2-small per-layer forward time, sequence mode (seq 512..4096)
+     — merged into the same computation JSON (disjoint keys)
+  3. gpt2-small memory profile (tp=1; single chip)
+  4. llama2-7b(2-layer) forward time at bsz1/seq2048 — the BASELINE.md
+     anchor point (reference A100: 15.08 ms for 2 layers)
+  5. flash-attention block sweep + fused-CE timing (tools/tpu_flash_check.py)
+  6. full bench.py (MFU headline + A/B legs)
+
+Run detached:  python tools/tpu_measure_all.py > tpu_measure.log 2>&1 &
+Outputs land in hetu_galvatron_tpu/profiles/tpu_v5e/ (+ bench JSON on
+stdout of step 6, captured in the log dir).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PROF_DIR = os.path.join(ROOT, "hetu_galvatron_tpu", "profiles", "tpu_v5e")
+LOG_DIR = os.path.join(ROOT, "tpu_measure_logs")
+COMP_JSON = os.path.join(
+    PROF_DIR, "computation_profiling_bf16_gpt2-small_all.json")
+GPT2_YAML = os.path.join(
+    ROOT, "hetu_galvatron_tpu", "models", "configs", "gpt2-small.yaml")
+LLAMA_YAML = os.path.join(
+    ROOT, "hetu_galvatron_tpu", "models", "configs", "llama2-7b.yaml")
+
+
+def log(msg: str) -> None:
+    print(f"[{time.strftime('%H:%M:%S')}] {msg}", flush=True)
+
+
+def wait_for_tunnel(max_hours: float) -> bool:
+    probe = os.path.join(ROOT, "tools", "tpu_probe.py")
+    deadline = time.time() + max_hours * 3600
+    attempt = 0
+    while time.time() < deadline:
+        attempt += 1
+        out_path = os.path.join(LOG_DIR, f"probe_{attempt}.json")
+        child = subprocess.Popen([sys.executable, probe, out_path],
+                                 stdout=subprocess.DEVNULL,
+                                 stderr=subprocess.DEVNULL, cwd=ROOT)
+        limit = time.time() + 120
+        while time.time() < limit and child.poll() is None:
+            time.sleep(2)
+        if child.poll() is not None and os.path.exists(out_path):
+            info = json.load(open(out_path))
+            if info.get("alive") and info.get("platform") == "tpu":
+                log(f"tunnel alive (attempt {attempt}): "
+                    f"{info.get('device_kind')}")
+                return True
+            log(f"probe attempt {attempt}: up but not tpu: {info}")
+        else:
+            log(f"probe attempt {attempt}: "
+                + ("hung; child abandoned" if child.poll() is None
+                   else f"exited rc={child.returncode} without result"))
+        time.sleep(180)
+    return False
+
+
+def run_step(name: str, argv: list, deadline_s: float,
+             env_extra: dict = None) -> bool:
+    """Run one measurement subprocess; True = completed (any rc). False =
+    hung past the deadline (child abandoned; caller must stop the suite)."""
+    log(f"step {name}: {' '.join(argv[:4])} ...")
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)  # steps run on the real chip
+    if env_extra:
+        env.update(env_extra)
+    out = open(os.path.join(LOG_DIR, f"{name}.log"), "w")
+    child = subprocess.Popen(argv, stdout=out, stderr=subprocess.STDOUT,
+                             cwd=ROOT, env=env)
+    limit = time.time() + deadline_s
+    while time.time() < limit and child.poll() is None:
+        time.sleep(5)
+    if child.poll() is None:
+        log(f"step {name}: exceeded {deadline_s:.0f}s; child abandoned — "
+            "stopping the suite (the chip is still held)")
+        return False
+    log(f"step {name}: rc={child.returncode}")
+    return True
+
+
+def merge_comp_json(extra_path: str) -> None:
+    """Merge a sequence-mode computation JSON into the batch-mode one
+    (disjoint keys: bsz{b}_seq1024 vs bsz1_seq{S})."""
+    if not (os.path.exists(COMP_JSON) and os.path.exists(extra_path)):
+        return
+    base = json.load(open(COMP_JSON))
+    base.update(json.load(open(extra_path)))
+    with open(COMP_JSON, "w") as f:
+        json.dump(base, f, indent=4)
+    os.remove(extra_path)
+    log(f"merged sequence-mode keys into {COMP_JSON}")
+
+
+def main() -> int:
+    os.makedirs(LOG_DIR, exist_ok=True)
+    os.makedirs(PROF_DIR, exist_ok=True)
+    max_hours = float(os.environ.get("TPU_WAIT_HOURS", 6))
+    if not wait_for_tunnel(max_hours):
+        log(f"tunnel never came up within {max_hours}h; giving up")
+        return 1
+
+    py = sys.executable
+    prof = [py, "-m", "hetu_galvatron_tpu.cli.profiler", GPT2_YAML,
+            "mode=model_profiler",
+            "model_profiler.output_dir=" + PROF_DIR]
+    seq_dir = os.path.join(LOG_DIR, "seq_mode")
+    steps = [
+        ("comp_batch", prof + [
+            "model_profiler.profile_type=computation",
+            "model_profiler.profile_mode=batch",
+            "model_profiler.profile_max_batch_size=8"], 2400, None),
+        ("comp_sequence", [py, "-m", "hetu_galvatron_tpu.cli.profiler",
+                           GPT2_YAML, "mode=model_profiler",
+                           "model_profiler.output_dir=" + seq_dir,
+                           "model_profiler.profile_type=computation",
+                           "model_profiler.profile_mode=sequence",
+                           "model_profiler.profile_min_seq_length=512",
+                           "model_profiler.profile_max_seq_length=4096",
+                           "model_profiler.profile_seq_length_step=512"],
+         2400, None),
+        ("memory", prof + [
+            "model_profiler.profile_type=memory",
+            "model_profiler.profile_batch_size=8",
+            "model_profiler.max_tp_deg=1"], 2400, None),
+        ("llama_anchor", [py, "-m", "hetu_galvatron_tpu.cli.profiler",
+                          LLAMA_YAML, "mode=model_profiler",
+                          "model_profiler.output_dir=" + PROF_DIR,
+                          "model_profiler.profile_type=computation",
+                          "model_profiler.layernum_min=1",
+                          "model_profiler.layernum_max=2",
+                          "model_profiler.profile_batch_size=1",
+                          "model_profiler.profile_seq_length_list=[2048]"],
+         2400, None),
+        ("flash_check", [py, os.path.join(ROOT, "tools",
+                                          "tpu_flash_check.py")], 2400, None),
+        ("bench", [py, os.path.join(ROOT, "bench.py")], 1100, None),
+    ]
+    for name, argv, deadline, env_extra in steps:
+        if not run_step(name, argv, deadline, env_extra):
+            return 2
+        if name == "comp_sequence":
+            merge_comp_json(os.path.join(
+                seq_dir, "computation_profiling_bf16_gpt2-small_all.json"))
+    log("suite complete")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
