@@ -55,6 +55,12 @@ class ModelArgs(BaseModel):
     # Pallas fused CE kernel for the single-device loss path (distributed
     # runs keep the GSPMD vocab-parallel CE; see modules.cross_entropy_loss)
     use_fused_ce: bool = False
+    # rematerialization policy for per-layer activation checkpointing:
+    # "full" recomputes everything (min memory); "dots" saves matmul outputs
+    # so the backward recomputes only cheap elementwise ops (MXU FLOPs are
+    # the expensive part on TPU); "dots_no_batch" saves only non-batch dots
+    # (XLA's offloading-friendly middle ground)
+    remat_policy: Literal["full", "dots", "dots_no_batch"] = "full"
     attention_dropout: float = 0.0
     hidden_dropout: float = 0.0
     # gemma-family numerics: RMSNorm computes x * (1 + scale) (zero-centered

@@ -139,7 +139,7 @@ def forward_causal_lm(
                 M.apply_decoder_layer(p, h, cfg, **kw),
                 jnp.zeros((), jnp.float32))
         if remat_flags is not None and remat_flags[i]:
-            fn = jax.checkpoint(fn)
+            fn = M.remat(fn, cfg)
         x, aux = fn(lp, x)
         aux_total = aux_total + aux
     if boundary_fn is not None:

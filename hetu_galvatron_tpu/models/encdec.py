@@ -245,7 +245,7 @@ def forward_encdec(
         kwargs.pop("cross_sdpa_fn", None)  # encoder blocks have no cross-attn
         fn = lambda p, h, kw=kwargs: M.apply_decoder_layer(p, h, cfg, **kw)
         if enc_remat_flags is not None and enc_remat_flags[i]:
-            fn = jax.checkpoint(fn)
+            fn = M.remat(fn, cfg)
         mem = fn(lp, mem)
     if enc_boundary_fn is not None:
         mem = enc_boundary_fn(len(params["enc_layers"]), mem)
@@ -265,7 +265,7 @@ def forward_encdec(
         fn = lambda p, h, m, kw=kwargs: apply_cross_decoder_layer(
             p, h, m, cfg, **kw)
         if remat_flags is not None and remat_flags[i]:
-            fn = jax.checkpoint(fn)
+            fn = M.remat(fn, cfg)
         x = fn(lp, x, mem)
     if boundary_fn is not None:
         x = boundary_fn(len(params["layers"]), x)

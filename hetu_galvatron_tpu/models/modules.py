@@ -177,6 +177,26 @@ def init_attention(key: jax.Array, cfg: ModelArgs) -> Tuple[Params, Axes]:
     return p, a
 
 
+def remat(fn, cfg: ModelArgs):
+    """Per-layer activation checkpointing with the configured policy
+    (reference parallel.py:213-243 wraps with torch checkpoint_wrapper; the
+    TPU lever is WHICH values the backward may keep — saving MXU outputs
+    ("dots") trades a little memory for skipping matmul recompute)."""
+    if cfg.remat_policy == "dots":
+        return jax.checkpoint(
+            fn, policy=jax.checkpoint_policies.checkpoint_dots)
+    if cfg.remat_policy == "dots_no_batch":
+        return jax.checkpoint(
+            fn,
+            policy=jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims)
+    if cfg.remat_policy != "full":
+        # model_copy(update=...) skips pydantic validation, so a typo'd
+        # policy would otherwise silently run full recompute
+        raise ValueError(f"unknown remat_policy {cfg.remat_policy!r} "
+                         "(full | dots | dots_no_batch)")
+    return jax.checkpoint(fn)
+
+
 # fold_in stream bases partitioning one per-step dropout key into disjoint
 # substreams: decoder layers use their index i directly; these bases keep
 # embeddings / encoder layers clear of that range

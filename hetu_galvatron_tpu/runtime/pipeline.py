@@ -357,7 +357,7 @@ class PipelineEngine:
                 fn = lambda p, h, b=base: (b(p, h),
                                            jnp.zeros((), jnp.float32))
             if sh.checkpoint:
-                fn = jax.checkpoint(fn)
+                fn = M.remat(fn, cfg)
             x, aux = fn(lp, x)
             aux_total = aux_total + aux
         if not st.has_head:
@@ -420,7 +420,7 @@ class PipelineEngine:
             kwargs.pop("cross_sdpa_fn", None)
             fn = partial(M.apply_decoder_layer, cfg=cfg, **kwargs)
             if sh.checkpoint:
-                fn = jax.checkpoint(fn)
+                fn = M.remat(fn, cfg)
             a = fn(lp, a)
         if st.has_enc_norm:
             a = M.apply_norm(sp["enc_norm"], a, cfg)
@@ -432,7 +432,7 @@ class PipelineEngine:
                           dropout_rng=layer_rng(j), **dec_over.get(j, {}))
             fn = partial(apply_cross_decoder_layer, cfg=cfg, **kwargs)
             if sh.checkpoint:
-                fn = jax.checkpoint(fn)
+                fn = M.remat(fn, cfg)
             b = fn(lp, b, a)
         aux = jnp.zeros((), jnp.float32)  # t5 stacks carry no MoE aux
         if not st.has_head:
