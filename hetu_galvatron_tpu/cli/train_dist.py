@@ -54,7 +54,8 @@ def train(args) -> Dict[str, Any]:
     schedule = make_lr_schedule(args.train)
     base_iter = get_data_iterator(args, global_batch_size=hpc.global_bsz)
     data_iter = RerunDataIterator(base_iter)
-    profiler = RuntimeProfiler(args, world_size=world)
+    profiler = RuntimeProfiler(args, world_size=world,
+                               rank=jax.process_index())
     rerun = RerunStateMachine(args.rerun)
     start_iter = 0
 
@@ -185,56 +186,61 @@ def train(args) -> Dict[str, Any]:
         """Shared iteration driver for both execution paths. step_fn(sp, so,
         raw_batch) -> (sp, so, metrics)."""
         nonlocal exit_code
-        for it in range(start_iter, args.train.train_iters):
-            profiler.time_start(it)
-            if calc is not None:
-                if calc.update(consumed_box[0]):
-                    state.log(f"ramping global batch size to "
-                              f"{calc.current_running_global_batch_size} "
-                              f"({calc.num_micro_batches} microbatches)")
-                batch = rebatch.next_batch(
-                    calc.current_running_global_batch_size)
-                consumed_box[0] += calc.current_running_global_batch_size
-            else:
-                batch = next(data_iter)
-            if use_dropout:
-                # per-iteration rng; captured by the batch so a rerun-machine
-                # re-execution replays the SAME dropout mask (deterministic
-                # fault attribution)
-                batch = dict(batch)
-                batch["dropout_rng"] = jax.random.fold_in(drop_key, it)
-            # keep pre-update state alive only when the rerun machine may
-            # re-execute the step for fault attribution
-            prev = (sp, so) if rerun.enabled else None
-            sp, so, metrics = step_fn(sp, so, batch)
-            profiler.time_end(it, sync=metrics.get("loss"))
-            profiler.iteration_log(it, metrics, lr=float(schedule(it)))
-            rerun.validate_result(
-                float(metrics["loss"]), it,
-                rerun_fn=(
-                    (lambda: float(step_fn(*prev, batch)[2]["loss"]))
-                    if prev is not None else None),
-                data_iterator=data_iter if calc is None else None)
-            if calc is None:
-                data_iter.advance()
-            losses.append(float(metrics["loss"]))
-            # check for a fault BEFORE the interval save: the faulty update
-            # must never be persisted (a step_{it+1} checkpoint would shadow
-            # the pre-fault step_{it} one on resume)
-            exit_code = rerun.exit_code_requested()
-            if exit_code is None:
-                maybe_save(it, sp, so)
-            if exit_code is not None:
-                state.log(f"rerun machine requested exit (code {exit_code});"
-                          " checkpointing pre-fault state")
-                if args.ckpt.save and prev is not None:
-                    # save the PRE-update state at iter `it`: the faulty
-                    # update must not be persisted, and the relaunch re-runs
-                    # the suspect iteration to disambiguate
-                    wait_for_checkpoints()  # never race an in-flight save
-                    save_checkpoint(args.ckpt.save, it, prev[0], prev[1],
-                                    hpc=hpc)
-                break
+        try:
+            for it in range(start_iter, args.train.train_iters):
+                profiler.time_start(it)
+                if calc is not None:
+                    if calc.update(consumed_box[0]):
+                        state.log(f"ramping global batch size to "
+                                  f"{calc.current_running_global_batch_size} "
+                                  f"({calc.num_micro_batches} microbatches)")
+                    batch = rebatch.next_batch(
+                        calc.current_running_global_batch_size)
+                    consumed_box[0] += calc.current_running_global_batch_size
+                else:
+                    batch = next(data_iter)
+                if use_dropout:
+                    # per-iteration rng; captured by the batch so a rerun-machine
+                    # re-execution replays the SAME dropout mask (deterministic
+                    # fault attribution)
+                    batch = dict(batch)
+                    batch["dropout_rng"] = jax.random.fold_in(drop_key, it)
+                # keep pre-update state alive only when the rerun machine may
+                # re-execute the step for fault attribution
+                prev = (sp, so) if rerun.enabled else None
+                sp, so, metrics = step_fn(sp, so, batch)
+                profiler.time_end(it, sync=metrics.get("loss"))
+                profiler.iteration_log(it, metrics, lr=float(schedule(it)))
+                rerun.validate_result(
+                    float(metrics["loss"]), it,
+                    rerun_fn=(
+                        (lambda: float(step_fn(*prev, batch)[2]["loss"]))
+                        if prev is not None else None),
+                    data_iterator=data_iter if calc is None else None)
+                if calc is None:
+                    data_iter.advance()
+                losses.append(float(metrics["loss"]))
+                # check for a fault BEFORE the interval save: the faulty update
+                # must never be persisted (a step_{it+1} checkpoint would shadow
+                # the pre-fault step_{it} one on resume)
+                exit_code = rerun.exit_code_requested()
+                if exit_code is None:
+                    maybe_save(it, sp, so)
+                if exit_code is not None:
+                    state.log(f"rerun machine requested exit (code {exit_code});"
+                              " checkpointing pre-fault state")
+                    if args.ckpt.save and prev is not None:
+                        # save the PRE-update state at iter `it`: the faulty
+                        # update must not be persisted, and the relaunch re-runs
+                        # the suspect iteration to disambiguate
+                        wait_for_checkpoints()  # never race an in-flight save
+                        save_checkpoint(args.ckpt.save, it, prev[0], prev[1],
+                                        hpc=hpc)
+                    break
+        finally:
+            # crash-safe: flush an open XLA trace window so the
+            # capture survives the exception it may help debug
+            profiler.stop_trace()
         return sp, so
 
     if hpc.pp_deg > 1:
@@ -290,6 +296,7 @@ def train(args) -> Dict[str, Any]:
         run_loop(sp, so, spmd_step)
 
     wait_for_checkpoints()
+    profiler.stop_trace()  # flush an open trace window (short runs)
     if args.profile.profile:
         state.log(f"mean iter time: {profiler.filtered_time_ms():.2f} ms")
     if rerun.enabled and rerun.records:

@@ -240,6 +240,12 @@ class ProfileArgs(BaseModel):
     profiler_dir: str = "configs"
     profile_iters: int = 5
     profile_warmup: int = 2
+    # non-empty => capture an XLA/jax.profiler trace of iterations
+    # [profile_warmup, profile_warmup + trace_iters) into this directory
+    # (view with tensorboard / xprof — the TPU counterpart of the
+    # reference's torch.profiler traces, profile_overlap.py:10-60)
+    trace_dir: str = ""
+    trace_iters: int = 3
 
 
 class LoggingArgs(BaseModel):

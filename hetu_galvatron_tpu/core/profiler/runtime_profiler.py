@@ -73,13 +73,35 @@ class RuntimeProfiler:
         self.memory_samples: Dict[str, Dict[str, float]] = {}
         self._t0: Optional[float] = None
         self.enabled = bool(args.profile.profile)
+        self._tracing = False
+        self._traced_iters = 0
 
     # -- timing -------------------------------------------------------------
 
     def time_start(self, it: int) -> None:
+        p = self.args.profile
+        if p.trace_dir and self.rank == 0:
+            # XLA trace window [warmup, warmup + trace_iters): the TPU
+            # counterpart of the reference's torch.profiler capture.
+            # Window-based (not ==) so checkpoint-resumed runs whose first
+            # iteration is already past warmup still capture a window.
+            if (not self._tracing and self._traced_iters == 0
+                    and it >= p.profile_warmup):
+                jax.profiler.start_trace(p.trace_dir)
+                self._tracing = True
+            elif self._tracing:
+                self._traced_iters += 1
+                if self._traced_iters >= p.trace_iters:
+                    self.stop_trace()
         if not self.enabled or it < self.args.profile.profile_warmup:
             return
         self._t0 = time.perf_counter()
+
+    def stop_trace(self) -> None:
+        """Idempotent; also called at loop exit so short runs still flush."""
+        if self._tracing:
+            jax.profiler.stop_trace()
+            self._tracing = False
 
     def time_end(self, it: int, sync: Any = None) -> None:
         if self._t0 is None:

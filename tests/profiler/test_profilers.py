@@ -120,3 +120,31 @@ def test_model_profiler_memory_schema(cpu_devices):
         sequence_parallel=True)
     assert params[0] > 0 and 1 in acts[0]
     assert "model_states" in off and "first_stage" in on
+
+
+def test_runtime_profiler_trace_capture(tmp_path):
+    """profile.trace_dir captures an XLA trace window (the reference's
+    torch.profiler counterpart); stop_trace is idempotent."""
+    import glob
+    import os
+
+    from hetu_galvatron_tpu.core.args_schema import CoreArgs
+    from hetu_galvatron_tpu.core.profiler.runtime_profiler import (
+        RuntimeProfiler,
+    )
+
+    args = CoreArgs()
+    args.profile.profile = 1
+    args.profile.profile_warmup = 1
+    args.profile.trace_dir = str(tmp_path / "trace")
+    args.profile.trace_iters = 2
+    prof = RuntimeProfiler(args)
+    x = jnp.ones((8, 8))
+    for it in range(5):
+        prof.time_start(it)
+        y = jax.jit(lambda a: a @ a)(x)
+        prof.time_end(it, sync=y)
+    prof.stop_trace()
+    prof.stop_trace()  # idempotent
+    files = glob.glob(str(tmp_path / "trace" / "**" / "*"), recursive=True)
+    assert any(os.path.isfile(f) for f in files), "no trace files written"
