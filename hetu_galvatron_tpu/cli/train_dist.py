@@ -296,7 +296,6 @@ def train(args) -> Dict[str, Any]:
         run_loop(sp, so, spmd_step)
 
     wait_for_checkpoints()
-    profiler.stop_trace()  # flush an open trace window (short runs)
     if args.profile.profile:
         state.log(f"mean iter time: {profiler.filtered_time_ms():.2f} ms")
     if rerun.enabled and rerun.records:

@@ -95,6 +95,11 @@ class RuntimeProfiler:
                     self.stop_trace()
         if not self.enabled or it < self.args.profile.profile_warmup:
             return
+        if self._tracing:
+            # trace instrumentation inflates step time; traced iterations
+            # stay out of time_samples so filtered_time_ms (and the
+            # computation profiles the search engine fits) stay clean
+            return
         self._t0 = time.perf_counter()
 
     def stop_trace(self) -> None:

@@ -205,6 +205,17 @@ def batch_sharding(
     return NamedSharding(mesh, per_layer[0].batch_spec())
 
 
+def _lower_specs(hpc: HybridParallelConfig, mesh: Mesh, axes_tree: Params):
+    """Shared lowering preamble: strategies -> (per-layer shardings, vocab
+    sharding, param PartitionSpec tree) with the t5 combined-stack split."""
+    per_layer_all, vocab = layer_shardings(hpc, mesh)
+    n_enc = hpc.num_encoder_layers
+    enc_per, per_layer = per_layer_all[:n_enc], per_layer_all[n_enc:]
+    pspecs = param_specs(axes_tree, per_layer, vocab,
+                         enc_per_layer=enc_per or None)
+    return enc_per, per_layer, vocab, pspecs
+
+
 def make_spmd_train_step(
     cfg: ModelArgs,
     hpc: HybridParallelConfig,
@@ -229,11 +240,7 @@ def make_spmd_train_step(
     if hpc.pp_deg != 1:
         raise ValueError("make_spmd_train_step is the pp=1 path; use the "
                          "pipeline engine for pp>1")
-    per_layer_all, vocab = layer_shardings(hpc, mesh)
-    n_enc = hpc.num_encoder_layers
-    enc_per, per_layer = per_layer_all[:n_enc], per_layer_all[n_enc:]
-    pspecs = param_specs(axes_tree, per_layer, vocab,
-                         enc_per_layer=enc_per or None)
+    enc_per, per_layer, vocab, pspecs = _lower_specs(hpc, mesh, axes_tree)
     opt_pspecs = param_specs(axes_tree, per_layer, vocab, opt=True,
                              enc_per_layer=enc_per or None)
     opt_specs = opt_state_specs(tx, params, opt_pspecs)
@@ -324,3 +331,47 @@ def make_spmd_train_step(
             donate_argnums=(0, 1) if donate else (),
         )
     return train_step, pspecs, opt_specs, batch_shd
+
+
+def make_spmd_generate(
+    cfg: ModelArgs,
+    hpc: HybridParallelConfig,
+    mesh: Mesh,
+    axes_tree: Params,
+    max_new_tokens: int,
+    **gen_kwargs,
+):
+    """Distributed autoregressive generation (pp=1): jit models/generate.py's
+    fully-jittable generate() under the plan's GSPMD shardings and let
+    propagation shard the KV cache off the (tp-sharded) k/v projections —
+    batch rides the dp axes, kv heads the tp axes, with zero changes to the
+    decode loop. The reference ships only inference-context stubs
+    (transformer/attention.py inference params); this is a working
+    tensor/data-parallel decode path.
+
+    Returns (generate_fn(params, tokens, key) -> tokens, pspecs, batch_shd).
+    Params must be placed with :func:`shard_params` first.
+    """
+    from hetu_galvatron_tpu.models.generate import generate
+
+    if hpc.pp_deg != 1:
+        raise ValueError("make_spmd_generate is the pp=1 path")
+    if cfg.model_type == "t5":
+        # fail at build time with the real reason, not at trace time
+        raise NotImplementedError("generate(): t5 decode not implemented")
+    _, per_layer, vocab, pspecs = _lower_specs(hpc, mesh, axes_tree)
+    # tokens: batch over the first layer's dp axes only (sequence stays
+    # local — the decode step is one position wide)
+    tok_spec = P(per_layer[0].batch_spec()[0])
+    batch_shd = NamedSharding(mesh, tok_spec)
+    nshd = lambda tree: jax.tree.map(
+        lambda s: NamedSharding(mesh, s), tree,
+        is_leaf=lambda x: isinstance(x, P))
+
+    fn = jax.jit(
+        lambda p, tokens, key: generate(
+            p, tokens, cfg, max_new_tokens, key=key, **gen_kwargs),
+        in_shardings=(nshd(pspecs), batch_shd, NamedSharding(mesh, P())),
+        out_shardings=batch_shd,
+    )
+    return fn, pspecs, batch_shd
