@@ -44,6 +44,10 @@ class ByteTokenizer:
     def encode(self, text: str) -> List[int]:
         return list(text.encode("utf-8"))
 
+    def decode(self, ids: List[int]) -> str:
+        return bytes(i for i in ids if i < 256).decode("utf-8",
+                                                       errors="replace")
+
 
 def make_tokenizer(name: Optional[str]):
     if not name or name == "byte":
@@ -59,6 +63,9 @@ def make_tokenizer(name: Optional[str]):
 
         def encode(self, text: str) -> List[int]:
             return tok.encode(text, add_special_tokens=False)
+
+        def decode(self, ids: List[int]) -> str:
+            return tok.decode(ids)
 
     return _Wrap()
 

@@ -228,3 +228,35 @@ def test_train_dist_cli_with_dropout(capsys):
         "model.hidden_dropout=0.1", "model.attention_dropout=0.1"])
     assert rc == 0
     assert "training done" in capsys.readouterr().out
+
+
+def test_generate_cli_smoke_and_ckpt(tmp_path, capsys):
+    """Generation CLI: random-init smoke on the multi-device mesh (auto-TP
+    submesh) and decoding from a trained framework checkpoint."""
+    from hetu_galvatron_tpu.cli.generate import main as gen_main
+    from hetu_galvatron_tpu.cli.train_dist import main as train_main
+
+    overrides = [
+        os.path.join(ZOO, "gpt2-small.yaml"),
+        "model.hidden_size=32", "model.num_hidden_layers=2",
+        "model.num_attention_heads=4", "model.vocab_size=257",
+        "model.max_position_embeddings=64",
+        "model.make_vocab_size_divisible_by=1",
+    ]
+    rc = gen_main(overrides + ["model.seq_length=64", "prompt=hi there",
+                               "max_new_tokens=4"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert out.startswith("hi there")
+
+    assert train_main(overrides + [
+        "model.seq_length=16", "train.train_iters=2",
+        "parallel.mixed_precision=fp32",
+        "parallel.global_train_batch_size=8",
+        f"ckpt.save={tmp_path}", "ckpt.save_interval=2"]) == 0
+    capsys.readouterr()  # drain the training log
+    rc = gen_main(overrides + ["model.seq_length=64", "prompt=abc",
+                               "max_new_tokens=4", f"ckpt={tmp_path}",
+                               "temperature=0.5", "top_k=5"])
+    assert rc == 0
+    assert capsys.readouterr().out.startswith("abc")
