@@ -307,3 +307,42 @@ def test_remat_policy_parity():
         for a, b in zip(jax.tree.leaves(g), jax.tree.leaves(g_ref)):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=1e-5, atol=1e-6, err_msg=policy)
+
+
+@pytest.mark.parametrize("family", ["qwen2", "mistral"])
+def test_qwen2_mistral_logit_parity_vs_hf(family):
+    """Qwen2 (qkv bias, no mlp bias) and Mistral (bias-free GQA) through the
+    config adapter + converter match HF logits."""
+    torch = pytest.importorskip("torch")
+
+    from hetu_galvatron_tpu.runtime.checkpoint import hf_to_params
+    from hetu_galvatron_tpu.utils.hf_config_adapter import (
+        populate_model_args_from_hf,
+    )
+
+    if family == "qwen2":
+        from transformers import Qwen2Config as Cfg, Qwen2ForCausalLM as LM
+    else:
+        from transformers import MistralConfig as Cfg, MistralForCausalLM as LM
+
+    hf_cfg = Cfg(
+        vocab_size=128, hidden_size=64, intermediate_size=176,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rms_norm_eps=1e-6, rope_theta=10000.0,
+        tie_word_embeddings=False,
+    )
+    cfg = populate_model_args_from_hf(hf_cfg)
+    cfg = cfg.model_copy(update={"seq_length": 16,
+                                 "make_vocab_size_divisible_by": 1})
+    assert cfg.add_qkv_bias == (family == "qwen2")
+    assert not cfg.add_bias_linear
+
+    torch.manual_seed(0)
+    hf = LM(hf_cfg).eval()
+    params = hf_to_params(hf.state_dict(), cfg)
+    tokens_np = np.random.RandomState(0).randint(0, 128, (2, 16))
+    with torch.no_grad():
+        ref = hf(torch.tensor(tokens_np)).logits.numpy()
+    ours = forward_causal_lm(params, jnp.asarray(tokens_np), cfg,
+                             compute_dtype=jnp.float32)
+    np.testing.assert_allclose(np.asarray(ours), ref, rtol=3e-4, atol=3e-4)
