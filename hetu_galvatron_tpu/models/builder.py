@@ -95,12 +95,19 @@ def forward_causal_lm(
     logits_fp32: bool = True,
     with_aux: bool = False,
     dropout_rng: Optional[jax.Array] = None,
+    position_ids: Optional[jax.Array] = None,
+    segment_ids: Optional[jax.Array] = None,
 ) -> jax.Array:
     """tokens [B, S] -> logits [B, S, V].
 
     ``dropout_rng`` (training only) enables cfg.attention_dropout /
     cfg.hidden_dropout; ``None`` (the default) is eval semantics — dropout
     layers are the identity, so existing callers are unchanged.
+
+    ``position_ids`` / ``segment_ids`` [B, S] implement the reference's
+    reset_position_ids / reset_attention_mask for packed multi-document
+    samples: positions restart at 0 after each eod and attention is
+    block-diagonalized per document (dataloader.packed_doc_fields).
 
     ``remat_flags[i]`` turns on `jax.checkpoint` for layer i (the reference's
     per-layer checkpoint_flags_enc, parallel.py:213-243). ``layer_overrides``
@@ -116,17 +123,24 @@ def forward_causal_lm(
     S = tokens.shape[1]
     rope = None
     if cfg.position_embedding_type == "rope":
-        rope = M.rope_cos_sin(S, cfg.head_dim, cfg.rope_theta,
-                              scaling=cfg.rope_scaling)
+        cos, sin = M.rope_cos_sin(S, cfg.head_dim, cfg.rope_theta,
+                                  scaling=cfg.rope_scaling)
+        if position_ids is not None:
+            # packed samples: gather per-token rows -> [B, S, D/2]
+            cos, sin = cos[position_ids], sin[position_ids]
+        rope = (cos, sin)
     x = M.apply_embedding(
         params["embed"], tokens, cfg, compute_dtype=compute_dtype,
         dropout_rng=M.fold_dropout_rng(dropout_rng, cfg,
-                                       M.DROPOUT_STREAM_EMBED))
+                                       M.DROPOUT_STREAM_EMBED),
+        position_ids=position_ids)
     aux_total = jnp.zeros((), jnp.float32)
     for i, lp in enumerate(params["layers"]):
         if boundary_fn is not None:
             x = boundary_fn(i, x)
         kwargs: Dict[str, Any] = dict(rope=rope, compute_dtype=compute_dtype)
+        if segment_ids is not None:
+            kwargs["segment_ids"] = segment_ids
         if dropout_rng is not None:
             kwargs["dropout_rng"] = M.fold_dropout_rng(dropout_rng, cfg, i)
         if layer_overrides and i in layer_overrides:
@@ -196,6 +210,8 @@ def causal_lm_loss(
         compute_dtype=compute_dtype, remat_flags=remat_flags,
         layer_overrides=layer_overrides, boundary_fn=boundary_fn,
         with_aux=True, dropout_rng=batch.get("dropout_rng"),
+        position_ids=batch.get("position_ids"),
+        segment_ids=batch.get("segment_ids"),
     )
     ce = M.cross_entropy_loss(logits, batch["labels"], batch.get("loss_mask"),
                               fused=fused)

@@ -142,11 +142,17 @@ def rope_cos_sin(
 
 
 def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
-    """x: [B, S, N, D]; rotate-half convention (llama-style)."""
+    """x: [B, S, N, D]; rotate-half convention (llama-style). cos/sin are
+    [S, D/2] (positions in order) or [B, S, D/2] (gathered per-token
+    position ids — packed samples with reset_position_ids)."""
     d2 = x.shape[-1] // 2
     x1, x2 = x[..., :d2], x[..., d2:]
-    cos = cos[None, :, None, :]
-    sin = sin[None, :, None, :]
+    if cos.ndim == 2:
+        cos = cos[None, :, None, :]
+        sin = sin[None, :, None, :]
+    else:
+        cos = cos[:, :, None, :]
+        sin = sin[:, :, None, :]
     return jnp.concatenate(
         [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
     ).astype(x.dtype)
@@ -229,6 +235,7 @@ def dropout(x: jax.Array, rate: float, rng: Optional[jax.Array]) -> jax.Array:
 def xla_sdpa(
     q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool = True,
     dropout_rate: float = 0.0, dropout_rng: Optional[jax.Array] = None,
+    segment_ids: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Reference attention core on XLA: [B,S,N,D] x [B,T,K,D] -> [B,S,N,D].
 
@@ -237,6 +244,10 @@ def xla_sdpa(
     dispatch (reference attention.py:664-720 has the same three-way switch).
     ``dropout_rate`` applies attention-probability dropout (reference
     attention.py passes attention_dropout into its cores).
+    ``segment_ids`` [B, S] (self-attention only, S == T) block-diagonalizes
+    the mask so packed documents cannot attend across boundaries (the
+    reference's reset_attention_mask, Megatron
+    get_ltor_masks_and_position_ids).
     """
     B, S, N, D = q.shape
     K = k.shape[2]
@@ -250,6 +261,12 @@ def xla_sdpa(
         qpos = jnp.arange(S)[:, None] + (k.shape[1] - S)
         kpos = jnp.arange(k.shape[1])[None, :]
         scores = jnp.where(qpos >= kpos, scores, jnp.finfo(jnp.float32).min)
+    if segment_ids is not None:
+        if k.shape[1] != S:
+            raise ValueError("segment_ids require self-attention (S == T)")
+        same = segment_ids[:, None, None, :, None] == \
+            segment_ids[:, None, None, None, :]
+        scores = jnp.where(same, scores, jnp.finfo(jnp.float32).min)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     probs = dropout(probs, dropout_rate, dropout_rng)
     out = jnp.einsum("bkgst,btkd->bskgd", probs, v,
@@ -266,6 +283,7 @@ def apply_attention(
     compute_dtype=jnp.bfloat16,
     causal: bool = True,
     dropout_rng: Optional[jax.Array] = None,
+    segment_ids: Optional[jax.Array] = None,
 ) -> jax.Array:
     B, S, H = x.shape
     hd = cfg.head_dim
@@ -284,23 +302,29 @@ def apply_attention(
         cos, sin = rope
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
-    if dropout_rng is not None and cfg.attention_dropout > 0.0:
-        # probability dropout lives inside the attention core; none of the
-        # kernel paths (Pallas flash, ring, Ulysses a2a) has a dropout
-        # variant (the reference's exists only inside the external CUDA
-        # flash-attn ops). Silently swapping an installed kernel for the
-        # score-materializing XLA core would be an OOM/perf cliff on the
-        # long-context plans those kernels exist for — refuse loudly.
+    use_dropout = dropout_rng is not None and cfg.attention_dropout > 0.0
+    if use_dropout or segment_ids is not None:
+        # probability dropout and segment (packed-document) masking both
+        # live inside the attention core; none of the kernel paths (Pallas
+        # flash, ring, Ulysses a2a) implements them (the reference's exist
+        # only inside the external CUDA flash-attn ops). Silently swapping
+        # an installed kernel for the score-materializing XLA core would be
+        # an OOM/perf cliff on the long-context plans those kernels exist
+        # for — refuse loudly.
         if sdpa_fn is not xla_sdpa:
             raise NotImplementedError(
-                "attention_dropout > 0 is only supported with the XLA "
-                "attention core; the installed flash/ring/Ulysses kernel "
-                "has no dropout variant. Set model.attention_dropout=0 "
-                "(hidden_dropout works with every kernel) or disable the "
-                "attention override for these layers")
+                "attention_dropout > 0 / reset_attention_mask are only "
+                "supported with the XLA attention core; the installed "
+                "flash/ring/Ulysses kernel implements neither. Set "
+                "model.use_flash_attn=false (and avoid cp/ulysses layers), "
+                "or turn the feature off (model.attention_dropout=0 / "
+                "data.reset_attention_mask=false); hidden_dropout works "
+                "with every kernel")
         out = xla_sdpa(q, k, v, causal=causal,
-                       dropout_rate=cfg.attention_dropout,
-                       dropout_rng=dropout_rng)
+                       dropout_rate=cfg.attention_dropout if use_dropout
+                       else 0.0,
+                       dropout_rng=dropout_rng if use_dropout else None,
+                       segment_ids=segment_ids)
     else:
         out = sdpa_fn(q, k, v, causal=causal)
     out = out.reshape(B, S, nq * hd)
@@ -398,6 +422,7 @@ def apply_decoder_layer(
     compute_dtype=jnp.bfloat16,
     causal: Optional[bool] = None,
     dropout_rng: Optional[jax.Array] = None,
+    segment_ids: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Pre-norm residual block (reference GalvatronDecoderLayer,
     modules.py:233). Encoder families (bert, t5 encoder stack) run the same
@@ -421,7 +446,8 @@ def apply_decoder_layer(
             x + drop_h(apply_attention(p["attn"], x, cfg, rope=rope,
                                        sdpa_fn=sdpa_fn,
                                        compute_dtype=compute_dtype,
-                                       causal=causal, dropout_rng=r_attn),
+                                       causal=causal, dropout_rng=r_attn,
+                                       segment_ids=segment_ids),
                        r_res1),
             cfg)
         return apply_norm(
@@ -433,7 +459,8 @@ def apply_decoder_layer(
     x = x + drop_h(apply_attention(p["attn"], h, cfg, rope=rope,
                                    sdpa_fn=sdpa_fn,
                                    compute_dtype=compute_dtype, causal=causal,
-                                   dropout_rng=r_attn), r_res1)
+                                   dropout_rng=r_attn,
+                                   segment_ids=segment_ids), r_res1)
     h = apply_norm(p["ln2"], x, cfg)
     x = x + drop_h(apply_mlp(p["mlp"], h, cfg, compute_dtype=compute_dtype),
                    r_res2)
@@ -464,11 +491,15 @@ def init_embedding(key: jax.Array, cfg: ModelArgs) -> Tuple[Params, Axes]:
 
 def apply_embedding(p: Params, tokens: jax.Array, cfg: ModelArgs,
                     compute_dtype=jnp.bfloat16,
-                    dropout_rng: Optional[jax.Array] = None) -> jax.Array:
+                    dropout_rng: Optional[jax.Array] = None,
+                    position_ids: Optional[jax.Array] = None) -> jax.Array:
     x = jnp.take(p["wte"], tokens, axis=0)
     if "wpe" in p:
-        S = tokens.shape[1]
-        x = x + p["wpe"][:S][None, :, :]
+        if position_ids is not None:  # packed samples: per-token positions
+            x = x + jnp.take(p["wpe"], position_ids, axis=0)
+        else:
+            S = tokens.shape[1]
+            x = x + p["wpe"][:S][None, :, :]
     if "ln" in p:
         x = apply_norm(p["ln"], x, cfg)
     if cfg.scale_embeddings:

@@ -321,6 +321,7 @@ def apply_moe_decoder_layer(
     sdpa_fn=M.xla_sdpa,
     compute_dtype=jnp.bfloat16,
     dropout_rng=None,
+    segment_ids=None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Pre-norm block with an MoE FFN; returns (x, aux_loss)."""
     r_attn = r_res1 = r_res2 = None
@@ -329,7 +330,8 @@ def apply_moe_decoder_layer(
     h = M.apply_norm(p["ln1"], x, cfg)
     x = x + M.dropout(
         M.apply_attention(p["attn"], h, cfg, rope=rope, sdpa_fn=sdpa_fn,
-                          compute_dtype=compute_dtype, dropout_rng=r_attn),
+                          compute_dtype=compute_dtype, dropout_rng=r_attn,
+                          segment_ids=segment_ids),
         cfg.hidden_dropout, r_res1)
     h = M.apply_norm(p["ln2"], x, cfg)
     y, aux = apply_moe_mlp(p["moe"], h, cfg, compute_dtype=compute_dtype)

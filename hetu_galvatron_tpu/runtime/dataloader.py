@@ -143,6 +143,20 @@ def get_data_iterator(
             it = eod_masked_batches(it, meta["eod_id"])
     else:
         raise ValueError(f"unknown dataset kind {data.dataset}")
+    if data.reset_position_ids or data.reset_attention_mask:
+        if args.model.model_type in ("bert", "t5"):
+            raise NotImplementedError(
+                "reset_position_ids/reset_attention_mask are causal-LM "
+                "packing flags (bert/t5 batches have no packed documents)")
+        if meta.get("eod_id") is None:
+            raise ValueError(
+                "reset_position_ids/reset_attention_mask need document "
+                "boundaries: use data.dataset=indexed with an eod-emitting "
+                "tokenizer (preprocess_data writes eod_id to the sidecar)")
+        it = packed_doc_batches(
+            it, meta["eod_id"],
+            reset_position_ids=data.reset_position_ids,
+            reset_attention_mask=data.reset_attention_mask)
     if args.model.model_type == "bert":
         # encoders train on the MLM objective, never the causal shift
         # (bidirectional attention would leak shifted labels)
@@ -191,6 +205,41 @@ def eod_masked_batches(it: Iterator[Dict[str, np.ndarray]], eod_id: int
         batch = dict(batch)
         batch["loss_mask"] = (batch["loss_mask"]
                               * (batch["tokens"] != eod_id))
+        yield batch
+
+
+def packed_doc_fields(tokens: np.ndarray, eod_id: int, *,
+                      reset_position_ids: bool, reset_attention_mask: bool
+                      ) -> Dict[str, np.ndarray]:
+    """Per-token position/segment ids for packed multi-document samples
+    (reference reset_position_ids / reset_attention_mask, Megatron
+    get_ltor_masks_and_position_ids): a document starts AFTER each eod
+    token; positions restart at 0 there and segment ids increment so
+    attention can be block-diagonalized per document."""
+    doc_starts = np.zeros_like(tokens, dtype=np.int64)
+    doc_starts[:, 1:] = (tokens[:, :-1] == eod_id)
+    segments = np.cumsum(doc_starts, axis=1)
+    out: Dict[str, np.ndarray] = {}
+    if reset_attention_mask:
+        out["segment_ids"] = segments.astype(np.int32)
+    if reset_position_ids:
+        pos = np.arange(tokens.shape[1], dtype=np.int64)[None, :]
+        # position of each document's first token, broadcast along the doc
+        starts = np.where(doc_starts.astype(bool), pos, 0)
+        doc_start_pos = np.maximum.accumulate(starts, axis=1)
+        out["position_ids"] = (pos - doc_start_pos).astype(np.int32)
+    return out
+
+
+def packed_doc_batches(it: Iterator[Dict[str, np.ndarray]], eod_id: int, *,
+                       reset_position_ids: bool, reset_attention_mask: bool
+                       ) -> Iterator[Dict[str, np.ndarray]]:
+    for batch in it:
+        batch = dict(batch)
+        batch.update(packed_doc_fields(
+            batch["tokens"], eod_id,
+            reset_position_ids=reset_position_ids,
+            reset_attention_mask=reset_attention_mask))
         yield batch
 
 

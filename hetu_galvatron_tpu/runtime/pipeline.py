@@ -516,8 +516,19 @@ class PipelineEngine:
     # schedules
     # ------------------------------------------------------------------
 
+    # batch keys the stage transfers actually ship; anything else (e.g.
+    # packed-sample position_ids/segment_ids) would be silently dropped by
+    # _put_stage0/_put_last, so its presence must be a loud error
+    _SHIPPED_KEYS = frozenset({"tokens", "labels", "loss_mask", "enc_tokens"})
+
     def _microbatches(self, batch: Dict[str, np.ndarray],
                       num_microbatches: Optional[int] = None):
+        extra = set(batch) - self._SHIPPED_KEYS
+        if extra:
+            raise NotImplementedError(
+                f"the pipeline engine does not thread batch keys {sorted(extra)} "
+                "through its stage transfers (reset_position_ids/"
+                "reset_attention_mask etc. need pp_deg=1)")
         m = max(num_microbatches if num_microbatches is not None
                 else self.hpc.chunks, 1)
         b = batch["tokens"].shape[0]
