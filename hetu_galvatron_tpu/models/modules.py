@@ -303,28 +303,34 @@ def apply_attention(
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
     use_dropout = dropout_rng is not None and cfg.attention_dropout > 0.0
-    if use_dropout or segment_ids is not None:
-        # probability dropout and segment (packed-document) masking both
-        # live inside the attention core; none of the kernel paths (Pallas
-        # flash, ring, Ulysses a2a) implements them (the reference's exist
-        # only inside the external CUDA flash-attn ops). Silently swapping
-        # an installed kernel for the score-materializing XLA core would be
-        # an OOM/perf cliff on the long-context plans those kernels exist
-        # for — refuse loudly.
+    if use_dropout:
+        # probability dropout lives inside the attention core and no kernel
+        # path implements it (the reference's exists only inside the
+        # external CUDA flash-attn ops). Silently swapping an installed
+        # kernel for the score-materializing XLA core would be an OOM/perf
+        # cliff on the long-context plans those kernels exist for — refuse.
         if sdpa_fn is not xla_sdpa:
             raise NotImplementedError(
-                "attention_dropout > 0 / reset_attention_mask are only "
-                "supported with the XLA attention core; the installed "
-                "flash/ring/Ulysses kernel implements neither. Set "
-                "model.use_flash_attn=false (and avoid cp/ulysses layers), "
-                "or turn the feature off (model.attention_dropout=0 / "
-                "data.reset_attention_mask=false); hidden_dropout works "
-                "with every kernel")
+                "attention_dropout > 0 is only supported with the XLA "
+                "attention core; the installed flash/ring/Ulysses kernel "
+                "has no dropout variant. Set model.use_flash_attn=false "
+                "(and avoid cp/ulysses layers) or model.attention_dropout=0;"
+                " hidden_dropout works with every kernel")
         out = xla_sdpa(q, k, v, causal=causal,
-                       dropout_rate=cfg.attention_dropout if use_dropout
-                       else 0.0,
-                       dropout_rng=dropout_rng if use_dropout else None,
-                       segment_ids=segment_ids)
+                       dropout_rate=cfg.attention_dropout,
+                       dropout_rng=dropout_rng, segment_ids=segment_ids)
+    elif segment_ids is not None:
+        # packed-document masking: the XLA core and the Pallas flash kernel
+        # implement it (flash masks per tile in-kernel); ring/Ulysses do not
+        if sdpa_fn is xla_sdpa or getattr(sdpa_fn, "supports_segments",
+                                          False):
+            out = sdpa_fn(q, k, v, causal=causal, segment_ids=segment_ids)
+        else:
+            raise NotImplementedError(
+                "reset_attention_mask is not supported by the installed "
+                "ring/Ulysses attention kernel; use flash or the XLA core "
+                "for packed-document layers, or set "
+                "data.reset_attention_mask=false")
     else:
         out = sdpa_fn(q, k, v, causal=causal)
     out = out.reshape(B, S, nq * hd)

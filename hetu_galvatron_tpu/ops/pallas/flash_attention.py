@@ -27,9 +27,14 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = float(jnp.finfo(jnp.float32).min)
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
-                  *, block_q: int, block_k: int, num_k: int, causal: bool,
-                  scale: float):
+def _flash_kernel(q_ref, k_ref, v_ref, *rest,
+                  block_q: int, block_k: int, num_k: int, causal: bool,
+                  scale: float, has_seg: bool = False):
+    if has_seg:
+        qseg_ref, kseg_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        qseg_ref = kseg_ref = None
+        o_ref, lse_ref, m_ref, l_ref, acc_ref = rest
     qi = pl.program_id(2)
     ki = pl.program_id(3)
 
@@ -55,6 +60,11 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
             kpos = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(qpos >= kpos, s, NEG_INF)
+        if qseg_ref is not None:
+            # packed documents: mask cross-segment pairs (reference
+            # reset_attention_mask; same trailing-singleton layout as lse)
+            s = jnp.where(qseg_ref[0, :, 0][:, None]
+                          == kseg_ref[0, :, 0][None, :], s, NEG_INF)
         m = m_ref[...]
         block_max = jnp.max(s, axis=1)
         new_m = jnp.maximum(m, block_max)
@@ -84,6 +94,7 @@ def flash_attention_hmajor(
     q: jax.Array,  # [B, N, S, D]
     k: jax.Array,  # [B, K, S, D]
     v: jax.Array,
+    segments: "jax.Array | None" = None,  # [B, S] int32 (packed docs)
     *,
     causal: bool = True,
     block_q: int = 256,
@@ -99,20 +110,32 @@ def flash_attention_hmajor(
         raise ValueError(f"seq {S} must divide by blocks {block_q}/{block_k}")
     num_k = S // block_k
     grid = (B, N, S // block_q, num_k)  # k-block axis innermost
+    has_seg = segments is not None
     kernel = functools.partial(
         _flash_kernel, block_q=block_q, block_k=block_k, num_k=num_k,
-        causal=causal, scale=1.0 / math.sqrt(D))
+        causal=causal, scale=1.0 / math.sqrt(D), has_seg=has_seg)
+    in_specs = [
+        pl.BlockSpec((1, 1, block_q, D),
+                     lambda b, n, qi, ki: (b, n, qi, 0)),
+        pl.BlockSpec((1, 1, block_k, D),
+                     lambda b, n, qi, ki: (b, n // G, ki, 0)),
+        pl.BlockSpec((1, 1, block_k, D),
+                     lambda b, n, qi, ki: (b, n // G, ki, 0)),
+    ]
+    operands = [q, k, v]
+    if has_seg:
+        # [B, S, 1]: trailing singleton keeps Mosaic's (8, 128)-or-equal
+        # tiling rule satisfied (same layout trick as lse)
+        seg3 = segments.astype(jnp.int32)[:, :, None]
+        in_specs += [
+            pl.BlockSpec((1, block_q, 1), lambda b, n, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, block_k, 1), lambda b, n, qi, ki: (b, ki, 0)),
+        ]
+        operands += [seg3, seg3]
     return pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, 1, block_q, D),
-                         lambda b, n, qi, ki: (b, n, qi, 0)),
-            pl.BlockSpec((1, 1, block_k, D),
-                         lambda b, n, qi, ki: (b, n // G, ki, 0)),
-            pl.BlockSpec((1, 1, block_k, D),
-                         lambda b, n, qi, ki: (b, n // G, ki, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, 1, block_q, D),
                          lambda b, n, qi, ki: (b, n, qi, 0)),
@@ -134,15 +157,20 @@ def flash_attention_hmajor(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,
-    )(q, k, v)
+    )(*operands)
 
 
 def _flash_bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                           dk_ref, dv_ref, dk_acc, dv_acc, *,
-                           block_q: int, block_k: int, num_q: int, G: int,
-                           causal: bool, scale: float):
+                           *rest, block_q: int, block_k: int, num_q: int,
+                           G: int, causal: bool, scale: float,
+                           has_seg: bool = False):
     """Grid (B, KV, kb, G, qb): accumulate dk/dv for one k/v tile across the
     G query heads of this kv head and all q blocks."""
+    if has_seg:
+        qseg_ref, kseg_ref, dk_ref, dv_ref, dk_acc, dv_acc = rest
+    else:
+        qseg_ref = kseg_ref = None
+        dk_ref, dv_ref, dk_acc, dv_acc = rest
     kb = pl.program_id(2)
     g = pl.program_id(3)
     qb = pl.program_id(4)
@@ -171,6 +199,9 @@ def _flash_bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             kpos = kb * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(qpos >= kpos, s, NEG_INF)
+        if qseg_ref is not None:
+            s = jnp.where(qseg_ref[0, :, 0][:, None]
+                          == kseg_ref[0, :, 0][None, :], s, NEG_INF)
         p = jnp.exp(s - lse)
         p = jnp.where(s == NEG_INF, 0.0, p)
         dv_acc[...] += jax.lax.dot_general(
@@ -190,9 +221,15 @@ def _flash_bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                         dq_ref, dq_acc, *, block_q: int, block_k: int,
-                         num_k: int, causal: bool, scale: float):
+                         *rest, block_q: int, block_k: int,
+                         num_k: int, causal: bool, scale: float,
+                         has_seg: bool = False):
     """Grid (B, N, qb, kb): accumulate dq for one q tile across k blocks."""
+    if has_seg:
+        qseg_ref, kseg_ref, dq_ref, dq_acc = rest
+    else:
+        qseg_ref = kseg_ref = None
+        dq_ref, dq_acc = rest
     qb = pl.program_id(2)
     kb = pl.program_id(3)
 
@@ -218,6 +255,9 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             kpos = kb * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(qpos >= kpos, s, NEG_INF)
+        if qseg_ref is not None:
+            s = jnp.where(qseg_ref[0, :, 0][:, None]
+                          == kseg_ref[0, :, 0][None, :], s, NEG_INF)
         p = jnp.exp(s - lse)
         p = jnp.where(s == NEG_INF, 0.0, p)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
@@ -235,7 +275,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 @functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
                                              "interpret"))
 def flash_attention_bwd_hmajor(
-    q, k, v, o, lse, do, *,
+    q, k, v, o, lse, do, segments=None, *,
     causal: bool = True,
     block_q: int = 256,
     block_k: int = 256,
@@ -251,29 +291,42 @@ def flash_attention_bwd_hmajor(
     num_q = S // block_q
     num_k = S // block_k
     scale = 1.0 / math.sqrt(D)
+    has_seg = segments is not None
     # (B, N, S, 1): same trailing-singleton layout as lse (Mosaic tiling)
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1,
                     keepdims=True)
 
+    dkdv_in_specs = [
+        pl.BlockSpec((1, 1, block_q, D),
+                     lambda b, kh, kb, g, qb: (b, kh * G + g, qb, 0)),
+        pl.BlockSpec((1, 1, block_k, D),
+                     lambda b, kh, kb, g, qb: (b, kh, kb, 0)),
+        pl.BlockSpec((1, 1, block_k, D),
+                     lambda b, kh, kb, g, qb: (b, kh, kb, 0)),
+        pl.BlockSpec((1, 1, block_q, D),
+                     lambda b, kh, kb, g, qb: (b, kh * G + g, qb, 0)),
+        pl.BlockSpec((1, 1, block_q, 1),
+                     lambda b, kh, kb, g, qb: (b, kh * G + g, qb, 0)),
+        pl.BlockSpec((1, 1, block_q, 1),
+                     lambda b, kh, kb, g, qb: (b, kh * G + g, qb, 0)),
+    ]
+    dkdv_operands = [q, k, v, do, lse, delta]
+    if has_seg:
+        seg3 = segments.astype(jnp.int32)[:, :, None]
+        dkdv_in_specs += [
+            pl.BlockSpec((1, block_q, 1),
+                         lambda b, kh, kb, g, qb: (b, qb, 0)),
+            pl.BlockSpec((1, block_k, 1),
+                         lambda b, kh, kb, g, qb: (b, kb, 0)),
+        ]
+        dkdv_operands += [seg3, seg3]
+
     dkdv = pl.pallas_call(
         functools.partial(_flash_bwd_dkdv_kernel, block_q=block_q,
                           block_k=block_k, num_q=num_q, G=G, causal=causal,
-                          scale=scale),
+                          scale=scale, has_seg=has_seg),
         grid=(B, KV, num_k, G, num_q),
-        in_specs=[
-            pl.BlockSpec((1, 1, block_q, D),
-                         lambda b, kh, kb, g, qb: (b, kh * G + g, qb, 0)),
-            pl.BlockSpec((1, 1, block_k, D),
-                         lambda b, kh, kb, g, qb: (b, kh, kb, 0)),
-            pl.BlockSpec((1, 1, block_k, D),
-                         lambda b, kh, kb, g, qb: (b, kh, kb, 0)),
-            pl.BlockSpec((1, 1, block_q, D),
-                         lambda b, kh, kb, g, qb: (b, kh * G + g, qb, 0)),
-            pl.BlockSpec((1, 1, block_q, 1),
-                         lambda b, kh, kb, g, qb: (b, kh * G + g, qb, 0)),
-            pl.BlockSpec((1, 1, block_q, 1),
-                         lambda b, kh, kb, g, qb: (b, kh * G + g, qb, 0)),
-        ],
+        in_specs=dkdv_in_specs,
         out_specs=[
             pl.BlockSpec((1, 1, block_k, D),
                          lambda b, kh, kb, g, qb: (b, kh, kb, 0)),
@@ -293,27 +346,35 @@ def flash_attention_bwd_hmajor(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary", "arbitrary")),
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(*dkdv_operands)
 
+    dq_in_specs = [
+        pl.BlockSpec((1, 1, block_q, D),
+                     lambda b, n, qb, kb: (b, n, qb, 0)),
+        pl.BlockSpec((1, 1, block_k, D),
+                     lambda b, n, qb, kb: (b, n // G, kb, 0)),
+        pl.BlockSpec((1, 1, block_k, D),
+                     lambda b, n, qb, kb: (b, n // G, kb, 0)),
+        pl.BlockSpec((1, 1, block_q, D),
+                     lambda b, n, qb, kb: (b, n, qb, 0)),
+        pl.BlockSpec((1, 1, block_q, 1),
+                     lambda b, n, qb, kb: (b, n, qb, 0)),
+        pl.BlockSpec((1, 1, block_q, 1),
+                     lambda b, n, qb, kb: (b, n, qb, 0)),
+    ]
+    dq_operands = [q, k, v, do, lse, delta]
+    if has_seg:
+        dq_in_specs += [
+            pl.BlockSpec((1, block_q, 1), lambda b, n, qb, kb: (b, qb, 0)),
+            pl.BlockSpec((1, block_k, 1), lambda b, n, qb, kb: (b, kb, 0)),
+        ]
+        dq_operands += [seg3, seg3]
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, block_q=block_q,
                           block_k=block_k, num_k=num_k, causal=causal,
-                          scale=scale),
+                          scale=scale, has_seg=has_seg),
         grid=(B, N, num_q, num_k),
-        in_specs=[
-            pl.BlockSpec((1, 1, block_q, D),
-                         lambda b, n, qb, kb: (b, n, qb, 0)),
-            pl.BlockSpec((1, 1, block_k, D),
-                         lambda b, n, qb, kb: (b, n // G, kb, 0)),
-            pl.BlockSpec((1, 1, block_k, D),
-                         lambda b, n, qb, kb: (b, n // G, kb, 0)),
-            pl.BlockSpec((1, 1, block_q, D),
-                         lambda b, n, qb, kb: (b, n, qb, 0)),
-            pl.BlockSpec((1, 1, block_q, 1),
-                         lambda b, n, qb, kb: (b, n, qb, 0)),
-            pl.BlockSpec((1, 1, block_q, 1),
-                         lambda b, n, qb, kb: (b, n, qb, 0)),
-        ],
+        in_specs=dq_in_specs,
         out_specs=pl.BlockSpec((1, 1, block_q, D),
                                lambda b, n, qb, kb: (b, n, qb, 0)),
         out_shape=jax.ShapeDtypeStruct((B, N, S, D), q.dtype),
@@ -323,7 +384,7 @@ def flash_attention_bwd_hmajor(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(*dq_operands)
     return dq, dkdv[0], dkdv[1]
 
 
@@ -345,51 +406,56 @@ def fit_block(default: int, seq: int, floor: int = 128) -> int:
     return 0
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_with_vjp(q, k, v, causal, interpret, block_q, block_k):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash_with_vjp(q, k, v, segments, causal, interpret, block_q, block_k):
     qh = q.transpose(0, 2, 1, 3)
     kh = k.transpose(0, 2, 1, 3)
     vh = v.transpose(0, 2, 1, 3)
-    out, _ = flash_attention_hmajor(qh, kh, vh, causal=causal,
+    out, _ = flash_attention_hmajor(qh, kh, vh, segments, causal=causal,
                                     interpret=interpret,
                                     block_q=block_q, block_k=block_k)
     return out.transpose(0, 2, 1, 3)
 
 
-def _flash_fwd(q, k, v, causal, interpret, block_q, block_k):
+def _flash_fwd(q, k, v, segments, causal, interpret, block_q, block_k):
     qh = q.transpose(0, 2, 1, 3)
     kh = k.transpose(0, 2, 1, 3)
     vh = v.transpose(0, 2, 1, 3)
-    out, lse = flash_attention_hmajor(qh, kh, vh, causal=causal,
+    out, lse = flash_attention_hmajor(qh, kh, vh, segments, causal=causal,
                                       interpret=interpret,
                                       block_q=block_q, block_k=block_k)
-    return out.transpose(0, 2, 1, 3), (qh, kh, vh, out, lse)
+    return out.transpose(0, 2, 1, 3), (qh, kh, vh, out, lse, segments)
 
 
 def _flash_bwd(causal, interpret, block_q, block_k, res, g):
-    qh, kh, vh, out, lse = res
+    qh, kh, vh, out, lse, segments = res
     dq, dk, dv = flash_attention_bwd_hmajor(
-        qh, kh, vh, out, lse, g.transpose(0, 2, 1, 3),
+        qh, kh, vh, out, lse, g.transpose(0, 2, 1, 3), segments,
         causal=causal, interpret=interpret,
         block_q=block_q, block_k=block_k)
     return (dq.transpose(0, 2, 1, 3), dk.transpose(0, 2, 1, 3),
-            dv.transpose(0, 2, 1, 3))
+            dv.transpose(0, 2, 1, 3), None)  # int segments: no cotangent
 
 
 _flash_with_vjp.defvjp(_flash_fwd, _flash_bwd)
 
 
 def flash_sdpa(q, k, v, *, causal: bool = True, interpret: bool = False,
-               block_q: int | None = None, block_k: int | None = None):
+               block_q: int | None = None, block_k: int | None = None,
+               segment_ids=None):
     """Drop-in sdpa_fn for modules.apply_attention: [B, S, N, D] layout in
     and out; fully differentiable — forward and backward both run as fused
     Pallas kernels (backward recomputes p per tile from the saved
     logsumexp), so neither direction materializes [S, S].
 
+    ``segment_ids`` [B, S] masks cross-document attention for packed
+    samples (reference reset_attention_mask) inside the kernel — packed
+    pretraining keeps flash speed instead of falling back to the dense core.
+
     Block defaults are clamped to divisors of S (e.g. S=768 runs 256-wide
     k blocks even though the tuned default is 512)."""
     S = q.shape[1]
-    return _flash_with_vjp(q, k, v, causal, interpret,
+    return _flash_with_vjp(q, k, v, segment_ids, causal, interpret,
                            block_q or fit_block(DEFAULT_BLOCK_Q, S) or S,
                            block_k or fit_block(DEFAULT_BLOCK_K, S) or S)
 
