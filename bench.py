@@ -5,15 +5,29 @@ train step (fwd + bwd + Adam), bf16 compute. Prints ONE JSON line:
   {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
 vs_baseline is measured MFU / the BASELINE.json north-star 40% MFU target.
 
-TPU access rides a fragile tunnel (a killed init can wedge it for hours), so
-the device is probed in a THROWAWAY SUBPROCESS first: if init + one matmul
-don't complete within BENCH_PROBE_TIMEOUT the child is abandoned (never
-killed mid-init) and the bench falls back to a CPU smoke run with an explicit
-"tpu_unavailable" error field — rc stays 0 and the JSON line always appears.
+Round-4 redesign (the driver bench must ALWAYS land a parseable result):
+  * The PARENT process never imports jax and never opens the device. It
+    orchestrates throwaway children (``bench.py --leg '<json>'``), each of
+    which measures ONE (attention impl, bsz, fused-ce) config and appends
+    progress + result lines to a journal file as numbers arrive. Partial
+    results survive a wedged tunnel.
+  * A child that stops making journal progress is ABANDONED, never killed:
+    SIGTERM-ing a process inside the tunnel's make_c_api_client wedges the
+    remote side for hours (tools/tpu_probe.py docstring; round-3 incident
+    log in PERF.md). Abandoned children self-terminate server-side.
+  * The CPU fallback leg never touches the TPU plugin: JAX_PLATFORMS=cpu in
+    the child env before any jax import, plus
+    jax.config.update("jax_platforms", "cpu") immediately after import to
+    undo the axon sitecustomize rewrite (same recipe as tests/conftest.py).
+  * The parent exits rc=0 with one JSON line in every failure mode; its
+    last-resort watchdog runs in a process that holds no device, so firing
+    it cannot wedge anything.
 
-Env knobs: BENCH_PLATFORM=cpu forces the virtual-CPU path (smoke testing);
+Env knobs: BENCH_PLATFORM=cpu forces the CPU path (smoke testing);
 BENCH_BSZ / BENCH_SEQ / BENCH_ITERS override shapes; BENCH_SWEEP=0 disables
-the batch-size sweep; BENCH_AB=0 skips the flash-vs-XLA A/B leg.
+the batch-size sweep; BENCH_AB=0 skips the flash-vs-XLA A/B leg; BENCH_CE=0
+skips the fused-CE leg; BENCH_TIMEOUT caps total wall clock (default 900s);
+BENCH_JOURNAL pins the journal path (default: a fresh temp file).
 """
 
 import json
@@ -23,7 +37,8 @@ import sys
 import tempfile
 import time
 
-import numpy as np
+METRIC = "gpt2_125m_train_mfu"
+NORTH_STAR_MFU = 40.0  # BASELINE.json
 
 # chip -> peak bf16 FLOP/s (public TPU specs)
 PEAK_FLOPS = {
@@ -38,41 +53,267 @@ PEAK_FLOPS = {
 }
 
 
-def _arm_watchdog(seconds: float) -> None:
-    """Belt over the probe's braces: if anything after a successful probe
-    still wedges (compile hang), emit one JSON line and exit instead of
-    hanging the driver."""
-    import threading
+# ---------------------------------------------------------------------------
+# child: one measurement leg
+# ---------------------------------------------------------------------------
 
-    def fire():
-        print(json.dumps({
-            "metric": "gpt2_125m_train_mfu", "value": 0.0, "unit": "% MFU",
-            "vs_baseline": 0.0,
-            "error": f"bench watchdog fired after {seconds:.0f}s "
-                     "(device init or compile hang)",
-        }), flush=True)
-        os._exit(3)
-
-    t = threading.Timer(seconds, fire)
-    t.daemon = True
-    t.start()
-    global _WATCHDOG
-    _WATCHDOG = t
+def _journal_append(path: str, line: dict) -> None:
+    line = dict(line, t=round(time.time(), 2))
+    with open(path, "a") as f:
+        f.write(json.dumps(line) + "\n")
+        f.flush()
+        os.fsync(f.fileno())
 
 
-_WATCHDOG = None
+def run_leg(spec: dict, journal: str) -> int:
+    """Measure one config and journal the result. Runs in a throwaway
+    subprocess; exceptions become an 'error' journal line, never a traceback
+    the parent has to parse. Exit code is irrelevant to the parent (it reads
+    the journal), but 0 keeps logs clean."""
+    leg_id = spec["id"]
+
+    def emit(status, **kw):
+        _journal_append(journal, {"id": leg_id, "status": status, **kw})
+
+    try:
+        emit("start")
+        if spec["platform"] == "cpu":
+            # tunnel-safe: pin the platform BEFORE jax loads any backend...
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            os.environ.setdefault(
+                "XLA_FLAGS", "--xla_force_host_platform_device_count=1")
+
+        import jax
+
+        if spec["platform"] == "cpu":
+            # ...and again AFTER import: the axon sitecustomize rewrites
+            # jax_platforms to "axon,cpu" at import time (tests/conftest.py)
+            jax.config.update("jax_platforms", "cpu")
+
+        import jax.numpy as jnp
+        import numpy as np
+
+        from hetu_galvatron_tpu.core.args_schema import ModelArgs, TrainArgs
+        from hetu_galvatron_tpu.models.builder import (
+            init_causal_lm,
+            model_flops_per_token,
+            param_count,
+        )
+        from hetu_galvatron_tpu.runtime.dataloader import make_batch
+        from hetu_galvatron_tpu.runtime.optimizer import make_optimizer
+        from hetu_galvatron_tpu.runtime.trainer import (
+            make_loss_fn,
+            make_train_step,
+        )
+
+        dev = jax.devices()[0]
+        kind = dev.device_kind
+        emit("device", platform=dev.platform, device_kind=kind)
+
+        peak = next(
+            (v for k, v in PEAK_FLOPS.items() if kind.startswith(k)), None)
+        if dev.platform == "cpu":
+            peak = PEAK_FLOPS["cpu"]
+        peak_assumed = peak is None
+        if peak_assumed:
+            peak = 197e12
+
+        if os.environ.get("BENCH_FAKE_WEDGE"):  # test hook: simulate a hang
+            time.sleep(float(os.environ.get("BENCH_FAKE_WEDGE_SECS", 120)))
+            return 0
+
+        seq, bsz, iters = spec["seq"], spec["bsz"], spec["iters"]
+        cfg = ModelArgs(model_name="gpt2-small", seq_length=seq,
+                        max_position_embeddings=max(seq, 1024))
+        if os.environ.get("BENCH_TINY"):  # smoke-test shapes
+            cfg = cfg.model_copy(update={
+                "hidden_size": 128, "num_hidden_layers": 2,
+                "num_attention_heads": 4, "vocab_size": 1024})
+        if spec["fused_ce"]:
+            cfg = cfg.model_copy(update={"use_fused_ce": True})
+        flops_tok = model_flops_per_token(cfg, seq)
+        tx = make_optimizer(TrainArgs(lr=1e-4, lr_decay_style="constant"))
+
+        overrides = None
+        if spec["flash"]:
+            from hetu_galvatron_tpu.ops.pallas.flash_attention import (
+                flash_sdpa,
+            )
+
+            overrides = {i: {"sdpa_fn": flash_sdpa}
+                         for i in range(cfg.num_hidden_layers)}
+        loss_fn = make_loss_fn(cfg, compute_dtype=jnp.bfloat16,
+                               layer_overrides=overrides)
+        step = jax.jit(make_train_step(loss_fn, tx), donate_argnums=(0, 1))
+
+        params, _ = init_causal_lm(jax.random.key(0), cfg)
+        params = jax.device_put(params, dev)
+        opt = jax.jit(tx.init)(params)
+        data = np.random.RandomState(0).randint(
+            0, cfg.padded_vocab_size, (bsz, seq + 1))
+        batch = jax.device_put(
+            jax.tree.map(jnp.asarray, make_batch(data)), dev)
+
+        def timed_run():
+            nonlocal params, opt
+            t0 = time.perf_counter()
+            metrics = None
+            for _ in range(iters):
+                params, opt, metrics = step(params, opt, batch)
+            # sync on a host transfer of the last step's loss, NOT just
+            # block_until_ready: through the axon tunnel block_until_ready
+            # has been observed returning before the queued steps actually
+            # ran, yielding physically impossible throughputs
+            loss = float(metrics["loss"])
+            dt = time.perf_counter() - t0
+            return bsz * seq * iters / dt, loss
+
+        params, opt, metrics = step(params, opt, batch)  # compile
+        float(metrics["loss"])
+        emit("compiled")
+        for _ in range(2):  # warmup
+            params, opt, metrics = step(params, opt, batch)
+        float(metrics["loss"])
+        emit("warm")
+
+        # plausibility bound: >100% MFU means the tunnel's async dispatch
+        # lied about timing, not that the chip is fast. When the peak itself
+        # is a guess, a genuinely faster chip must not be rejected (10x).
+        bound = peak * (10.0 if peak_assumed else 1.0)
+        tps, loss = timed_run()
+        if tps * flops_tok > bound:
+            emit("remeasure", tokens_per_sec=round(tps, 1))
+            tps, loss = timed_run()
+            if tps * flops_tok > bound:
+                emit("error", error=(f"repeated implausible timing "
+                                     f"({tps:,.0f} tok/s)"),
+                     implausible=True)
+                return 0
+
+        params_n = param_count(jax.eval_shape(
+            lambda k: init_causal_lm(k, cfg)[0], jax.random.key(0)))
+        emit("ok",
+             tokens_per_sec=round(tps, 1),
+             loss=round(loss, 4),
+             mfu=round(tps * flops_tok / peak * 100.0, 2),
+             flops_per_token=flops_tok,
+             peak_flops=peak,
+             peak_assumed=peak_assumed,
+             params=params_n,
+             platform=dev.platform,
+             device_kind=kind)
+        return 0
+    except Exception as e:  # noqa: BLE001 — journal every failure
+        msg = f"{type(e).__name__}: {e}"
+        low = msg.lower()
+        oom = ("resource_exhausted" in low or "out of memory" in low
+               or ("allocation" in low and "hbm" in low))
+        try:
+            emit("error", error=msg[:2000], oom=oom)
+        except OSError:
+            pass
+        return 0
+
+
+# ---------------------------------------------------------------------------
+# parent: orchestration (NEVER imports jax)
+# ---------------------------------------------------------------------------
+
+class Orchestrator:
+    """Runs legs as children, reads their journal lines, abandons (never
+    kills) children that stop making progress."""
+
+    def __init__(self, journal: str, deadline: float,
+                 progress_timeout: float = 180.0):
+        self.journal = journal
+        self.deadline = deadline
+        self.progress_timeout = float(
+            os.environ.get("BENCH_PROGRESS_TIMEOUT", progress_timeout))
+        self._next_id = 0
+        self._offset = 0
+        self._lines: list[dict] = []
+        self.wedged = False
+        self.abandoned: list[int] = []
+
+    def _poll_journal(self) -> None:
+        try:
+            with open(self.journal) as f:
+                f.seek(self._offset)
+                chunk = f.read()
+                self._offset = f.tell()
+        except FileNotFoundError:
+            return
+        for raw in chunk.splitlines():
+            raw = raw.strip()
+            if not raw:
+                continue
+            try:
+                self._lines.append(json.loads(raw))
+            except json.JSONDecodeError:
+                pass  # torn write from an abandoned child; ignore
+
+    def lines_for(self, leg_id: int) -> list[dict]:
+        return [ln for ln in self._lines if ln.get("id") == leg_id]
+
+    def run(self, spec: dict, leg_budget: float,
+            hard_deadline: float | None = None) -> dict:
+        """Run one leg to completion / error / abandonment. Returns the
+        final journal line for the leg, or a synthesized one on wedge.
+        ``hard_deadline`` overrides the orchestrator deadline (the CPU
+        fallback leg runs in the time reserved past it)."""
+        spec = dict(spec, id=self._next_id)
+        self._next_id += 1
+        argv = [sys.executable, os.path.abspath(__file__),
+                "--leg", json.dumps(spec), self.journal]
+        log = os.path.splitext(self.journal)[0] + f".leg{spec['id']}.log"
+        with open(log, "w") as lf:
+            child = subprocess.Popen(argv, stdout=lf, stderr=lf,
+                                     cwd=os.path.dirname(
+                                         os.path.abspath(__file__)))
+        leg_deadline = min(time.time() + leg_budget,
+                           hard_deadline or self.deadline)
+        last_progress = time.time()
+        n_seen = 0
+        while True:
+            self._poll_journal()
+            mine = self.lines_for(spec["id"])
+            if len(mine) > n_seen:
+                n_seen = len(mine)
+                last_progress = time.time()
+            if mine and mine[-1]["status"] in ("ok", "error"):
+                return mine[-1]
+            if child.poll() is not None:
+                # exited without a terminal line: re-read once then give up
+                self._poll_journal()
+                mine = self.lines_for(spec["id"])
+                if mine and mine[-1]["status"] in ("ok", "error"):
+                    return mine[-1]
+                return {"id": spec["id"], "status": "error",
+                        "error": f"leg exited rc={child.returncode} "
+                                 "without a result"}
+            now = time.time()
+            if (now - last_progress > self.progress_timeout
+                    or now > leg_deadline):
+                # ABANDON: never SIGTERM a process that may hold the device
+                stage = mine[-1]["status"] if mine else "spawn"
+                self.abandoned.append(child.pid)
+                if spec["platform"] == "tpu":
+                    self.wedged = True
+                print(f"warning: leg {spec['id']} ({spec['platform']} "
+                      f"flash={spec['flash']} bsz={spec['bsz']}) abandoned "
+                      f"after no progress past stage {stage!r} "
+                      f"(pid {child.pid} left running)", file=sys.stderr)
+                return {"id": spec["id"], "status": "wedged", "stage": stage}
+            time.sleep(1.0)
 
 
 def probe_tpu() -> dict:
-    """Probe TPU init in a subprocess; never block the bench on a wedged
-    tunnel. Returns {"alive": bool, "reason": str, ...probe fields}.
-
-    The child is NOT killed on timeout — killing a process inside the
-    tunnel's make_c_api_client wedges the remote side for hours; an
-    abandoned blocked child costs one idle process instead."""
+    """Probe TPU init in a throwaway subprocess; never block the bench on a
+    wedged tunnel. The child is NOT killed on timeout — abandoned."""
     probe = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          "tools", "tpu_probe.py")
     timeouts = [float(os.environ.get("BENCH_PROBE_TIMEOUT", 150)), 45.0]
+    reason = "probe not run"
     for attempt, limit in enumerate(timeouts):
         out_path = os.path.join(
             tempfile.mkdtemp(prefix="tpu_probe_"), "probe.json")
@@ -99,8 +340,59 @@ def probe_tpu() -> dict:
     return {"alive": False, "reason": reason}
 
 
-def main():
-    _arm_watchdog(float(os.environ.get("BENCH_TIMEOUT", 900)))
+def _zero_result(error: str) -> dict:
+    return {"metric": METRIC, "value": 0.0, "unit": "% MFU",
+            "vs_baseline": 0.0, "error": error}
+
+
+_WATCHDOG = None
+_RESULT_EMITTED = False
+
+
+def _emit_result(out: dict) -> None:
+    global _RESULT_EMITTED
+    if _RESULT_EMITTED:
+        return
+    _RESULT_EMITTED = True
+    if _WATCHDOG is not None:
+        _WATCHDOG.cancel()
+    print(json.dumps(out), flush=True)
+
+
+def _arm_watchdog(seconds: float, state: dict) -> None:
+    """Last resort: the parent holds no device, so exiting here is safe.
+    Emits best-so-far (or zero) and exits rc=0 — the result always lands."""
+    import threading
+
+    def fire():
+        out = state.get("best_out") or _zero_result(
+            f"bench watchdog fired after {seconds:.0f}s; "
+            f"last stage: {state.get('stage', 'unknown')}")
+        out.setdefault("watchdog_fired", True)
+        _emit_result(out)
+        os._exit(0)
+
+    t = threading.Timer(seconds, fire)
+    t.daemon = True
+    t.start()
+    global _WATCHDOG
+    _WATCHDOG = t
+
+
+def main() -> int:
+    total = float(os.environ.get("BENCH_TIMEOUT", 900))
+    t_start = time.time()
+    state = {"stage": "probe"}
+    _arm_watchdog(total - 5.0, state)
+
+    journal = os.environ.get("BENCH_JOURNAL") or os.path.join(
+        tempfile.mkdtemp(prefix="bench_"), "journal.jsonl")
+    os.makedirs(os.path.dirname(os.path.abspath(journal)), exist_ok=True)
+    print(f"bench: journal at {journal}", file=sys.stderr)
+    # reserve time at the tail for a CPU fallback leg (~5 min on this host)
+    # + assembly; lifted once a TPU result lands and no fallback is needed
+    fallback_reserve = 340.0
+    orch = Orchestrator(journal, deadline=t_start + total - fallback_reserve)
 
     tpu_error = None
     if os.environ.get("BENCH_PLATFORM") == "cpu":
@@ -120,233 +412,172 @@ def main():
             platform = "cpu"
             tpu_error = f"tpu_unavailable: {info.get('reason', 'unknown')}"
 
-    import jax
+    on_tpu = platform == "tpu"
+    seq = int(os.environ.get("BENCH_SEQ", 1024 if on_tpu else 512))
+    iters = int(os.environ.get("BENCH_ITERS", 20 if on_tpu else 2))
+    base = {"platform": platform, "seq": seq, "iters": iters,
+            "flash": False, "fused_ce": False}
 
-    if platform == "cpu":
-        # pin AFTER import: the tunnel plugin's sitecustomize rewrites
-        # jax_platforms at import time, overriding the env var
-        jax.config.update("jax_platforms", "cpu")
-    import jax.numpy as jnp
-
-    from hetu_galvatron_tpu.core.args_schema import ModelArgs, TrainArgs
-    from hetu_galvatron_tpu.models.builder import (
-        init_causal_lm,
-        model_flops_per_token,
-        param_count,
-    )
-    from hetu_galvatron_tpu.runtime.dataloader import make_batch
-    from hetu_galvatron_tpu.runtime.optimizer import make_optimizer
-    from hetu_galvatron_tpu.runtime.trainer import make_loss_fn, make_train_step
-
-    dev = jax.devices()[0]
-    kind = dev.device_kind
-    peak = next((v for k, v in PEAK_FLOPS.items() if kind.startswith(k)), None)
-    if dev.platform == "cpu":
-        peak = PEAK_FLOPS["cpu"]
-    peak_assumed = peak is None
-    if peak_assumed:
-        print(f"warning: unknown device kind {kind!r}; assuming v5e peak "
-              "(197 TFLOP/s) — MFU may be wrong", file=sys.stderr)
-        peak = 197e12
-
-    on_tpu = dev.platform != "cpu"
-    seq = int(os.environ.get("BENCH_SEQ", 1024))
-    iters = int(os.environ.get("BENCH_ITERS", 20 if on_tpu else 5))
-    cfg = ModelArgs(model_name="gpt2-small", seq_length=seq,
-                    max_position_embeddings=max(seq, 1024))
-    flops_tok = model_flops_per_token(cfg, seq)
-    tx = make_optimizer(TrainArgs(lr=1e-4, lr_decay_style="constant"))
-
-    def build_step(use_flash: bool, cfg_local=None):
-        cfg_local = cfg_local or cfg
-        overrides = None
-        if use_flash:
-            from hetu_galvatron_tpu.ops.pallas.flash_attention import flash_sdpa
-
-            overrides = {i: {"sdpa_fn": flash_sdpa}
-                         for i in range(cfg_local.num_hidden_layers)}
-        loss_fn = make_loss_fn(cfg_local, compute_dtype=jnp.bfloat16,
-                               layer_overrides=overrides)
-        return jax.jit(make_train_step(loss_fn, tx), donate_argnums=(0, 1))
-
-    def measure(use_flash: bool, bsz: int, cfg_local=None):
-        """Compile + warm + time one (attention impl, bsz) config.
-        Returns tokens/sec, or raises (OOM / Mosaic failure)."""
-        cfg_local = cfg_local or cfg
-        step = build_step(use_flash, cfg_local)
-        params, _ = init_causal_lm(jax.random.key(0), cfg_local)
-        params = jax.device_put(params, dev)
-        opt = jax.jit(tx.init)(params)
-        data = np.random.RandomState(0).randint(
-            0, cfg_local.padded_vocab_size, (bsz, seq + 1))
-        batch = jax.device_put(
-            jax.tree.map(jnp.asarray, make_batch(data)), dev)
-        for _ in range(3):  # warmup + compile
-            params, opt, metrics = step(params, opt, batch)
-        float(metrics["loss"])  # host round-trip: full pipeline drained
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            params, opt, metrics = step(params, opt, batch)
-        # sync on a host transfer of the last step's loss, NOT just
-        # block_until_ready: through the axon tunnel block_until_ready has
-        # been observed returning before the queued steps actually ran,
-        # yielding physically impossible throughputs
-        loss = float(metrics["loss"])
-        dt = time.perf_counter() - t0
-        return bsz * seq * iters / dt, loss
-
-    # plausibility bound for EVERY measurement (primary, fallback retry, and
-    # A/B leg): >100% MFU means the tunnel's async dispatch lied about
-    # timing, not that the chip is fast. When the peak itself is a guess
-    # (unknown device kind) a genuinely faster chip must not be rejected, so
-    # the bound is loosened to 10x the guessed peak.
-    bound = peak * (10.0 if peak_assumed else 1.0)
-
-    def measure_checked(use_flash: bool, bsz: int, cfg_local=None):
-        tps, loss = measure(use_flash, bsz, cfg_local)
-        if tps * flops_tok > bound:
-            print(f"warning: bsz {bsz} measured {tps:,.0f} tok/s "
-                  "(implausible; async-timing glitch); remeasuring",
-                  file=sys.stderr)
-            tps, loss = measure(use_flash, bsz, cfg_local)
-            if tps * flops_tok > bound:
-                raise RuntimeError(
-                    f"bsz {bsz}: repeated implausible timing "
-                    f"({tps:,.0f} tok/s)")
-        return tps, loss
-
-    # batch-size candidates: sweep on TPU (HBM allows far more than the old
-    # fixed 8 for a 125M model), single size on CPU smoke
     if os.environ.get("BENCH_BSZ"):
         bszs = [int(os.environ["BENCH_BSZ"])]
     elif on_tpu and os.environ.get("BENCH_SWEEP", "1") != "0":
         bszs = [64, 32, 16, 8]
     else:
-        bszs = [8]
+        bszs = [2]
 
-    want_flash = (on_tpu and cfg.use_flash_attn
+    want_flash = (on_tpu
                   and os.environ.get("BENCH_FLASH", "1") != "0")
-    used_flash = want_flash
+    leg_budget = 300.0 if on_tpu else 600.0
+
+    state["stage"] = "sweep"
     flash_error = None
-    best = None  # (tokens_per_sec, bsz, loss, flash_used_for_this_run)
+    best = None  # journal 'ok' line of the winning run, + bsz/flash tags
+    used_flash = want_flash
     for bsz in bszs:
-        try:
-            tps, loss = measure_checked(used_flash, bsz)
-        except Exception as e:
-            msg = str(e).lower()
-            oom = ("resource_exhausted" in msg or "out of memory" in msg
-                   or "allocation" in msg and "hbm" in msg)
-            if oom:
+        if orch.wedged:
+            break
+        res = orch.run(dict(base, flash=used_flash, bsz=bsz), leg_budget)
+        if res["status"] == "error":
+            if res.get("oom"):
                 print(f"warning: bsz {bsz} OOM; trying smaller",
                       file=sys.stderr)
                 continue
-            if "implausible timing" in msg:
-                print(f"warning: bsz {bsz} skipped: {e}", file=sys.stderr)
+            if res.get("implausible"):
+                print(f"warning: bsz {bsz} skipped: {res['error']}",
+                      file=sys.stderr)
                 continue
             if used_flash:
                 # Mosaic/pallas failure: fall back to the XLA core once,
                 # retrying the same bsz
-                flash_error = f"{type(e).__name__}: {e}"
+                flash_error = res["error"]
                 print(f"warning: flash attention failed ({flash_error}); "
                       "falling back to XLA attention", file=sys.stderr)
                 used_flash = False
-                try:
-                    tps, loss = measure_checked(False, bsz)
-                except Exception as e2:
-                    print(f"warning: bsz {bsz} failed: {e2}", file=sys.stderr)
+                res = orch.run(dict(base, flash=False, bsz=bsz), leg_budget)
+                if res["status"] != "ok":
                     continue
             else:
-                print(f"warning: bsz {bsz} failed ({type(e).__name__}); "
-                      "trying smaller", file=sys.stderr)
+                print(f"warning: bsz {bsz} failed: {res.get('error')}",
+                      file=sys.stderr)
                 continue
-        mfu = tps * flops_tok / peak * 100.0
+        if res["status"] != "ok":
+            break  # wedged
+        res = dict(res, bsz=bsz, flash=used_flash, seq=seq)
         print(f"bench: bsz {bsz} flash={used_flash} "
-              f"{tps:,.0f} tok/s ({mfu:.1f}% MFU)", file=sys.stderr)
-        if best is None or tps > best[0]:
-            best = (tps, bsz, loss, used_flash)
-        if best[1] != bsz:
+              f"{res['tokens_per_sec']:,.0f} tok/s ({res['mfu']:.1f}% MFU)",
+              file=sys.stderr)
+        if best is None or res["tokens_per_sec"] > best["tokens_per_sec"]:
+            best = res
+            state["best_out"] = _assemble(best, tpu_error, flash_error,
+                                          on_tpu, partial=True)
+            # a result landed: the CPU fallback is moot, spend its reserve
+            orch.deadline = t_start + total - 30.0
+        if best["bsz"] != bsz:
             break  # throughput stopped improving as bsz shrinks
 
+    if orch.wedged:
+        tpu_error = tpu_error or (
+            "tpu_wedged: a measurement leg stopped making progress and was "
+            "abandoned (tunnel wedge); partial results only")
+
+    if best is None and on_tpu:
+        # nothing landed on TPU: tunnel-safe CPU smoke so value > 0
+        state["stage"] = "cpu-fallback"
+        tpu_error = tpu_error or "tpu_unavailable: no TPU leg completed"
+        res = orch.run({"platform": "cpu", "seq": 256, "iters": 2,
+                        "flash": False, "fused_ce": False, "bsz": 2}, 600.0,
+                       hard_deadline=t_start + total - 30.0)
+        if res["status"] == "ok":
+            best = dict(res, bsz=2, flash=False, seq=256)
+            on_tpu = False
+
     if best is None:
-        print(json.dumps({
-            "metric": "gpt2_125m_train_mfu", "value": 0.0, "unit": "% MFU",
-            "vs_baseline": 0.0,
-            "error": tpu_error or "no batch size ran to completion",
-        }), flush=True)
+        _emit_result(_zero_result(
+            tpu_error or "no batch size ran to completion"))
         return 0
 
-    # attribute the result to the impl that produced the WINNING run, not
-    # the loop's final state (a mid-sweep flash fallback must not relabel
-    # an earlier flash-measured winner)
-    tokens_per_sec, bsz, loss, best_flash = best
-
-    # A/B the attention impls at the winning bsz FIRST, both legs with the
-    # plain CE, so flash_speedup isolates the attention kernel (the fused-CE
-    # leg below may later replace the headline throughput)
+    # A/B the attention impls at the winning bsz, both legs with the plain
+    # CE, so flash_speedup isolates the attention kernel
     ab = None
-    if best_flash and os.environ.get("BENCH_AB", "1") != "0":
-        try:
-            xla_tps, _ = measure_checked(False, bsz)
-            ab = {"xla_tokens_per_sec": round(xla_tps, 1),
-                  "flash_speedup": round(tokens_per_sec / xla_tps, 3)}
-            print(f"bench A/B: flash {tokens_per_sec:,.0f} vs XLA "
-                  f"{xla_tps:,.0f} tok/s ({ab['flash_speedup']}x)",
+    if (best["flash"] and not orch.wedged
+            and os.environ.get("BENCH_AB", "1") != "0"):
+        state["stage"] = "ab"
+        res = orch.run(dict(base, flash=False, bsz=best["bsz"]), leg_budget)
+        if res["status"] == "ok":
+            ab = {"xla_tokens_per_sec": res["tokens_per_sec"],
+                  "flash_speedup": round(
+                      best["tokens_per_sec"] / res["tokens_per_sec"], 3)}
+            print(f"bench A/B: flash {best['tokens_per_sec']:,.0f} vs XLA "
+                  f"{res['tokens_per_sec']:,.0f} tok/s "
+                  f"({ab['flash_speedup']}x)", file=sys.stderr)
+        else:
+            print(f"warning: XLA A/B leg failed: {res.get('error')}",
                   file=sys.stderr)
-        except Exception as e:
-            print(f"warning: XLA A/B leg failed: {e}", file=sys.stderr)
 
     # fused Pallas cross-entropy leg at the winning config: adopt it for the
     # headline if it wins (it is a first-class config of the framework)
-    fused_ce = False
     ce_ab = None
-    if on_tpu and os.environ.get("BENCH_CE", "1") != "0":
-        try:
-            cfg_ce = cfg.model_copy(update={"use_fused_ce": True})
-            ce_tps, ce_loss = measure_checked(best_flash, bsz, cfg_ce)
-            ce_ab = {"fused_ce_tokens_per_sec": round(ce_tps, 1),
-                     "fused_ce_speedup": round(ce_tps / tokens_per_sec, 3)}
-            print(f"bench CE A/B: fused {ce_tps:,.0f} vs plain "
-                  f"{tokens_per_sec:,.0f} tok/s "
+    fused_ce = False
+    if (on_tpu and not orch.wedged
+            and os.environ.get("BENCH_CE", "1") != "0"):
+        state["stage"] = "fused-ce"
+        res = orch.run(dict(base, flash=best["flash"], bsz=best["bsz"],
+                            fused_ce=True), leg_budget)
+        if res["status"] == "ok":
+            ce_ab = {"fused_ce_tokens_per_sec": res["tokens_per_sec"],
+                     "fused_ce_speedup": round(
+                         res["tokens_per_sec"] / best["tokens_per_sec"], 3)}
+            print(f"bench CE A/B: fused {res['tokens_per_sec']:,.0f} vs "
+                  f"plain {best['tokens_per_sec']:,.0f} tok/s "
                   f"({ce_ab['fused_ce_speedup']}x)", file=sys.stderr)
-            if ce_tps > tokens_per_sec:
-                tokens_per_sec, loss, fused_ce = ce_tps, ce_loss, True
-        except Exception as e:
-            print(f"warning: fused-CE leg failed: {e}", file=sys.stderr)
+            if res["tokens_per_sec"] > best["tokens_per_sec"]:
+                best = dict(res, bsz=best["bsz"], flash=best["flash"],
+                            seq=seq)
+                fused_ce = True
+        else:
+            print(f"warning: fused-CE leg failed: {res.get('error')}",
+                  file=sys.stderr)
 
-    mfu = tokens_per_sec * flops_tok / peak * 100.0
+    out = _assemble(best, tpu_error, flash_error, on_tpu)
+    out["fused_ce"] = fused_ce
+    if ab:
+        out.update(ab)
+    if ce_ab:
+        out.update(ce_ab)
+    if orch.abandoned:
+        out["abandoned_children"] = orch.abandoned
+    _emit_result(out)
+    return 0
 
-    # count from abstract shapes — no need to re-materialize 125M weights
-    params_n = param_count(jax.eval_shape(
-        lambda k: init_causal_lm(k, cfg)[0], jax.random.key(0)))
+
+def _assemble(best: dict, tpu_error, flash_error, on_tpu: bool,
+              partial: bool = False) -> dict:
+    mfu = best["mfu"]
     out = {
-        "metric": "gpt2_125m_train_mfu",
-        "value": round(mfu, 2),
+        "metric": METRIC,
+        "value": mfu,
         "unit": "% MFU",
-        "vs_baseline": round(mfu / 40.0, 4) if on_tpu else 0.0,
-        "tokens_per_sec": round(tokens_per_sec, 1),
-        "params": params_n,
-        "device": kind,
-        "peak_flops": peak,
-        "peak_assumed": peak_assumed,
-        "flash_attention": best_flash,
-        "fused_ce": fused_ce,
-        "bsz": bsz,
-        "seq": seq,
-        "loss": round(loss, 4),
+        "vs_baseline": round(mfu / NORTH_STAR_MFU, 4) if on_tpu else 0.0,
+        "tokens_per_sec": best["tokens_per_sec"],
+        "params": best["params"],
+        "device": best["device_kind"],
+        "peak_flops": best["peak_flops"],
+        "peak_assumed": best["peak_assumed"],
+        "flash_attention": best["flash"],
+        "bsz": best["bsz"],
+        "seq": best["seq"],
+        "loss": best["loss"],
     }
     if tpu_error:
         out["error"] = tpu_error
     if flash_error:
         out["flash_error"] = flash_error
-    if ab:
-        out.update(ab)
-    if ce_ab:
-        out.update(ce_ab)
-    if _WATCHDOG is not None:
-        _WATCHDOG.cancel()
-    print(json.dumps(out))
-    return 0
+    if partial:
+        out["partial"] = True
+    return out
 
 
 if __name__ == "__main__":
+    if len(sys.argv) >= 3 and sys.argv[1] == "--leg":
+        sys.exit(run_leg(json.loads(sys.argv[2]), sys.argv[3]))
     sys.exit(main())

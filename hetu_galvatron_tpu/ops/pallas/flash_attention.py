@@ -460,19 +460,25 @@ def flash_sdpa(q, k, v, *, causal: bool = True, interpret: bool = False,
                            block_k or fit_block(DEFAULT_BLOCK_K, S) or S)
 
 
+# the fwd + both bwd kernels mask cross-document tiles in-kernel
+flash_sdpa.supports_segments = True
+
+
 def make_flash_sdpa(mesh, dp_axes=(), tp_axes=(), *, interpret: bool = False):
     """Distributed flash attention: the kernel is a custom call XLA cannot
     auto-partition, so it runs under shard_map — batch sharded over dp,
     heads over tp, sequence local (attention needs the full sequence; cp
     layers use ring attention instead). Grad flows through the fused VJP
-    inside the shard_map."""
+    inside the shard_map. ``segment_ids`` [B, S] ride as an extra batch-
+    sharded operand so packed documents keep flash speed under SPMD."""
     from jax.sharding import PartitionSpec as P
 
     import jax
 
     spec = P(dp_axes or None, None, tp_axes or None, None)
+    seg_spec = P(dp_axes or None, None)
 
-    def sdpa(q, k, v, *, causal=True):
+    def sdpa(q, k, v, *, causal=True, segment_ids=None):
         S = q.shape[1]
         bq = fit_block(DEFAULT_BLOCK_Q, S)
         bk = fit_block(DEFAULT_BLOCK_K, S)
@@ -481,13 +487,21 @@ def make_flash_sdpa(mesh, dp_axes=(), tp_axes=(), *, interpret: bool = False):
         if not bq or not bk or k.shape[1] != S:
             from hetu_galvatron_tpu.models.modules import xla_sdpa
 
-            return xla_sdpa(q, k, v, causal=causal)
+            return xla_sdpa(q, k, v, causal=causal, segment_ids=segment_ids)
         # nondiff args of a custom_vjp must stay positional
+        if segment_ids is None:
+            fn = jax.shard_map(
+                lambda a, b, c: _flash_with_vjp(a, b, c, None, causal,
+                                                interpret, bq, bk),
+                mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+                check_vma=False)
+            return fn(q, k, v)
         fn = jax.shard_map(
-            lambda a, b, c: _flash_with_vjp(a, b, c, causal, interpret,
-                                            bq, bk),
-            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            lambda a, b, c, s: _flash_with_vjp(a, b, c, s, causal,
+                                               interpret, bq, bk),
+            mesh=mesh, in_specs=(spec, spec, spec, seg_spec), out_specs=spec,
             check_vma=False)
-        return fn(q, k, v)
+        return fn(q, k, v, segment_ids)
 
+    sdpa.supports_segments = True
     return sdpa

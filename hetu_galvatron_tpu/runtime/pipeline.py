@@ -393,10 +393,10 @@ class PipelineEngine:
             enc_tok, dec_tok = carry
             a = M.apply_embedding(sp["embed"], enc_tok, cfg,
                                   compute_dtype=self.compute_dtype,
-                                  dropout_rng=layer_rng(M.DROPOUT_STREAM_EMBED))
+                                  dropout_rng=layer_rng(M.DROPOUT_STREAM_EMBED_ENC))
             b = M.apply_embedding(sp["embed"], dec_tok, cfg,
                                   compute_dtype=self.compute_dtype,
-                                  dropout_rng=layer_rng(M.DROPOUT_STREAM_EMBED_ENC))
+                                  dropout_rng=layer_rng(M.DROPOUT_STREAM_EMBED))
         else:
             a, b = carry
         rope_enc = rope_dec = None
