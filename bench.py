@@ -294,15 +294,30 @@ class Orchestrator:
             now = time.time()
             if (now - last_progress > self.progress_timeout
                     or now > leg_deadline):
-                # ABANDON: never SIGTERM a process that may hold the device
                 stage = mine[-1]["status"] if mine else "spawn"
                 self.abandoned.append(child.pid)
                 if spec["platform"] == "tpu":
+                    # ABANDON: never SIGTERM a process that may hold the
+                    # device — it wedges the remote side of the tunnel
                     self.wedged = True
+                    fate = f"pid {child.pid} left running"
+                elif os.environ.get("BENCH_NEVER_KILL", "0") != "0":
+                    fate = f"pid {child.pid} left running"
+                else:
+                    # a CPU child cannot hold the tunnel: safe to reap, with
+                    # SIGKILL escalation so a SIGTERM-ignoring child cannot
+                    # outlive the message claiming it was terminated
+                    child.terminate()
+                    try:
+                        child.wait(timeout=5)
+                    except subprocess.TimeoutExpired:
+                        child.kill()
+                        child.wait()
+                    fate = f"pid {child.pid} terminated"
                 print(f"warning: leg {spec['id']} ({spec['platform']} "
                       f"flash={spec['flash']} bsz={spec['bsz']}) abandoned "
                       f"after no progress past stage {stage!r} "
-                      f"(pid {child.pid} left running)", file=sys.stderr)
+                      f"({fate})", file=sys.stderr)
                 return {"id": spec["id"], "status": "wedged", "stage": stage}
             time.sleep(1.0)
 
@@ -389,10 +404,6 @@ def main() -> int:
         tempfile.mkdtemp(prefix="bench_"), "journal.jsonl")
     os.makedirs(os.path.dirname(os.path.abspath(journal)), exist_ok=True)
     print(f"bench: journal at {journal}", file=sys.stderr)
-    # reserve time at the tail for a CPU fallback leg (~5 min on this host)
-    # + assembly; lifted once a TPU result lands and no fallback is needed
-    fallback_reserve = 340.0
-    orch = Orchestrator(journal, deadline=t_start + total - fallback_reserve)
 
     tpu_error = None
     if os.environ.get("BENCH_PLATFORM") == "cpu":
@@ -413,6 +424,14 @@ def main() -> int:
             tpu_error = f"tpu_unavailable: {info.get('reason', 'unknown')}"
 
     on_tpu = platform == "tpu"
+    # Reserve tail time for the tunnel-safe CPU fallback leg (~5 min on this
+    # host) — only meaningful when TPU legs might wedge. The deadline must
+    # stay in the future even for small BENCH_TIMEOUT values: otherwise every
+    # leg is insta-abandoned at stage 'spawn' (round-4 advisor finding).
+    fallback_reserve = 340.0 if on_tpu else 0.0
+    deadline = t_start + max(total - fallback_reserve, total * 0.5)
+    orch = Orchestrator(journal, deadline=deadline)
+
     seq = int(os.environ.get("BENCH_SEQ", 1024 if on_tpu else 512))
     iters = int(os.environ.get("BENCH_ITERS", 20 if on_tpu else 2))
     base = {"platform": platform, "seq": seq, "iters": iters,

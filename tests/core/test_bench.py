@@ -61,26 +61,55 @@ def test_bench_cpu_smoke_lands_result(tmp_path):
     assert statuses[-1] == "ok"
 
 
-def test_bench_wedged_leg_abandoned_not_killed(tmp_path):
-    """A leg that hangs is abandoned: the parent emits a zero result with an
-    error annotation, rc stays 0, and the child is left running (never
-    signalled)."""
+def _abandoned_pids(proc):
+    return [int(p) for line in proc.stderr.splitlines()
+            for w in [line.split("pid ")]
+            if len(w) > 1
+            for p in [w[1].split()[0].rstrip(")")] if p.isdigit()]
+
+
+def test_bench_wedged_cpu_leg_terminated(tmp_path):
+    """A hung CPU leg is abandoned via the progress-stall path (it journals
+    'start' before hanging, so the stage is never 'spawn') and, since a CPU
+    child cannot hold the TPU tunnel, it is terminated rather than leaked."""
     proc = _run_bench(tmp_path, {
         "BENCH_FAKE_WEDGE": "1",
-        "BENCH_FAKE_WEDGE_SECS": "60",
-        "BENCH_PROGRESS_TIMEOUT": "5",
+        "BENCH_FAKE_WEDGE_SECS": "120",
+        "BENCH_PROGRESS_TIMEOUT": "15",
     })
     assert proc.returncode == 0, proc.stderr
     out = _parse_line(proc)
     assert out["value"] == 0.0
     assert "error" in out
     assert "abandoned" in proc.stderr
-    # the abandoned child must still be alive (it was not killed); reap it
-    # here so the test doesn't leak a sleeper
-    pids = [int(p) for line in proc.stderr.splitlines()
-            for w in [line.split("pid ")]
-            if len(w) > 1
-            for p in [w[1].split()[0].rstrip(")")] if p.isdigit()]
+    # the stall detector (not a past-deadline bug) must be what fired: the
+    # child journals 'start' (and usually 'device') before the fake wedge
+    assert "stage 'spawn'" not in proc.stderr, proc.stderr
+    assert "terminated" in proc.stderr
+    pids = _abandoned_pids(proc)
+    assert pids, f"no abandoned pid reported in: {proc.stderr!r}"
+    for pid in pids:  # cleanup if terminate lost the race; must not linger
+        try:
+            os.kill(pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+
+
+def test_bench_wedged_leg_abandoned_never_kill(tmp_path):
+    """With BENCH_NEVER_KILL (the TPU-leg policy, forced on for the test),
+    the hung child is left running — abandoned, never signalled."""
+    proc = _run_bench(tmp_path, {
+        "BENCH_FAKE_WEDGE": "1",
+        "BENCH_FAKE_WEDGE_SECS": "120",
+        "BENCH_PROGRESS_TIMEOUT": "15",
+        "BENCH_NEVER_KILL": "1",
+    })
+    assert proc.returncode == 0, proc.stderr
+    out = _parse_line(proc)
+    assert out["value"] == 0.0
+    assert "abandoned" in proc.stderr
+    assert "left running" in proc.stderr
+    pids = _abandoned_pids(proc)
     assert pids, f"no abandoned pid reported in: {proc.stderr!r}"
     for pid in pids:
         try:
