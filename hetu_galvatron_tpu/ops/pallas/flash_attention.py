@@ -103,12 +103,18 @@ def flash_attention_hmajor(
 ) -> jax.Array:
     B, N, S, D = q.shape
     K = k.shape[1]
+    Sk = k.shape[2]  # may differ from S (ring off-diagonal blocks)
     G = N // K
     block_q = min(block_q, S)
-    block_k = min(block_k, S)
-    if S % block_q or S % block_k:
-        raise ValueError(f"seq {S} must divide by blocks {block_q}/{block_k}")
-    num_k = S // block_k
+    block_k = min(block_k, Sk)
+    if S % block_q or Sk % block_k:
+        raise ValueError(
+            f"seq {S}/{Sk} must divide by blocks {block_q}/{block_k}")
+    if causal and Sk != S:
+        raise ValueError("causal flash needs equal q/k lengths")
+    if segments is not None and Sk != S:
+        raise ValueError("segment masking needs equal q/k lengths")
+    num_k = Sk // block_k
     grid = (B, N, S // block_q, num_k)  # k-block axis innermost
     has_seg = segments is not None
     kernel = functools.partial(
@@ -285,13 +291,18 @@ def flash_attention_bwd_hmajor(
     tile, so nothing O(S^2) ever hits HBM. Returns (dq, dk, dv)."""
     B, N, S, D = q.shape
     KV = k.shape[1]
+    Sk = k.shape[2]  # may differ from S (ring off-diagonal blocks)
     G = N // KV
     block_q = min(block_q, S)
-    block_k = min(block_k, S)
+    block_k = min(block_k, Sk)
     num_q = S // block_q
-    num_k = S // block_k
+    num_k = Sk // block_k
     scale = 1.0 / math.sqrt(D)
+    if causal and Sk != S:
+        raise ValueError("causal flash needs equal q/k lengths")
     has_seg = segments is not None
+    if has_seg and Sk != S:
+        raise ValueError("segment masking needs equal q/k lengths")
     # (B, N, S, 1): same trailing-singleton layout as lse (Mosaic tiling)
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1,
                     keepdims=True)
@@ -334,8 +345,8 @@ def flash_attention_bwd_hmajor(
                          lambda b, kh, kb, g, qb: (b, kh, kb, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B, KV, S, D), k.dtype),
-            jax.ShapeDtypeStruct((B, KV, S, D), v.dtype),
+            jax.ShapeDtypeStruct((B, KV, Sk, D), k.dtype),
+            jax.ShapeDtypeStruct((B, KV, Sk, D), v.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, D), jnp.float32),

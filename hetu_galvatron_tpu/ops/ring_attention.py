@@ -104,12 +104,238 @@ def _ring_attention_local(q, k, v, *, axis, causal, zigzag=False):
     return o.transpose(0, 3, 1, 2, 4).reshape(B, Sq, N, D).astype(q.dtype)
 
 
+# ---------------------------------------------------------------------------
+# flash-inside-the-ring: each ring step runs the Pallas flash kernel on the
+# currently-held k/v block; per-block (o, lse) pairs merge in log space.
+# Mirrors the reference's zigzag ring flash (attention_impl.py:564-905), where
+# each step issues a flash_attn call on a full or half block:
+#   * diagonal step (src == my): plain causal flash on the local layout
+#     (for zigzag the local [half r | half 2cp-1-r] order IS causal order);
+#   * src < my: every q row attends the earlier block — non-causal flash on
+#     the full k (contiguous) or its first half (zigzag: the second half of
+#     an earlier rank's block is LATER than all local rows... see _positions);
+#   * src > my: contiguous ranks skip entirely; zigzag ranks attend with the
+#     local second half only (global half-block 2cp-1-my is after everything
+#     rank src holds).
+# The backward replays the ring with the final (o, lse): the flash backward
+# recomputes p per tile from the global logsumexp, so per-step dk/dv are
+# exact partial sums; they accumulate in buffers that rotate in lockstep
+# with k/v and arrive home after cp rotations (the reference's reverse-ring
+# send of dk/dv).
+# ---------------------------------------------------------------------------
+
+
+def _fit_or_die(seq: int, floor: int) -> Tuple[int, int]:
+    from hetu_galvatron_tpu.ops.pallas.flash_attention import (
+        DEFAULT_BLOCK_K,
+        DEFAULT_BLOCK_Q,
+        fit_block,
+    )
+
+    bq = fit_block(DEFAULT_BLOCK_Q, seq, floor)
+    bk = fit_block(DEFAULT_BLOCK_K, seq, floor)
+    if not bq or not bk:
+        raise ValueError(f"no flash block >= {floor} divides seq {seq}")
+    return bq, bk
+
+
+def ring_flash_blocks_fit(s_local: int, zigzag: bool, floor: int) -> bool:
+    """Whether the flash-in-ring path can tile this local sequence length
+    (callers fall back to the dense XLA ring otherwise)."""
+    from hetu_galvatron_tpu.ops.pallas.flash_attention import (
+        DEFAULT_BLOCK_K,
+        DEFAULT_BLOCK_Q,
+        fit_block,
+    )
+
+    seqs = [s_local] + ([s_local // 2] if zigzag else [])
+    return all(s > 0
+               and fit_block(DEFAULT_BLOCK_Q, s, floor)
+               and fit_block(DEFAULT_BLOCK_K, s, floor) for s in seqs)
+
+
+def _fa_block(q, k, v, causal, interpret, floor):
+    """Forward flash on one (q, k/v) block pair; heads-major [B,N,S,D]."""
+    from hetu_galvatron_tpu.ops.pallas.flash_attention import (
+        flash_attention_hmajor,
+    )
+
+    bq, _ = _fit_or_die(q.shape[2], floor)
+    _, bk = _fit_or_die(k.shape[2], floor)
+    o, lse = flash_attention_hmajor(q, k, v, None, causal=causal,
+                                    block_q=bq, block_k=bk,
+                                    interpret=interpret)
+    return o.astype(jnp.float32), lse
+
+
+def _fa_block_bwd(q, k, v, o, lse, do, causal, interpret, floor):
+    """Backward flash on one block pair -> (dq, dk, dv) fp32."""
+    from hetu_galvatron_tpu.ops.pallas.flash_attention import (
+        flash_attention_bwd_hmajor,
+    )
+
+    bq, _ = _fit_or_die(q.shape[2], floor)
+    _, bk = _fit_or_die(k.shape[2], floor)
+    dq, dk, dv = flash_attention_bwd_hmajor(
+        q, k, v, o, lse, do, None, causal=causal,
+        block_q=bq, block_k=bk, interpret=interpret)
+    return (dq.astype(jnp.float32), dk.astype(jnp.float32),
+            dv.astype(jnp.float32))
+
+
+def _combine_blocks(o, lse, oi, lsei):
+    """Merge two normalized flash outputs (o fp32 [B,N,S,D], lse
+    [B,N,S,1]): o = o*exp(lse-m)/denom + oi*exp(lsei-m)/denom."""
+    m = jnp.maximum(lse, lsei)
+    m_safe = jnp.where(m == NEG_INF, 0.0, m)
+    a = jnp.where(lse == NEG_INF, 0.0, jnp.exp(lse - m_safe))
+    ai = jnp.where(lsei == NEG_INF, 0.0, jnp.exp(lsei - m_safe))
+    denom = jnp.maximum(a + ai, 1e-38)
+    new_lse = jnp.where(a + ai > 0.0, m_safe + jnp.log(denom), NEG_INF)
+    return o * (a / denom) + oi * (ai / denom), new_lse
+
+
+def _rotate(ts, axis, cp):
+    perm = [(i, (i + 1) % cp) for i in range(cp)]
+    return tuple(jax.lax.ppermute(t, axis, perm) for t in ts)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _ring_flash_local(q, k, v, axis, cp, causal, zigzag, interpret, floor):
+    out, _ = _ring_flash_fwd(q, k, v, axis, cp, causal, zigzag, interpret,
+                             floor)
+    return out
+
+
+def _ring_flash_fwd(q, k, v, axis, cp, causal, zigzag, interpret, floor):
+    """q [B,N,S,D], k/v [B,K,S,D] heads-major local blocks under shard_map."""
+    my = jax.lax.axis_index(axis)
+    B, N, S, D = q.shape
+    K = k.shape[1]
+    half = S // 2
+    kt, vt = k, v
+    o, lse = _fa_block(q, kt, vt, causal, interpret, floor)  # diagonal step
+    for t in range(1, cp):
+        kt, vt = _rotate((kt, vt), axis, cp)
+        src = (my - t) % cp
+        if not causal:
+            oi, lsei = _fa_block(q, kt, vt, False, interpret, floor)
+        elif zigzag:
+            def _earlier(kb, vb):
+                # src holds global half-blocks (src, 2cp-1-src); only the
+                # FIRST half (src < my) is in the local rows' past
+                return _fa_block(q, kb[:, :, :half], vb[:, :, :half],
+                                 False, interpret, floor)
+
+            def _later(kb, vb):
+                # src > my: only local half 2cp-1-my (rows half:) is after
+                # everything rank src holds
+                ob, lb = _fa_block(q[:, :, half:], kb, vb,
+                                   False, interpret, floor)
+                return (
+                    jnp.concatenate(
+                        [jnp.zeros((B, N, half, D), jnp.float32), ob], 2),
+                    jnp.concatenate(
+                        [jnp.full((B, N, half, 1), NEG_INF, jnp.float32),
+                         lb], 2),
+                )
+
+            oi, lsei = jax.lax.cond(src < my, _earlier, _later, kt, vt)
+        else:
+            def _earlier(kb, vb):
+                return _fa_block(q, kb, vb, False, interpret, floor)
+
+            def _later(kb, vb):
+                return (jnp.zeros((B, N, S, D), jnp.float32),
+                        jnp.full((B, N, S, 1), NEG_INF, jnp.float32))
+
+            oi, lsei = jax.lax.cond(src < my, _earlier, _later, kt, vt)
+        o, lse = _combine_blocks(o, lse, oi, lsei)
+    out = o.astype(q.dtype)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_flash_bwd(axis, cp, causal, zigzag, interpret, floor, res, do):
+    """Ring replay: per-step flash backward against the final (o, lse);
+    dk/dv partial sums rotate with k/v and arrive home after cp steps."""
+    q, k, v, o, lse = res
+    my = jax.lax.axis_index(axis)
+    B, N, S, D = q.shape
+    K = k.shape[1]
+    half = S // 2
+    dq = jnp.zeros((B, N, S, D), jnp.float32)
+    dk_acc = jnp.zeros((B, K, S, D), jnp.float32)
+    dv_acc = jnp.zeros((B, K, S, D), jnp.float32)
+    kt, vt = k, v
+    for t in range(cp):
+        src = (my - t) % cp
+        if t == 0:
+            dq_c, dk_c, dv_c = _fa_block_bwd(q, kt, vt, o, lse, do, causal,
+                                             interpret, floor)
+        elif not causal:
+            dq_c, dk_c, dv_c = _fa_block_bwd(q, kt, vt, o, lse, do, False,
+                                             interpret, floor)
+        elif zigzag:
+            def _earlier(kb, vb):
+                dqb, dkb, dvb = _fa_block_bwd(
+                    q, kb[:, :, :half], vb[:, :, :half], o, lse, do,
+                    False, interpret, floor)
+                pad = jnp.zeros((B, K, half, D), jnp.float32)
+                return (dqb, jnp.concatenate([dkb, pad], 2),
+                        jnp.concatenate([dvb, pad], 2))
+
+            def _later(kb, vb):
+                dqb, dkb, dvb = _fa_block_bwd(
+                    q[:, :, half:], kb, vb, o[:, :, half:],
+                    lse[:, :, half:], do[:, :, half:],
+                    False, interpret, floor)
+                pad = jnp.zeros((B, N, half, D), jnp.float32)
+                return jnp.concatenate([pad, dqb], 2), dkb, dvb
+
+            dq_c, dk_c, dv_c = jax.lax.cond(src < my, _earlier, _later,
+                                            kt, vt)
+        else:
+            def _earlier(kb, vb):
+                return _fa_block_bwd(q, kb, vb, o, lse, do, False,
+                                     interpret, floor)
+
+            def _later(kb, vb):
+                return (jnp.zeros((B, N, S, D), jnp.float32),
+                        jnp.zeros((B, K, S, D), jnp.float32),
+                        jnp.zeros((B, K, S, D), jnp.float32))
+
+            dq_c, dk_c, dv_c = jax.lax.cond(src < my, _earlier, _later,
+                                            kt, vt)
+        dq = dq + dq_c
+        dk_acc = dk_acc + dk_c
+        dv_acc = dv_acc + dv_c
+        # rotate every step (cp total): a contribution for block b added at
+        # step t undergoes cp - t further rotations -> lands on rank b
+        kt, vt, dk_acc, dv_acc = _rotate((kt, vt, dk_acc, dv_acc), axis, cp)
+    return dq.astype(q.dtype), dk_acc.astype(k.dtype), dv_acc.astype(v.dtype)
+
+
+_ring_flash_local.defvjp(_ring_flash_fwd, _ring_flash_bwd)
+
+
+def _ring_flash_sdpa_local(q, k, v, *, axis, cp, causal, zigzag, interpret,
+                           floor):
+    """shard_map body: [B, S/cp, N|K, D] in/out (matches
+    :func:`_ring_attention_local`); flash kernels want heads-major."""
+    qh, kh, vh = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+    out = _ring_flash_local(qh, kh, vh, axis, cp, causal, zigzag, interpret,
+                            floor)
+    return out.transpose(0, 2, 1, 3)
+
+
 def make_ring_sdpa(
     mesh: Mesh,
     cp_axes: Tuple[str, ...],
     dp_axes: Tuple[str, ...] = (),
     tp_axes: Tuple[str, ...] = (),
     zigzag: bool = False,
+    use_flash: bool = False,
+    interpret: bool = False,
 ):
     """sdpa_fn for modules.apply_attention: reshards q/k/v so the sequence
     lives on the cp axes, runs the ring kernel under shard_map, and hands the
@@ -121,7 +347,13 @@ def make_ring_sdpa(
     post-RoPE q/k/v is position-safe). Balancing costs one all-to-all-ish
     reshard at entry/exit; pushing the zigzag layout out to the dataloader
     (get_batch zigzag slice, reference utils.py:295) removes that cost and
-    is the long-sequence deployment mode."""
+    is the long-sequence deployment mode.
+
+    ``use_flash=True`` runs the Pallas flash kernel inside each ring step
+    (the reference's flash-in-ring, attention_impl.py:564-905) instead of
+    the dense per-block XLA fold — O(block) memory per step at MXU speed.
+    Falls back to the dense fold per call when no lane-aligned flash block
+    tiles the local sequence. ``interpret=True`` is for CPU tests."""
     if not cp_axes:
         raise ValueError("ring attention needs at least one cp axis")
     axis = cp_axes if len(cp_axes) > 1 else cp_axes[0]
@@ -138,9 +370,16 @@ def make_ring_sdpa(
             raise ValueError(
                 f"zigzag layout needs sequence {S} divisible by 2*cp "
                 f"= {2 * cp} (two half-blocks per rank)")
+        floor = 8 if interpret else 128
+        if use_flash and ring_flash_blocks_fit(S // cp, zigzag, floor):
+            local = partial(_ring_flash_sdpa_local, axis=axis, cp=cp,
+                            causal=causal, zigzag=zigzag,
+                            interpret=interpret, floor=floor)
+        else:
+            local = partial(_ring_attention_local, axis=axis, causal=causal,
+                            zigzag=zigzag)
         fn = jax.shard_map(
-            partial(_ring_attention_local, axis=axis, causal=causal,
-                    zigzag=zigzag),
+            local,
             mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
             check_vma=False)
         if zigzag:
