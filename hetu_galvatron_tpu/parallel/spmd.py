@@ -147,7 +147,8 @@ def attention_overrides(
     for i, sh in enumerate(per_layer):
         if sh.cp_axes:
             out[i] = {"sdpa_fn": make_ring_sdpa(
-                mesh, sh.cp_axes, dp_axes=sh.dp_axes, tp_axes=sh.tp_axes)}
+                mesh, sh.cp_axes, dp_axes=sh.dp_axes, tp_axes=sh.tp_axes,
+                use_flash=use_flash)}
             if with_cross:
                 out[i]["cross_sdpa_fn"] = xla_sdpa
         elif sh.ulysses and sh.tp_axes:
@@ -191,6 +192,34 @@ def make_boundary_fn(
     return boundary
 
 
+def make_embed_use_constraint(
+    embed_axes: Params, vocab: LayerSharding, mesh: Mesh
+) -> Callable[[Params], Params]:
+    """ZeRO-3 shards the embedding table's hidden dim across dp; the table
+    must be (all-)gathered before the token lookup. State that explicitly
+    with a use-site `with_sharding_constraint` (hidden dim unsharded, vocab
+    dim still vtp-sharded) so the partitioner doesn't solve the gather with
+    a hidden-sharded output and then full-rematerialize it to the batch/seq
+    activation layout — the `spmd_partitioner.cc` "Involuntary full
+    rematerialization" warning. Backward gets the transpose for free: the
+    wte grad is formed in the gathered layout and reduce-scattered back to
+    the ZeRO-3 spec by the constraint's adjoint. This is the relocation the
+    reference does by hand (runtime/redistribute.py:345-415)."""
+    is_axes = lambda x: (isinstance(x, tuple)
+                         and all(isinstance(s, str) for s in x))
+    specs = jax.tree.map(
+        lambda la: vocab.param_spec(la, zero3_override=False),
+        embed_axes, is_leaf=is_axes)
+
+    def constrain(embed_params: Params) -> Params:
+        return jax.tree.map(
+            lambda x, s: jax.lax.with_sharding_constraint(
+                x, NamedSharding(mesh, s)),
+            embed_params, specs)
+
+    return constrain
+
+
 def shard_params(params: Params, specs: Params, mesh: Mesh) -> Params:
     """Place an (unsharded, host/single-device) params tree onto the mesh."""
     return jax.tree.map(
@@ -214,6 +243,102 @@ def _lower_specs(hpc: HybridParallelConfig, mesh: Mesh, axes_tree: Params):
     pspecs = param_specs(axes_tree, per_layer, vocab,
                          enc_per_layer=enc_per or None)
     return enc_per, per_layer, vocab, pspecs
+
+
+def build_spmd_loss_fn(
+    cfg: ModelArgs,
+    hpc: HybridParallelConfig,
+    mesh: Mesh,
+    axes_tree: Params,
+    *,
+    compute_dtype=jnp.bfloat16,
+    layer_overrides: Optional[Dict[int, Dict[str, Any]]] = None,
+):
+    """The plan-lowered loss closure shared by the train and eval steps:
+    per-layer shardings, boundary constraints, attention-impl dispatch,
+    remat flags, fused CE, and the ZeRO-3 embed use-site constraint.
+    Returns (loss_fn, pspecs, batch_shd, per_layer, vocab, enc_per)."""
+    enc_per, per_layer, vocab, pspecs = _lower_specs(hpc, mesh, axes_tree)
+    boundary = make_boundary_fn(per_layer, vocab, mesh)
+    enc_boundary = (make_boundary_fn(enc_per, vocab, mesh)
+                    if enc_per else None)
+    use_flash = None if cfg.use_flash_attn else False
+    ring = attention_overrides(
+        per_layer, mesh, use_flash=use_flash,
+        with_cross=cfg.model_type == "t5")
+    enc_overrides = (attention_overrides(enc_per, mesh, use_flash=use_flash)
+                     if enc_per else None)
+    if ring:
+        # per-key merge: a caller override on a cp layer must not drop the
+        # ring sdpa_fn unless it sets sdpa_fn itself
+        merged = dict(layer_overrides or {})
+        for i, kw in ring.items():
+            merged[i] = {**kw, **merged.get(i, {})}
+        layer_overrides = merged
+    remat = [sh.checkpoint for sh in per_layer]
+    enc_remat = [sh.checkpoint for sh in enc_per]
+    batch_shd = batch_sharding(per_layer, mesh)
+
+    enc_kwargs = {}
+    if cfg.model_type == "t5":
+        # always pass the explicit per-layer list: None would trigger the
+        # legacy clone-remat_flags[0] fallback in forward_encdec
+        enc_kwargs = dict(
+            enc_remat_flags=enc_remat,
+            enc_layer_overrides=enc_overrides,
+            enc_boundary_fn=enc_boundary)
+
+    # Fused CE on a mesh: a bare Pallas call is a custom call GSPMD cannot
+    # partition, so distributed runs get the shard_map vocab-parallel
+    # wrapper matched to the head's sharding (pmax/psum logsumexp merge
+    # across vocab shards — the reference's Triton vocab-parallel CE
+    # semantics); single-device runs use the kernel directly.
+    fused_ce = cfg.use_fused_ce
+    if fused_ce and mesh.size > 1:
+        from hetu_galvatron_tpu.ops.pallas.cross_entropy import (
+            make_vocab_parallel_ce,
+        )
+
+        fused_ce = make_vocab_parallel_ce(mesh, vocab)
+
+    constrain_embed = make_embed_use_constraint(
+        axes_tree["embed"], vocab, mesh)
+
+    def loss_fn(p, batch):
+        p = {**p, "embed": constrain_embed(p["embed"])}
+        return causal_lm_loss(
+            p, batch, cfg, compute_dtype=compute_dtype,
+            remat_flags=remat if any(remat) else None,
+            layer_overrides=layer_overrides, boundary_fn=boundary,
+            fused_ce=fused_ce, **enc_kwargs)
+
+    return loss_fn, pspecs, batch_shd, per_layer, vocab, enc_per
+
+
+def make_spmd_eval_step(
+    cfg: ModelArgs,
+    hpc: HybridParallelConfig,
+    mesh: Mesh,
+    axes_tree: Params,
+    *,
+    compute_dtype=jnp.bfloat16,
+    layer_overrides: Optional[Dict[int, Dict[str, Any]]] = None,
+):
+    """Jitted held-out loss under the SAME plan shardings as training
+    (reference evaluate(), training.py side of dataloader.py:462): no
+    optimizer, no dropout (eval semantics are the loss_fn default when the
+    batch carries no 'dropout_rng'). Returns (eval_fn(params, batch) ->
+    loss, batch_shd)."""
+    if hpc.pp_deg != 1:
+        raise ValueError("make_spmd_eval_step is the pp=1 path; use "
+                         "PipelineEngine.eval_step for pp>1")
+    loss_fn, pspecs, batch_shd, _, _, _ = build_spmd_loss_fn(
+        cfg, hpc, mesh, axes_tree, compute_dtype=compute_dtype,
+        layer_overrides=layer_overrides)
+    nshd = jax.tree.map(
+        lambda s: NamedSharding(mesh, s), pspecs,
+        is_leaf=lambda x: isinstance(x, P))
+    return jax.jit(loss_fn, in_shardings=(nshd, batch_shd)), batch_shd
 
 
 def make_spmd_train_step(
@@ -240,60 +365,14 @@ def make_spmd_train_step(
     if hpc.pp_deg != 1:
         raise ValueError("make_spmd_train_step is the pp=1 path; use the "
                          "pipeline engine for pp>1")
-    enc_per, per_layer, vocab, pspecs = _lower_specs(hpc, mesh, axes_tree)
+    loss_fn, pspecs, batch_shd, per_layer, vocab, enc_per = (
+        build_spmd_loss_fn(
+            cfg, hpc, mesh, axes_tree, compute_dtype=compute_dtype,
+            layer_overrides=layer_overrides))
     opt_pspecs = param_specs(axes_tree, per_layer, vocab, opt=True,
                              enc_per_layer=enc_per or None)
     opt_specs = opt_state_specs(tx, params, opt_pspecs)
-    boundary = make_boundary_fn(per_layer, vocab, mesh)
-    enc_boundary = (make_boundary_fn(enc_per, vocab, mesh)
-                    if enc_per else None)
-    use_flash = None if cfg.use_flash_attn else False
-    ring = attention_overrides(
-        per_layer, mesh, use_flash=use_flash,
-        with_cross=cfg.model_type == "t5")
-    enc_overrides = (attention_overrides(enc_per, mesh, use_flash=use_flash)
-                     if enc_per else None)
-    if ring:
-        # per-key merge: a caller override on a cp layer must not drop the
-        # ring sdpa_fn unless it sets sdpa_fn itself
-        merged = dict(layer_overrides or {})
-        for i, kw in ring.items():
-            merged[i] = {**kw, **merged.get(i, {})}
-        layer_overrides = merged
-    remat = [sh.checkpoint for sh in per_layer]
-    enc_remat = [sh.checkpoint for sh in enc_per]
-    batch_shd = batch_sharding(per_layer, mesh)
     chunks = max(chunks if chunks is not None else hpc.chunks, 1)
-
-    enc_kwargs = {}
-    if cfg.model_type == "t5":
-        # always pass the explicit per-layer list: None would trigger the
-        # legacy clone-remat_flags[0] fallback in forward_encdec
-        enc_kwargs = dict(
-            enc_remat_flags=enc_remat,
-            enc_layer_overrides=enc_overrides,
-            enc_boundary_fn=enc_boundary)
-
-    # Fused CE on a mesh: a bare Pallas call is a custom call GSPMD cannot
-    # partition, so distributed runs get the shard_map vocab-parallel
-    # wrapper matched to the head's sharding (pmax/psum logsumexp merge
-    # across vocab shards — the reference's Triton vocab-parallel CE
-    # semantics); single-device runs use the kernel directly.
-    fused_ce = cfg.use_fused_ce
-    if fused_ce and mesh.size > 1:
-        from hetu_galvatron_tpu.ops.pallas.cross_entropy import (
-            make_vocab_parallel_ce,
-        )
-
-        fused_ce = make_vocab_parallel_ce(mesh, vocab)
-
-    def loss_fn(p, batch):
-        return causal_lm_loss(
-            p, batch, cfg, compute_dtype=compute_dtype,
-            remat_flags=remat if any(remat) else None,
-            layer_overrides=layer_overrides, boundary_fn=boundary,
-            fused_ce=fused_ce, **enc_kwargs)
-
     step = make_train_step(loss_fn, tx, chunks=chunks)
 
     nshd = lambda tree: jax.tree.map(

@@ -53,6 +53,7 @@ _SLOW_TESTS = {
     "test_param_count_gpt2_small",
     "test_gpt2_loss_trajectory_matches_hf",
     # spmd / pipeline parity
+    "test_no_involuntary_full_rematerialization",
     "test_strategy_matches_single_device",
     "test_mixed_per_layer_strategies",
     "test_multi_step_trajectory_matches_single_device",
