@@ -182,6 +182,7 @@ class PipelineEngine:
         self._fwd_jits = [self._make_fwd(st) for st in self.stages]
         self._bwd_jits = [self._make_bwd(st) for st in self.stages]
         self._update_jits = [self._make_update(st) for st in self.stages]
+        self._eval_jits = None  # built on first eval_step (dropout off)
         self._transpose_jit = jax.jit(jnp.transpose)
         # expert_bias maintenance pseudo-grads stay out of the clip norm,
         # matching the SPMD path (clip_by_global_norm lives inside the
@@ -313,12 +314,19 @@ class PipelineEngine:
     # ------------------------------------------------------------------
 
     def _stage_apply(self, st: _Stage, sp: Params, x: jax.Array,
-                     labels=None, loss_mask=None, dropout_rng=None):
+                     labels=None, loss_mask=None, dropout_rng=None,
+                     position_ids=None, segment_ids=None):
         """Non-head stages return (x, stage_aux); the head stage returns
         ce_loss + its own aux (MoE auxiliary losses contribute per stage).
         ``dropout_rng`` is the per-(microbatch, stage) key; the schedule
         passes the SAME key to a microbatch's forward and backward so the
-        backward's remat recomputation reuses the forward's masks."""
+        backward's remat recomputation reuses the forward's masks.
+
+        ``position_ids`` / ``segment_ids`` [B, S] are the packed-document
+        fields (reset_position_ids / reset_attention_mask): the single
+        controller places them on every stage's submesh directly, where the
+        reference ships them through multi-tensor p2p transfers
+        (pipeline.py:1140 _communicate)."""
         from hetu_galvatron_tpu.models.moe import apply_moe_decoder_layer
 
         cfg = self.cfg
@@ -329,16 +337,24 @@ class PipelineEngine:
         if st.has_embed:
             x = M.apply_embedding(sp["embed"], x, cfg,
                                   compute_dtype=self.compute_dtype,
-                                  dropout_rng=layer_rng(M.DROPOUT_STREAM_EMBED))
+                                  dropout_rng=layer_rng(M.DROPOUT_STREAM_EMBED),
+                                  position_ids=position_ids)
         rope = None
         if cfg.position_embedding_type == "rope":
-            rope = M.rope_cos_sin(x.shape[1], cfg.head_dim, cfg.rope_theta,
-                                  scaling=cfg.rope_scaling)
+            cos, sin = M.rope_cos_sin(x.shape[1], cfg.head_dim,
+                                      cfg.rope_theta,
+                                      scaling=cfg.rope_scaling)
+            if position_ids is not None:
+                # packed samples: gather per-token rows -> [B, S, D/2]
+                cos, sin = cos[position_ids], sin[position_ids]
+            rope = (cos, sin)
         from hetu_galvatron_tpu.parallel.spmd import attention_overrides
 
         overrides = attention_overrides(
             st.shardings, st.mesh,
             use_flash=None if cfg.use_flash_attn else False)
+        seg_kw = ({"segment_ids": segment_ids}
+                  if segment_ids is not None else {})
         aux_total = jnp.zeros((), jnp.float32)
         for j, lp in enumerate(sp["layers"]):
             sh = st.shardings[j]
@@ -348,12 +364,12 @@ class PipelineEngine:
                 fn = partial(apply_moe_decoder_layer, cfg=cfg, rope=rope,
                              compute_dtype=self.compute_dtype,
                              dropout_rng=layer_rng(j),
-                             **overrides.get(j, {}))
+                             **seg_kw, **overrides.get(j, {}))
             else:
                 base = partial(M.apply_decoder_layer, cfg=cfg, rope=rope,
                                compute_dtype=self.compute_dtype,
                                dropout_rng=layer_rng(j),
-                               **overrides.get(j, {}))
+                               **seg_kw, **overrides.get(j, {}))
                 fn = lambda p, h, b=base: (b(p, h),
                                            jnp.zeros((), jnp.float32))
             if sh.checkpoint:
@@ -460,13 +476,24 @@ class PipelineEngine:
         sh_b = st.shardings[idx] if st.shardings else sh_a
         return sh_a.act_spec(), sh_b.act_spec()
 
+    def _apply_with_extras(self, st, sp, x, labels=None, loss_mask=None,
+                           dropout_rng=None, pos=None, seg=None):
+        """Route to the family apply; packed-doc extras are causal-LM only
+        (the dataloader and _microbatches both gate t5)."""
+        if self.is_t5:
+            return self._stage_apply_t5(st, sp, x, labels, loss_mask,
+                                        dropout_rng=dropout_rng)
+        return self._stage_apply(st, sp, x, labels, loss_mask,
+                                 dropout_rng=dropout_rng,
+                                 position_ids=pos, segment_ids=seg)
+
     def _make_fwd(self, st: _Stage) -> Optional[Callable]:
         if st.has_head:
             return None  # head fwd is fused into its value_and_grad backward
-        apply = self._stage_apply_t5 if self.is_t5 else self._stage_apply
 
-        def f(sp, x, rng):
-            y, _ = apply(st, sp, x, dropout_rng=rng)
+        def f(sp, x, rng, pos, seg):
+            y, _ = self._apply_with_extras(st, sp, x, dropout_rng=rng,
+                                           pos=pos, seg=seg)
             return y
         return jax.jit(f)
 
@@ -476,11 +503,12 @@ class PipelineEngine:
         forward never runs separately just for the metric. ``rng`` is the
         same per-(microbatch, stage) key the forward ran with, so the remat
         recomputation reuses the identical dropout masks."""
-        apply = self._stage_apply_t5 if self.is_t5 else self._stage_apply
         if st.has_head:
-            def g(sp, x, labels, mask, seed, rng):
+            def g(sp, x, labels, mask, seed, rng, pos, seg):
                 def lf(sp_, x_):
-                    return apply(st, sp_, x_, labels, mask, dropout_rng=rng)
+                    return self._apply_with_extras(
+                        st, sp_, x_, labels, mask, dropout_rng=rng,
+                        pos=pos, seg=seg)
                 loss, (dp, dx) = jax.value_and_grad(
                     lambda sp_, x_: lf(sp_, x_), argnums=(0, 1))(sp, x)
                 dp = jax.tree.map(lambda t: seed * t, dp)
@@ -488,14 +516,62 @@ class PipelineEngine:
                 return dp, dx, loss
             return jax.jit(g)
 
-        def g(sp, x, dy, seed, rng):
+        def g(sp, x, dy, seed, rng, pos, seg):
             # cotangents: dy for the activation, seed (the microbatch weight)
             # for this stage's MoE aux loss which enters the total directly
             (_, aux), vjp = jax.vjp(
-                lambda sp_, x_: apply(st, sp_, x_, dropout_rng=rng), sp, x)
+                lambda sp_, x_: self._apply_with_extras(
+                    st, sp_, x_, dropout_rng=rng, pos=pos, seg=seg), sp, x)
             dp, dx = vjp((dy, seed))
             return dp, dx, aux
         return jax.jit(g)
+
+    def _make_eval(self, st: _Stage) -> Callable:
+        """Forward-only stage program with eval semantics (no dropout): the
+        head stage returns the held-out loss, others the activation."""
+        if st.has_head:
+            def f(sp, x, labels, mask, pos, seg):
+                return self._apply_with_extras(st, sp, x, labels, mask,
+                                               dropout_rng=None,
+                                               pos=pos, seg=seg)
+            return jax.jit(f)
+
+        def f(sp, x, pos, seg):
+            y, _ = self._apply_with_extras(st, sp, x, dropout_rng=None,
+                                           pos=pos, seg=seg)
+            return y
+        return jax.jit(f)
+
+    def eval_step(
+        self,
+        stage_params: List[Params],
+        batch: Dict[str, np.ndarray],
+        num_microbatches: Optional[int] = None,
+    ) -> Dict[str, float]:
+        """Held-out loss under the training plan: forward-only through the
+        stage pipeline (reference evaluate() over the valid iterator,
+        dataloader.py:462 split machinery). Dropout is off; no optimizer
+        state is touched."""
+        batch = dict(batch)
+        batch.pop("dropout_rng", None)
+        if self._eval_jits is None:
+            self._eval_jits = [self._make_eval(st) for st in self.stages]
+        mbs, weights = self._microbatches(batch, num_microbatches)
+        losses = []
+        n_stages = len(self.stages)
+        for mb in mbs:
+            x = self._put_stage0(mb)
+            for s in range(n_stages):
+                pos, seg = self._put_extras(mb, s)
+                if s == n_stages - 1:
+                    lbl, msk = self._put_last(mb)
+                    losses.append(self._eval_jits[s](
+                        stage_params[s], x, lbl, msk, pos, seg))
+                else:
+                    y = self._eval_jits[s](stage_params[s], x, pos, seg)
+                    x = self._transfer(y, s + 1)
+        loss = sum(float(w) * float(l) for w, l in zip(weights, losses))
+        return {"loss": loss}
 
     def _make_update(self, st: _Stage) -> Callable:
         tx = self.tx
@@ -516,19 +592,24 @@ class PipelineEngine:
     # schedules
     # ------------------------------------------------------------------
 
-    # batch keys the stage transfers actually ship; anything else (e.g.
-    # packed-sample position_ids/segment_ids) would be silently dropped by
-    # _put_stage0/_put_last, so its presence must be a loud error
+    # batch keys the schedule knows how to place; anything else would be
+    # silently dropped by _put_stage0/_put_last/_put_extras, so its presence
+    # must be a loud error. position_ids/segment_ids (packed documents,
+    # reset_position_ids/reset_attention_mask) are placed on EVERY stage's
+    # submesh by the single controller — the reference ships them via
+    # multi-tensor p2p instead (pipeline.py:1140 _communicate).
     _SHIPPED_KEYS = frozenset({"tokens", "labels", "loss_mask", "enc_tokens"})
+    _EXTRA_KEYS = frozenset({"position_ids", "segment_ids"})
 
     def _microbatches(self, batch: Dict[str, np.ndarray],
                       num_microbatches: Optional[int] = None):
-        extra = set(batch) - self._SHIPPED_KEYS
+        shipped = self._SHIPPED_KEYS | (
+            frozenset() if self.is_t5 else self._EXTRA_KEYS)
+        extra = set(batch) - shipped
         if extra:
             raise NotImplementedError(
-                f"the pipeline engine does not thread batch keys {sorted(extra)} "
-                "through its stage transfers (reset_position_ids/"
-                "reset_attention_mask etc. need pp_deg=1)")
+                f"the pipeline engine does not thread batch keys "
+                f"{sorted(extra)} through its stage transfers")
         m = max(num_microbatches if num_microbatches is not None
                 else self.hpc.chunks, 1)
         b = batch["tokens"].shape[0]
@@ -561,6 +642,18 @@ class PipelineEngine:
         msk = (jax.device_put(jnp.asarray(mb["loss_mask"]), shd)
                if "loss_mask" in mb else None)
         return lbl, msk
+
+    def _put_extras(self, mb, s: int):
+        """Place packed-doc fields [B, S] on stage s's submesh (every stage
+        needs segment_ids for attention masking and position_ids for rope;
+        the controller holds the batch, so no inter-stage p2p is needed)."""
+        st = self.stages[s]
+        spec = (st.shardings[0].batch_spec() if st.shardings
+                else st.vocab.batch_spec())
+        shd = NamedSharding(st.mesh, spec)
+        put = lambda k: (jax.device_put(jnp.asarray(mb[k]), shd)
+                         if k in mb else None)
+        return put("position_ids"), put("segment_ids")
 
     def _transfer(self, y, to_stage: int):
         """Move the inter-stage activation (array, or (a, b) pair for t5)
@@ -599,36 +692,45 @@ class PipelineEngine:
         loss costs no extra pass."""
         x = self._put_stage0(mb)
         inputs = []
+        extras = []
         n_stages = len(self.stages)
         for s in range(n_stages):
             inputs.append(x)
+            extras.append(self._put_extras(mb, s))
             if s == n_stages - 1:
                 lbl, msk = self._put_last(mb)
                 ctx["labels"].append((lbl, msk))
                 ctx["losses"].append(None)  # filled by the backward
             else:
+                pos, seg = extras[s]
                 y = self._fwd_jits[s](stage_params[s], x,
-                                      self._mb_rng(ctx, m, s))
+                                      self._mb_rng(ctx, m, s), pos, seg)
                 x = self._transfer(y, s + 1)
         ctx["inputs"].append(inputs)
+        ctx["extras"].append(extras)
 
     def _bwd_microbatch(self, stage_params, m, w, ctx, grad_acc):
         """Backward for microbatch m seeded with its token weight."""
         inputs = ctx["inputs"][m]
+        extras = ctx["extras"][m]
         lbl, msk = ctx["labels"][m]
         seed = jnp.asarray(w, jnp.float32)
         n_stages = len(self.stages)
+        pos, seg = extras[-1]
         dp, dx, loss = self._bwd_jits[-1](stage_params[-1], inputs[-1], lbl,
                                           msk, seed,
-                                          self._mb_rng(ctx, m, n_stages - 1))
+                                          self._mb_rng(ctx, m, n_stages - 1),
+                                          pos, seg)
         # keep loss/aux as lazy device scalars — any host sync here would
         # serialize the schedule; train_step folds them once at the end
         aux_parts = []
         grad_acc[-1] = _tree_add(grad_acc[-1], dp)
         for s in range(n_stages - 2, -1, -1):
             dy = self._put_cotangent(dx, s)
+            pos, seg = extras[s]
             dp, dx, aux = self._bwd_jits[s](stage_params[s], inputs[s], dy,
-                                            seed, self._mb_rng(ctx, m, s))
+                                            seed, self._mb_rng(ctx, m, s),
+                                            pos, seg)
             if self.cfg.num_experts:
                 aux_parts.append(aux)
             grad_acc[s] = _tree_add(grad_acc[s], dp)
@@ -636,6 +738,7 @@ class PipelineEngine:
         ctx["aux"][m] = aux_parts
         # free stored activations for this microbatch (1F1B memory bound)
         ctx["inputs"][m] = None
+        ctx["extras"][m] = None
 
     def train_step(
         self,
@@ -665,7 +768,7 @@ class PipelineEngine:
             step_rng = jax.random.key(0)
         mbs, weights = self._microbatches(batch, num_microbatches)
         mcount = len(mbs)
-        ctx = {"inputs": [], "labels": [], "losses": [],
+        ctx = {"inputs": [], "extras": [], "labels": [], "losses": [],
                "aux": [[] for _ in range(mcount)], "rng": step_rng}
         grad_acc: List[Any] = [None] * len(self.stages)
 
