@@ -30,7 +30,9 @@ def train(args) -> Dict[str, Any]:
         save_checkpoint,
         wait_for_checkpoints,
     )
-    from hetu_galvatron_tpu.runtime.dataloader import get_data_iterator
+    from hetu_galvatron_tpu.runtime.dataloader import (
+        get_train_valid_test_data_iterators,
+    )
     from hetu_galvatron_tpu.runtime.hybrid_config import get_hybrid_parallel_config
     from hetu_galvatron_tpu.runtime.initialize import initialize
     from hetu_galvatron_tpu.runtime.mesh import build_mesh
@@ -52,7 +54,8 @@ def train(args) -> Dict[str, Any]:
     params, axes = init_causal_lm(jax.random.key(args.train.seed), cfg)
     tx = make_optimizer(args.train)
     schedule = make_lr_schedule(args.train)
-    base_iter = get_data_iterator(args, global_batch_size=hpc.global_bsz)
+    base_iter, valid_iter, test_iter = get_train_valid_test_data_iterators(
+        args, global_batch_size=hpc.global_bsz)
     data_iter = RerunDataIterator(base_iter)
     profiler = RuntimeProfiler(args, world_size=world,
                                rank=jax.process_index())
@@ -97,6 +100,15 @@ def train(args) -> Dict[str, Any]:
 
     compute_dtype = compute_dtype_of(args.parallel.mixed_precision)
     losses = []
+    val_losses = []
+    # per-path eval fn(sp, raw_batch) -> float loss; set below once the
+    # execution path (spmd / pipeline) is built
+    eval_box: Dict[str, Any] = {}
+
+    def run_eval(sp, iterator) -> float:
+        vs = [eval_box["fn"](sp, next(iterator))
+              for _ in range(max(args.train.eval_iters, 1))]
+        return float(np.mean(vs))
 
     def maybe_save(it, sp, so):
         ck = args.ckpt
@@ -220,6 +232,13 @@ def train(args) -> Dict[str, Any]:
                 if calc is None:
                     data_iter.advance()
                 losses.append(float(metrics["loss"]))
+                if (valid_iter is not None and "fn" in eval_box
+                        and args.train.eval_interval
+                        and (it + 1) % args.train.eval_interval == 0):
+                    v = run_eval(sp, valid_iter)
+                    val_losses.append({"iter": it + 1, "loss": v})
+                    state.log(f"iter {it + 1}: validation loss {v:.4f} "
+                              f"({args.train.eval_iters} held-out batches)")
                 # check for a fault BEFORE the interval save: the faulty update
                 # must never be persisted (a step_{it+1} checkpoint would shadow
                 # the pre-fault step_{it} one on resume)
@@ -250,11 +269,13 @@ def train(args) -> Dict[str, Any]:
         sp = eng.split_params(params, axes)
         so = eng.init_opt(sp, axes)
         sp, so, start_iter = maybe_resume(sp, so)
+        if valid_iter is not None or test_iter is not None:
+            eval_box["fn"] = lambda sp_, raw: eng.eval_step(sp_, raw)["loss"]
         if calc is None:
-            run_loop(sp, so, eng.train_step)
+            sp, so = run_loop(sp, so, eng.train_step)
         else:
             # the stage jits are microbatch-shaped: a ramp reuses them all
-            run_loop(sp, so, lambda sp_, so_, b: eng.train_step(
+            sp, so = run_loop(sp, so, lambda sp_, so_, b: eng.train_step(
                 sp_, so_, b, num_microbatches=calc.num_micro_batches))
     else:
         mesh = build_mesh(world, 1, devices=state.devices,
@@ -293,14 +314,37 @@ def train(args) -> Dict[str, Any]:
             fn = step if calc is None else get_step(calc.num_micro_batches)
             return fn(sp, so, b)
 
-        run_loop(sp, so, spmd_step)
+        if valid_iter is not None or test_iter is not None:
+            from hetu_galvatron_tpu.parallel.spmd import make_spmd_eval_step
+
+            eval_fn, eval_shd = make_spmd_eval_step(
+                cfg, hpc, mesh, axes, compute_dtype=compute_dtype)
+
+            def spmd_eval(sp_, raw):
+                raw = dict(raw)
+                raw.pop("dropout_rng", None)
+                b = jax.device_put(jax.tree.map(jnp.asarray, raw), eval_shd)
+                return float(eval_fn(sp_, b))
+
+            eval_box["fn"] = spmd_eval
+
+        sp, so = run_loop(sp, so, spmd_step)
 
     wait_for_checkpoints()
+    test_loss = None
+    if (test_iter is not None and "fn" in eval_box and exit_code is None
+            and losses):
+        # end-of-training held-out evaluation on the test split (the
+        # reference runs evaluate() on the test iterator after training)
+        test_loss = run_eval(sp, test_iter)
+        state.log(f"test loss {test_loss:.4f} "
+                  f"({args.train.eval_iters} held-out batches)")
     if args.profile.profile:
         state.log(f"mean iter time: {profiler.filtered_time_ms():.2f} ms")
     if rerun.enabled and rerun.records:
         state.log(f"rerun report: {rerun.report()}")
-    return {"losses": losses, "iter_ms": profiler.filtered_time_ms(),
+    return {"losses": losses, "val_losses": val_losses,
+            "test_loss": test_loss, "iter_ms": profiler.filtered_time_ms(),
             "rerun": rerun.report() if rerun.enabled else None,
             "exit_code": exit_code}
 

@@ -135,15 +135,27 @@ class GPTDataset:
     seeded by (seed, e), so multi-epoch runs never repeat batch order."""
 
     def __init__(self, indexed: IndexedDataset, seq_length: int,
-                 seed: int = 1234, shuffle: bool = True):
+                 seed: int = 1234, shuffle: bool = True,
+                 doc_range: Optional[tuple] = None):
+        """``doc_range`` (lo, hi) restricts the view to a contiguous slice
+        of documents — the train/valid/test split unit (reference split
+        matrix, blended_megatron_dataset_builder.py:39). A document range is
+        a contiguous token span in the .bin stream, so sample spans built
+        from the subset's doc_lens never cross into another split."""
         self.indexed = indexed
         self.seq_length = seq_length
         self.seed = seed
         self.shuffle = shuffle
-        max_samples = max(
-            (indexed.total_tokens - 1) // seq_length, 0)
+        lo, hi = doc_range if doc_range is not None else (0, len(indexed))
+        if not (0 <= lo <= hi <= len(indexed)):
+            raise ValueError(f"doc_range {doc_range} outside "
+                             f"[0, {len(indexed)}]")
+        self._doc_lo = lo
+        doc_lens = indexed.doc_lens[lo:hi]
+        total = int(doc_lens.sum())
+        max_samples = max((total - 1) // seq_length, 0)
         self.sample_idx = build_sample_idx(
-            indexed.doc_lens, seq_length, max_samples)
+            np.ascontiguousarray(doc_lens), seq_length, max_samples)
         self._epoch = -1
         self._order = np.arange(len(self.sample_idx))
 
@@ -162,7 +174,7 @@ class GPTDataset:
         n = max(len(self), 1)
         order = self._order_for(i // n)
         doc, off = self.sample_idx[order[i % n]]
-        return self.indexed.get_span(int(doc), int(off),
+        return self.indexed.get_span(int(doc) + self._doc_lo, int(off),
                                      self.seq_length + 1).astype(np.int32)
 
 
@@ -199,25 +211,66 @@ class BlendedDataset:
         return self.datasets[d][idx]
 
 
+def split_doc_ranges(n_docs: int, split: str) -> List[tuple]:
+    """Partition ``n_docs`` documents into train/valid/test ranges by the
+    comma-separated ratio string (reference --split '969,30,1',
+    blended_megatron_dataset_builder.py:39). Ratios are normalized; a zero
+    ratio yields an empty range. Boundaries round so every doc lands in
+    exactly one split."""
+    ratios = [float(x) for x in str(split).split(",")]
+    if len(ratios) != 3 or any(r < 0 for r in ratios) or sum(ratios) <= 0:
+        raise ValueError(
+            f"data.split must be three non-negative ratios, got {split!r}")
+    total = sum(ratios)
+    bounds = [0]
+    acc = 0.0
+    for r in ratios:
+        acc += r
+        bounds.append(int(round(n_docs * acc / total)))
+    bounds[-1] = n_docs
+    return [(bounds[i], bounds[i + 1]) for i in range(3)]
+
+
 def indexed_batches(prefix_or_paths, seq_length: int, global_batch_size: int,
                     *, seed: int = 1234,
-                    weights: Optional[Sequence[float]] = None
+                    weights: Optional[Sequence[float]] = None,
+                    split: Optional[str] = None,
+                    split_index: int = 0,
+                    shuffle: bool = True,
                     ) -> Iterator[Dict[str, np.ndarray]]:
     """Batch iterator over (blended) indexed corpora matching the synthetic
-    iterator's contract (dataloader.get_data_iterator)."""
+    iterator's contract (dataloader.get_data_iterator). ``split`` +
+    ``split_index`` select the train(0)/valid(1)/test(2) document range of
+    each corpus (reference get_train_valid_test_data_iterators,
+    runtime/dataloader.py:462); evaluation streams pass ``shuffle=False``
+    so held-out loss is computed over a stable batch order."""
     from hetu_galvatron_tpu.runtime.dataloader import make_batch
 
     paths = ([prefix_or_paths] if isinstance(prefix_or_paths, str)
              else list(prefix_or_paths))
-    ds_list = [GPTDataset(IndexedDataset(p), seq_length, seed=seed)
-               for p in paths]
+    ds_list = []
+    for p in paths:
+        idx = IndexedDataset(p)
+        rng = (split_doc_ranges(len(idx), split)[split_index]
+               if split is not None else None)
+        ds_list.append(GPTDataset(idx, seq_length, seed=seed,
+                                  shuffle=shuffle, doc_range=rng))
     ds = (ds_list[0] if len(ds_list) == 1
           else BlendedDataset(ds_list, weights=weights, seed=seed))
     if len(ds) == 0:
-        raise ValueError("indexed corpus smaller than one sample")
-    i = 0
-    while True:
-        rows = [ds[i * global_batch_size + j]
-                for j in range(global_batch_size)]
-        yield make_batch(np.stack(rows))
-        i += 1
+        # raised EAGERLY (not from the generator's first next()) so callers
+        # can degrade an empty eval split before spending any training time
+        name = {0: "train", 1: "valid", 2: "test"}.get(split_index, "?")
+        raise ValueError(
+            f"indexed corpus {name} split smaller than one sample "
+            f"(split={split!r}; grow the corpus or the split ratio)")
+
+    def gen():
+        i = 0
+        while True:
+            rows = [ds[i * global_batch_size + j]
+                    for j in range(global_batch_size)]
+            yield make_batch(np.stack(rows))
+            i += 1
+
+    return gen()

@@ -114,16 +114,26 @@ def synthetic_batches(
         i += 1
 
 
+_SPLIT_INDEX = {"train": 0, "valid": 1, "test": 2}
+
+
 def get_data_iterator(
-    args: CoreArgs, *, global_batch_size: Optional[int] = None
+    args: CoreArgs, *, global_batch_size: Optional[int] = None,
+    split: str = "train",
 ) -> Iterator[Dict[str, np.ndarray]]:
-    """Entry point mirroring get_train_valid_test_data_iterators
-    (dataloader.py:462)."""
+    """One split's batch iterator (see
+    :func:`get_train_valid_test_data_iterators` for the reference-shaped
+    three-way entry point, runtime/dataloader.py:462). ``split`` selects
+    the document range by the ``data.split`` ratios for indexed corpora;
+    the synthetic dataset draws each split from a disjoint seed. Evaluation
+    splits iterate in a stable (unshuffled) order."""
     gbs = global_batch_size or args.parallel.global_train_batch_size
     data: DataArgs = args.data
     meta: Dict = {}
+    split_idx = _SPLIT_INDEX[split]
     if data.dataset == "random":
-        it = synthetic_batches(args.model, gbs, seed=args.train.seed)
+        it = synthetic_batches(args.model, gbs,
+                               seed=args.train.seed + 101 * split_idx)
     elif data.dataset == "indexed":
         from hetu_galvatron_tpu.data.indexed_dataset import indexed_batches
 
@@ -135,7 +145,9 @@ def get_data_iterator(
                 f"corpus tokenizer vocab {meta['vocab_size']} exceeds model "
                 f"padded vocab {args.model.padded_vocab_size}")
         it = indexed_batches(data.data_path, args.model.seq_length, gbs,
-                             seed=args.train.seed)
+                             seed=args.train.seed, split=data.split,
+                             split_index=split_idx,
+                             shuffle=split == "train")
         if (data.eod_mask_loss and meta.get("eod_id") is not None
                 and args.model.model_type != "bert"):
             # bert handles eod inside mlm_batches (the causal-shifted
@@ -167,6 +179,38 @@ def get_data_iterator(
     if args.model.model_type == "t5":
         return seq2seq_batches(it)
     return it
+
+
+def get_train_valid_test_data_iterators(
+    args: CoreArgs, *, global_batch_size: Optional[int] = None,
+):
+    """(train, valid, test) iterators (reference
+    get_train_valid_test_data_iterators, runtime/dataloader.py:462). The
+    eval iterators are built lazily only when train.eval_interval and
+    eval_iters are both set — an empty valid/test split must not fail a
+    training-only run."""
+    import sys
+
+    train_it = get_data_iterator(args, global_batch_size=global_batch_size,
+                                 split="train")
+    valid_it = test_it = None
+    if args.train.eval_interval and args.train.eval_iters:
+        for name in ("valid", "test"):
+            try:
+                it = get_data_iterator(
+                    args, global_batch_size=global_batch_size, split=name)
+            except ValueError as e:
+                # an undersized split must degrade eval, not crash a run
+                # after the training compute is spent (the small-corpus case
+                # under the default 969/30/1 ratios)
+                print(f"warning: {name} eval disabled: {e}",
+                      file=sys.stderr)
+                it = None
+            if name == "valid":
+                valid_it = it
+            else:
+                test_it = it
+    return train_it, valid_it, test_it
 
 
 def corpus_meta(paths) -> Dict:
