@@ -141,6 +141,41 @@ def rope_cos_sin(
     return jnp.cos(freqs).astype(dtype), jnp.sin(freqs).astype(dtype)
 
 
+def mrope_cos_sin(
+    position_ids: jax.Array,  # [3, B, S] (temporal, height, width)
+    head_dim: int, theta: float, sections, dtype=jnp.float32,
+    scaling: Optional[dict] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Multimodal rotary tables (reference
+    rotary_pos_embedding.py MultimodalRotaryEmbedding / HF Qwen2-VL mrope):
+    the D/2 frequency dims split into ``sections`` (sum = D/2); section j's
+    rotations use the j-th position row, so temporal/height/width positions
+    each drive their own frequency band. With the three rows identical this
+    reduces EXACTLY to :func:`rope_cos_sin` over those positions (the
+    text-only case — parity-tested). Returns cos/sin [B, S, D/2], the
+    gathered-per-token layout :func:`apply_rope` accepts."""
+    sections = tuple(int(s) for s in sections)
+    if sum(sections) != head_dim // 2:
+        raise ValueError(
+            f"mrope sections {sections} must sum to head_dim//2 "
+            f"= {head_dim // 2}")
+    if position_ids.ndim != 3 or position_ids.shape[0] != len(sections):
+        raise ValueError(
+            f"mrope position_ids must be [{len(sections)}, B, S], got "
+            f"{position_ids.shape}")
+    inv_freq = 1.0 / (
+        theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    )
+    inv_freq = _scale_inv_freq(inv_freq, scaling)
+    # [3, B, S, D/2]; frequency dim d draws from position row row[d]
+    freqs = position_ids.astype(jnp.float32)[..., None] * inv_freq
+    row = jnp.concatenate([
+        jnp.full((s,), j, jnp.int32) for j, s in enumerate(sections)])
+    sel = jnp.einsum("rbsd,dr->bsd", freqs,
+                     jax.nn.one_hot(row, len(sections), dtype=jnp.float32))
+    return jnp.cos(sel).astype(dtype), jnp.sin(sel).astype(dtype)
+
+
 def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
     """x: [B, S, N, D]; rotate-half convention (llama-style). cos/sin are
     [S, D/2] (positions in order) or [B, S, D/2] (gathered per-token
@@ -304,21 +339,24 @@ def apply_attention(
         k = apply_rope(k, cos, sin)
     use_dropout = dropout_rng is not None and cfg.attention_dropout > 0.0
     if use_dropout:
-        # probability dropout lives inside the attention core and no kernel
-        # path implements it (the reference's exists only inside the
-        # external CUDA flash-attn ops). Silently swapping an installed
+        # probability dropout lives inside the attention core: the XLA core
+        # and the Pallas flash kernel implement it (flash regenerates a
+        # counter-based mask per tile in fwd+bwd — the reference's CUDA
+        # flash-attn dropout variant). Silently swapping a ring/Ulysses
         # kernel for the score-materializing XLA core would be an OOM/perf
         # cliff on the long-context plans those kernels exist for — refuse.
-        if sdpa_fn is not xla_sdpa:
+        if sdpa_fn is xla_sdpa or getattr(sdpa_fn, "supports_dropout",
+                                          False):
+            out = sdpa_fn(q, k, v, causal=causal,
+                          dropout_rate=cfg.attention_dropout,
+                          dropout_rng=dropout_rng, segment_ids=segment_ids)
+        else:
             raise NotImplementedError(
                 "attention_dropout > 0 is only supported with the XLA "
-                "attention core; the installed flash/ring/Ulysses kernel "
-                "has no dropout variant. Set model.use_flash_attn=false "
-                "(and avoid cp/ulysses layers) or model.attention_dropout=0;"
-                " hidden_dropout works with every kernel")
-        out = xla_sdpa(q, k, v, causal=causal,
-                       dropout_rate=cfg.attention_dropout,
-                       dropout_rng=dropout_rng, segment_ids=segment_ids)
+                "attention core and the Pallas flash kernel; the installed "
+                "ring/Ulysses kernel has no dropout variant. Avoid "
+                "cp/ulysses layers or set model.attention_dropout=0; "
+                "hidden_dropout works with every kernel")
     elif segment_ids is not None:
         # packed-document masking: the XLA core and the Pallas flash kernel
         # implement it (flash masks per tile in-kernel); ring/Ulysses do not

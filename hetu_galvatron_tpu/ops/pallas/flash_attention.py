@@ -27,9 +27,58 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = float(jnp.finfo(jnp.float32).min)
 
 
+def keep_mask(seed, bn, qpos, kpos, s_total: int, rate: float):
+    """Deterministic counter-based dropout keep-mask (splitmix32 finalizer
+    over a per-element counter). Depends only on GLOBAL coordinates
+    (seed, batch*heads index, q position, k position), so forward/backward
+    kernels regenerate identical masks regardless of tile sizes — the same
+    property the reference gets from flash-attn's saved philox state. Plain
+    integer ops only: lowers under Mosaic AND interpret mode (pltpu.prng_*
+    has no CPU lowering), and a pure-JAX caller over full index grids is
+    the test reference. qpos/kpos are int32 arrays broadcastable to the
+    mask shape; returns bool (True = keep)."""
+    import numpy as np
+
+    # numpy scalar literals (NOT jnp arrays): closed-over jnp constants are
+    # rejected by the pallas_call lowering
+    u32 = jnp.uint32
+    c = np.uint32
+
+    def fin(x):  # splitmix32 finalizer (full avalanche)
+        x = x ^ (x >> c(16))
+        x = x * c(0x85EBCA6B)
+        x = x ^ (x >> c(13))
+        x = x * c(0xC2B2AE35)
+        return x ^ (x >> c(16))
+
+    # hash (seed, bn) into a per-head key FIRST: a linear bn*S^2 counter
+    # would wrap every 2^32/S^2 heads and hand distant heads bit-identical
+    # masks; after avalanche, head streams collide only by hash accident
+    key = fin(seed.astype(u32) * c(0x9E3779B9) + bn.astype(u32))
+    ctr = qpos.astype(u32) * c(s_total) + kpos.astype(u32)
+    x = fin(ctr ^ key)
+    keep_prob = 1.0 - rate
+    threshold = c(min(int(keep_prob * 2.0 ** 32), 2 ** 32 - 1))
+    return x < threshold
+
+
+def _tile_keep(seed_ref, bn, qi, ki, block_q: int, block_k: int,
+               s_total: int, rate: float):
+    qpos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    kpos = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    return keep_mask(seed_ref[0], bn, qpos, kpos, s_total, rate)
+
+
 def _flash_kernel(q_ref, k_ref, v_ref, *rest,
                   block_q: int, block_k: int, num_k: int, causal: bool,
-                  scale: float, has_seg: bool = False):
+                  scale: float, has_seg: bool = False,
+                  dropout_rate: float = 0.0, s_total: int = 0):
+    if dropout_rate > 0.0:
+        seed_ref, rest = rest[0], rest[1:]
+    else:
+        seed_ref = None
     if has_seg:
         qseg_ref, kseg_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref = rest
     else:
@@ -37,6 +86,10 @@ def _flash_kernel(q_ref, k_ref, v_ref, *rest,
         o_ref, lse_ref, m_ref, l_ref, acc_ref = rest
     qi = pl.program_id(2)
     ki = pl.program_id(3)
+    # flat batch*heads index for the dropout mask; program_id must be read
+    # at kernel top level (the interpret-mode executor does not rewrite it
+    # inside pl.when bodies)
+    bn = pl.program_id(0) * pl.num_programs(1) + pl.program_id(1)
 
     @pl.when(ki == 0)
     def _init():
@@ -72,7 +125,12 @@ def _flash_kernel(q_ref, k_ref, v_ref, *rest,
         p = jnp.exp(s - new_m[:, None])
         p = jnp.where(s == NEG_INF, 0.0, p)
         m_ref[...] = new_m
+        # the normalizer uses the UNdropped p: out = dropout(softmax(s)) @ v
         l_ref[...] = l_ref[...] * corr + jnp.sum(p, axis=1)
+        if dropout_rate > 0.0:
+            keep = _tile_keep(seed_ref, bn, qi, ki, block_q, block_k,
+                              s_total, dropout_rate)
+            p = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
         acc_ref[...] = acc_ref[...] * corr[:, None] + jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -89,17 +147,19 @@ def _flash_kernel(q_ref, k_ref, v_ref, *rest,
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
-                                             "interpret"))
+                                             "interpret", "dropout_rate"))
 def flash_attention_hmajor(
     q: jax.Array,  # [B, N, S, D]
     k: jax.Array,  # [B, K, S, D]
     v: jax.Array,
     segments: "jax.Array | None" = None,  # [B, S] int32 (packed docs)
+    dropout_seed: "jax.Array | None" = None,  # [1] int32 (attention dropout)
     *,
     causal: bool = True,
     block_q: int = 256,
     block_k: int = 256,
     interpret: bool = False,
+    dropout_rate: float = 0.0,
 ) -> jax.Array:
     B, N, S, D = q.shape
     K = k.shape[1]
@@ -114,12 +174,15 @@ def flash_attention_hmajor(
         raise ValueError("causal flash needs equal q/k lengths")
     if segments is not None and Sk != S:
         raise ValueError("segment masking needs equal q/k lengths")
+    if dropout_rate > 0.0 and dropout_seed is None:
+        raise ValueError("dropout_rate > 0 needs a dropout_seed")
     num_k = Sk // block_k
     grid = (B, N, S // block_q, num_k)  # k-block axis innermost
     has_seg = segments is not None
     kernel = functools.partial(
         _flash_kernel, block_q=block_q, block_k=block_k, num_k=num_k,
-        causal=causal, scale=1.0 / math.sqrt(D), has_seg=has_seg)
+        causal=causal, scale=1.0 / math.sqrt(D), has_seg=has_seg,
+        dropout_rate=dropout_rate, s_total=Sk)
     in_specs = [
         pl.BlockSpec((1, 1, block_q, D),
                      lambda b, n, qi, ki: (b, n, qi, 0)),
@@ -129,6 +192,10 @@ def flash_attention_hmajor(
                      lambda b, n, qi, ki: (b, n // G, ki, 0)),
     ]
     operands = [q, k, v]
+    if dropout_rate > 0.0:
+        # kernel unpacks the seed ref FIRST from *rest (after q/k/v)
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        operands.append(dropout_seed.astype(jnp.int32).reshape(1))
     if has_seg:
         # [B, S, 1]: trailing singleton keeps Mosaic's (8, 128)-or-equal
         # tiling rule satisfied (same layout trick as lse)
@@ -169,9 +236,14 @@ def flash_attention_hmajor(
 def _flash_bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                            *rest, block_q: int, block_k: int, num_q: int,
                            G: int, causal: bool, scale: float,
-                           has_seg: bool = False):
+                           has_seg: bool = False,
+                           dropout_rate: float = 0.0, s_total: int = 0):
     """Grid (B, KV, kb, G, qb): accumulate dk/dv for one k/v tile across the
     G query heads of this kv head and all q blocks."""
+    if dropout_rate > 0.0:
+        seed_ref, rest = rest[0], rest[1:]
+    else:
+        seed_ref = None
     if has_seg:
         qseg_ref, kseg_ref, dk_ref, dv_ref, dk_acc, dv_acc = rest
     else:
@@ -180,6 +252,8 @@ def _flash_bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     kb = pl.program_id(2)
     g = pl.program_id(3)
     qb = pl.program_id(4)
+    # flat head index n = kh*G + g (N = KV*G heads); top-level program_id
+    bn = pl.program_id(0) * (pl.num_programs(1) * G) + pl.program_id(1) * G + g
 
     @pl.when((g == 0) & (qb == 0))
     def _init():
@@ -210,11 +284,20 @@ def _flash_bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                           == kseg_ref[0, :, 0][None, :], s, NEG_INF)
         p = jnp.exp(s - lse)
         p = jnp.where(s == NEG_INF, 0.0, p)
-        dv_acc[...] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
+        pd = p
+        if dropout_rate > 0.0:
+            # mask is (qpos, kpos)-indexed; this kernel's tile is q=qb, k=kb
+            keep = _tile_keep(seed_ref, bn, qb, kb, block_q, block_k,
+                              s_total, dropout_rate)
+            pd = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
+            dp = jnp.where(keep, dp / (1.0 - dropout_rate), 0.0)
+        dv_acc[...] += jax.lax.dot_general(
+            pd, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        # delta = rowsum(dropout(P) . dP') = dO . O, so the flash delta
+        # trick survives dropout unchanged
         ds = p * (dp - delta) * scale
         dk_acc[...] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
@@ -229,8 +312,13 @@ def _flash_bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                          *rest, block_q: int, block_k: int,
                          num_k: int, causal: bool, scale: float,
-                         has_seg: bool = False):
+                         has_seg: bool = False,
+                         dropout_rate: float = 0.0, s_total: int = 0):
     """Grid (B, N, qb, kb): accumulate dq for one q tile across k blocks."""
+    if dropout_rate > 0.0:
+        seed_ref, rest = rest[0], rest[1:]
+    else:
+        seed_ref = None
     if has_seg:
         qseg_ref, kseg_ref, dq_ref, dq_acc = rest
     else:
@@ -238,6 +326,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_ref, dq_acc = rest
     qb = pl.program_id(2)
     kb = pl.program_id(3)
+    bn = pl.program_id(0) * pl.num_programs(1) + pl.program_id(1)
 
     @pl.when(kb == 0)
     def _init():
@@ -268,6 +357,10 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         p = jnp.where(s == NEG_INF, 0.0, p)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
+        if dropout_rate > 0.0:
+            keep = _tile_keep(seed_ref, bn, qb, kb,
+                              block_q, block_k, s_total, dropout_rate)
+            dp = jnp.where(keep, dp / (1.0 - dropout_rate), 0.0)
         ds = p * (dp - delta) * scale
         dq_acc[...] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
@@ -279,13 +372,14 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
-                                             "interpret"))
+                                             "interpret", "dropout_rate"))
 def flash_attention_bwd_hmajor(
-    q, k, v, o, lse, do, segments=None, *,
+    q, k, v, o, lse, do, segments=None, dropout_seed=None, *,
     causal: bool = True,
     block_q: int = 256,
     block_k: int = 256,
     interpret: bool = False,
+    dropout_rate: float = 0.0,
 ):
     """Fused flash backward (heads-major layouts): recomputes p from lse per
     tile, so nothing O(S^2) ever hits HBM. Returns (dq, dk, dv)."""
@@ -307,6 +401,11 @@ def flash_attention_bwd_hmajor(
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1,
                     keepdims=True)
 
+    if dropout_rate > 0.0 and dropout_seed is None:
+        raise ValueError("dropout_rate > 0 needs a dropout_seed")
+    seed_arr = (dropout_seed.astype(jnp.int32).reshape(1)
+                if dropout_rate > 0.0 else None)
+
     dkdv_in_specs = [
         pl.BlockSpec((1, 1, block_q, D),
                      lambda b, kh, kb, g, qb: (b, kh * G + g, qb, 0)),
@@ -322,6 +421,9 @@ def flash_attention_bwd_hmajor(
                      lambda b, kh, kb, g, qb: (b, kh * G + g, qb, 0)),
     ]
     dkdv_operands = [q, k, v, do, lse, delta]
+    if dropout_rate > 0.0:
+        dkdv_in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        dkdv_operands.append(seed_arr)
     if has_seg:
         seg3 = segments.astype(jnp.int32)[:, :, None]
         dkdv_in_specs += [
@@ -335,7 +437,8 @@ def flash_attention_bwd_hmajor(
     dkdv = pl.pallas_call(
         functools.partial(_flash_bwd_dkdv_kernel, block_q=block_q,
                           block_k=block_k, num_q=num_q, G=G, causal=causal,
-                          scale=scale, has_seg=has_seg),
+                          scale=scale, has_seg=has_seg,
+                          dropout_rate=dropout_rate, s_total=Sk),
         grid=(B, KV, num_k, G, num_q),
         in_specs=dkdv_in_specs,
         out_specs=[
@@ -374,6 +477,9 @@ def flash_attention_bwd_hmajor(
                      lambda b, n, qb, kb: (b, n, qb, 0)),
     ]
     dq_operands = [q, k, v, do, lse, delta]
+    if dropout_rate > 0.0:
+        dq_in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        dq_operands.append(seed_arr)
     if has_seg:
         dq_in_specs += [
             pl.BlockSpec((1, block_q, 1), lambda b, n, qb, kb: (b, qb, 0)),
@@ -383,7 +489,8 @@ def flash_attention_bwd_hmajor(
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, block_q=block_q,
                           block_k=block_k, num_k=num_k, causal=causal,
-                          scale=scale, has_seg=has_seg),
+                          scale=scale, has_seg=has_seg,
+                          dropout_rate=dropout_rate, s_total=Sk),
         grid=(B, N, num_q, num_k),
         in_specs=dq_in_specs,
         out_specs=pl.BlockSpec((1, 1, block_q, D),
@@ -417,43 +524,56 @@ def fit_block(default: int, seq: int, floor: int = 128) -> int:
     return 0
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
-def _flash_with_vjp(q, k, v, segments, causal, interpret, block_q, block_k):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _flash_with_vjp(q, k, v, segments, dropout_seed, causal, interpret,
+                    block_q, block_k, dropout_rate):
     qh = q.transpose(0, 2, 1, 3)
     kh = k.transpose(0, 2, 1, 3)
     vh = v.transpose(0, 2, 1, 3)
-    out, _ = flash_attention_hmajor(qh, kh, vh, segments, causal=causal,
-                                    interpret=interpret,
-                                    block_q=block_q, block_k=block_k)
+    out, _ = flash_attention_hmajor(qh, kh, vh, segments, dropout_seed,
+                                    causal=causal, interpret=interpret,
+                                    block_q=block_q, block_k=block_k,
+                                    dropout_rate=dropout_rate)
     return out.transpose(0, 2, 1, 3)
 
 
-def _flash_fwd(q, k, v, segments, causal, interpret, block_q, block_k):
+def _flash_fwd(q, k, v, segments, dropout_seed, causal, interpret, block_q,
+               block_k, dropout_rate):
     qh = q.transpose(0, 2, 1, 3)
     kh = k.transpose(0, 2, 1, 3)
     vh = v.transpose(0, 2, 1, 3)
-    out, lse = flash_attention_hmajor(qh, kh, vh, segments, causal=causal,
-                                      interpret=interpret,
-                                      block_q=block_q, block_k=block_k)
-    return out.transpose(0, 2, 1, 3), (qh, kh, vh, out, lse, segments)
+    out, lse = flash_attention_hmajor(qh, kh, vh, segments, dropout_seed,
+                                      causal=causal, interpret=interpret,
+                                      block_q=block_q, block_k=block_k,
+                                      dropout_rate=dropout_rate)
+    return (out.transpose(0, 2, 1, 3),
+            (qh, kh, vh, out, lse, segments, dropout_seed))
 
 
-def _flash_bwd(causal, interpret, block_q, block_k, res, g):
-    qh, kh, vh, out, lse, segments = res
+def _flash_bwd(causal, interpret, block_q, block_k, dropout_rate, res, g):
+    qh, kh, vh, out, lse, segments, dropout_seed = res
     dq, dk, dv = flash_attention_bwd_hmajor(
         qh, kh, vh, out, lse, g.transpose(0, 2, 1, 3), segments,
-        causal=causal, interpret=interpret,
-        block_q=block_q, block_k=block_k)
+        dropout_seed, causal=causal, interpret=interpret,
+        block_q=block_q, block_k=block_k, dropout_rate=dropout_rate)
     return (dq.transpose(0, 2, 1, 3), dk.transpose(0, 2, 1, 3),
-            dv.transpose(0, 2, 1, 3), None)  # int segments: no cotangent
+            dv.transpose(0, 2, 1, 3), None, None)  # int operands: no cotan
 
 
 _flash_with_vjp.defvjp(_flash_fwd, _flash_bwd)
 
 
+def seed_from_key(rng: jax.Array) -> jax.Array:
+    """Fold a jax PRNG key into the [1] int32 seed the kernel's
+    counter-based mask consumes."""
+    return jax.random.randint(rng, (1,), 0, jnp.iinfo(jnp.int32).max,
+                              dtype=jnp.int32)
+
+
 def flash_sdpa(q, k, v, *, causal: bool = True, interpret: bool = False,
                block_q: int | None = None, block_k: int | None = None,
-               segment_ids=None):
+               segment_ids=None, dropout_rate: float = 0.0,
+               dropout_rng=None):
     """Drop-in sdpa_fn for modules.apply_attention: [B, S, N, D] layout in
     and out; fully differentiable — forward and backward both run as fused
     Pallas kernels (backward recomputes p per tile from the saved
@@ -463,16 +583,31 @@ def flash_sdpa(q, k, v, *, causal: bool = True, interpret: bool = False,
     samples (reference reset_attention_mask) inside the kernel — packed
     pretraining keeps flash speed instead of falling back to the dense core.
 
+    ``dropout_rate`` > 0 (+ ``dropout_rng``) applies attention-probability
+    dropout in-kernel via a counter-based mask over global (head, qpos,
+    kpos) — the reference's flash-attn dropout variant. The mask derives
+    from the key, not from jax.random's threefry, so flash-dropout
+    trajectories are deterministic per seed but not bit-equal to the XLA
+    core's (the reference's CUDA kernel has the same property vs torch).
+
     Block defaults are clamped to divisors of S (e.g. S=768 runs 256-wide
     k blocks even though the tuned default is 512)."""
     S = q.shape[1]
-    return _flash_with_vjp(q, k, v, segment_ids, causal, interpret,
+    seed = None
+    if dropout_rate > 0.0:
+        if dropout_rng is None:
+            raise ValueError("flash dropout_rate > 0 needs dropout_rng")
+        seed = seed_from_key(dropout_rng)
+    return _flash_with_vjp(q, k, v, segment_ids, seed, causal, interpret,
                            block_q or fit_block(DEFAULT_BLOCK_Q, S) or S,
-                           block_k or fit_block(DEFAULT_BLOCK_K, S) or S)
+                           block_k or fit_block(DEFAULT_BLOCK_K, S) or S,
+                           dropout_rate)
 
 
 # the fwd + both bwd kernels mask cross-document tiles in-kernel
 flash_sdpa.supports_segments = True
+# in-kernel counter-based attention dropout (fwd + bwd regenerate the mask)
+flash_sdpa.supports_dropout = True
 
 
 def make_flash_sdpa(mesh, dp_axes=(), tp_axes=(), *, interpret: bool = False):
@@ -481,15 +616,26 @@ def make_flash_sdpa(mesh, dp_axes=(), tp_axes=(), *, interpret: bool = False):
     heads over tp, sequence local (attention needs the full sequence; cp
     layers use ring attention instead). Grad flows through the fused VJP
     inside the shard_map. ``segment_ids`` [B, S] ride as an extra batch-
-    sharded operand so packed documents keep flash speed under SPMD."""
+    sharded operand so packed documents keep flash speed under SPMD.
+    ``dropout_rate`` > 0 runs the in-kernel counter-based dropout; each
+    shard folds its (dp, tp) mesh coordinates into the seed so masks
+    decorrelate across the sharded batch/head dims."""
     from jax.sharding import PartitionSpec as P
 
     import jax
 
     spec = P(dp_axes or None, None, tp_axes or None, None)
     seg_spec = P(dp_axes or None, None)
+    seed_spec = P()
 
-    def sdpa(q, k, v, *, causal=True, segment_ids=None):
+    def _shard_seed(seed):
+        idx = jnp.int32(0)
+        for ax in tuple(dp_axes) + tuple(tp_axes):
+            idx = idx * jnp.int32(mesh.shape[ax]) + jax.lax.axis_index(ax)
+        return seed + idx * jnp.int32(-1640531527)  # 2654435761 as int32
+
+    def sdpa(q, k, v, *, causal=True, segment_ids=None,
+             dropout_rate: float = 0.0, dropout_rng=None):
         S = q.shape[1]
         bq = fit_block(DEFAULT_BLOCK_Q, S)
         bk = fit_block(DEFAULT_BLOCK_K, S)
@@ -498,21 +644,37 @@ def make_flash_sdpa(mesh, dp_axes=(), tp_axes=(), *, interpret: bool = False):
         if not bq or not bk or k.shape[1] != S:
             from hetu_galvatron_tpu.models.modules import xla_sdpa
 
-            return xla_sdpa(q, k, v, causal=causal, segment_ids=segment_ids)
-        # nondiff args of a custom_vjp must stay positional
-        if segment_ids is None:
-            fn = jax.shard_map(
-                lambda a, b, c: _flash_with_vjp(a, b, c, None, causal,
-                                                interpret, bq, bk),
-                mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-                check_vma=False)
-            return fn(q, k, v)
-        fn = jax.shard_map(
-            lambda a, b, c, s: _flash_with_vjp(a, b, c, s, causal,
-                                               interpret, bq, bk),
-            mesh=mesh, in_specs=(spec, spec, spec, seg_spec), out_specs=spec,
-            check_vma=False)
-        return fn(q, k, v, segment_ids)
+            return xla_sdpa(q, k, v, causal=causal, segment_ids=segment_ids,
+                            dropout_rate=dropout_rate,
+                            dropout_rng=dropout_rng)
+        seed = None
+        if dropout_rate > 0.0:
+            if dropout_rng is None:
+                raise ValueError("flash dropout_rate > 0 needs dropout_rng")
+            seed = seed_from_key(dropout_rng)
+
+        # one shard_map over a dynamic operand list; the optional operands
+        # are rebuilt into keywords inside (custom_vjp args stay positional)
+        has_seg, has_seed = segment_ids is not None, seed is not None
+        in_specs = [spec, spec, spec]
+        operands = [q, k, v]
+        if has_seg:
+            in_specs.append(seg_spec)
+            operands.append(segment_ids)
+        if has_seed:
+            in_specs.append(seed_spec)
+            operands.append(seed)
+
+        def local(a, b, c, *rest):
+            s = rest[0] if has_seg else None
+            sd = _shard_seed(rest[-1]) if has_seed else None
+            return _flash_with_vjp(a, b, c, s, sd, causal, interpret,
+                                   bq, bk, dropout_rate)
+
+        fn = jax.shard_map(local, mesh=mesh, in_specs=tuple(in_specs),
+                           out_specs=spec, check_vma=False)
+        return fn(*operands)
 
     sdpa.supports_segments = True
+    sdpa.supports_dropout = True
     return sdpa

@@ -188,3 +188,136 @@ def test_distributed_flash_matches_dense(cpu_devices):
     for a, b in zip(g_ref, g_out):
         np.testing.assert_allclose(np.asarray(b), np.asarray(a),
                                    rtol=5e-5, atol=5e-5)
+
+
+# ---------------------------------------------------------------------------
+# in-kernel attention dropout (counter-based mask over global coordinates;
+# the reference's flash-attn dropout variant). keep_mask is pure jnp, so a
+# dense reference applying the EXACT same mask verifies fwd + bwd bitwise
+# (up to fp tolerance) — stronger than a statistical check.
+# ---------------------------------------------------------------------------
+
+
+def _ref_dropout_attn(q, k, v, seed, rate, causal=True):
+    """Dense attention with the kernel's exact dropout mask."""
+    import math
+
+    from hetu_galvatron_tpu.ops.pallas.flash_attention import keep_mask
+
+    B, S, N, D = q.shape
+    K = k.shape[2]
+    G = N // K
+    qg = q.reshape(B, S, K, G, D)
+    s = jnp.einsum("bskgd,btkd->bkgst", qg, k,
+                   preferred_element_type=jnp.float32) / math.sqrt(D)
+    if causal:
+        s = jnp.where(jnp.arange(S)[:, None] >= jnp.arange(S)[None, :], s,
+                      jnp.finfo(jnp.float32).min)
+    p = jax.nn.softmax(s, axis=-1)
+    bn = (jnp.arange(B)[:, None] * N
+          + jnp.arange(N)[None, :])  # flat head index n = kh*G + g
+    keep = keep_mask(seed[0], bn[:, :, None, None],
+                     jnp.arange(S)[None, None, :, None],
+                     jnp.arange(S)[None, None, None, :], S, rate)
+    keep = keep.reshape(B, K, G, S, S)
+    p = jnp.where(keep, p / (1.0 - rate), 0.0)
+    out = jnp.einsum("bkgst,btkd->bskgd", p, v,
+                     preferred_element_type=jnp.float32)
+    return out.reshape(B, S, N, D).astype(q.dtype)
+
+
+def test_flash_dropout_matches_masked_dense():
+    from hetu_galvatron_tpu.ops.pallas.flash_attention import seed_from_key
+
+    q, k, v = _qkv(S=64, D=16)
+    rng = jax.random.key(5)
+    seed = seed_from_key(rng)
+    ref = _ref_dropout_attn(q, k, v, seed, 0.2)
+    out = flash_sdpa(q, k, v, causal=True, interpret=True,
+                     dropout_rate=0.2, dropout_rng=rng)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_flash_dropout_gradients_match_masked_dense():
+    from hetu_galvatron_tpu.ops.pallas.flash_attention import seed_from_key
+
+    q, k, v = _qkv(S=32, N=4, K=2, D=16)  # GQA
+    rng = jax.random.key(11)
+    seed = seed_from_key(rng)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(_ref_dropout_attn(q, k, v, seed, 0.3) ** 2)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_sdpa(q, k, v, causal=True, interpret=True,
+                                  dropout_rate=0.3, dropout_rng=rng) ** 2)
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    g_out = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ref, g_out):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=5e-5, atol=5e-5)
+
+
+def test_flash_dropout_block_size_invariant():
+    """The mask hashes GLOBAL coordinates, so different tilings drop the
+    same entries."""
+    q, k, v = _qkv(S=64, D=16)
+    rng = jax.random.key(3)
+    a = flash_sdpa(q, k, v, interpret=True, dropout_rate=0.25,
+                   dropout_rng=rng, block_q=16, block_k=32)
+    b = flash_sdpa(q, k, v, interpret=True, dropout_rate=0.25,
+                   dropout_rng=rng, block_q=32, block_k=16)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_flash_dropout_statistics_and_zero_rate():
+    from hetu_galvatron_tpu.ops.pallas.flash_attention import keep_mask
+
+    # empirical keep fraction over a large grid ~ 1 - rate
+    bn = jnp.zeros((1,), jnp.int32)
+    m = keep_mask(jnp.int32(123), bn, jnp.arange(512)[:, None],
+                  jnp.arange(512)[None, :], 512, 0.3)
+    frac = float(jnp.mean(m.astype(jnp.float32)))
+    assert abs(frac - 0.7) < 0.01, frac
+    # rate 0 == no dropout path
+    q, k, v = _qkv(S=32, D=16)
+    a = flash_sdpa(q, k, v, interpret=True)
+    b = flash_sdpa(q, k, v, interpret=True, dropout_rate=0.0,
+                   dropout_rng=jax.random.key(0))
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_apply_attention_flash_dropout_dispatch(cpu_devices):
+    """modules.apply_attention routes attention_dropout through a
+    dropout-capable kernel instead of refusing (ring still refuses)."""
+    from jax.sharding import Mesh
+
+    from hetu_galvatron_tpu.core.args_schema import ModelArgs
+    from hetu_galvatron_tpu.models import modules as M
+    from hetu_galvatron_tpu.ops.ring_attention import make_ring_sdpa
+
+    cfg = ModelArgs(
+        hidden_size=32, num_hidden_layers=2, num_attention_heads=2,
+        vocab_size=64, max_position_embeddings=64, seq_length=32,
+        attention_dropout=0.2, hidden_act="swiglu", normalization="rmsnorm",
+        position_embedding_type="rope", add_bias_linear=False,
+        add_qkv_bias=False, make_vocab_size_divisible_by=1)
+    p, _ = M.init_attention(jax.random.key(0), cfg)
+    x = jax.random.normal(jax.random.key(1), (2, 32, 32), jnp.float32)
+
+    def flash_interp(qq, kk, vv, **kw):
+        return flash_sdpa(qq, kk, vv, interpret=True, **kw)
+
+    flash_interp.supports_dropout = True
+    out = M.apply_attention(p, x, cfg, sdpa_fn=flash_interp,
+                            compute_dtype=jnp.float32,
+                            dropout_rng=jax.random.key(2))
+    assert np.all(np.isfinite(np.asarray(out)))
+    ring = make_ring_sdpa(Mesh(np.array(cpu_devices[:2]), ("c",)), ("c",))
+    with pytest.raises(NotImplementedError, match="ring"):
+        M.apply_attention(p, x, cfg, sdpa_fn=ring,
+                          compute_dtype=jnp.float32,
+                          dropout_rng=jax.random.key(2))
