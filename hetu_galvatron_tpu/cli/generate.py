@@ -122,6 +122,21 @@ def main(argv=None) -> int:
     # the (kv) head counts. Explicit DEGREE overrides win (other parallel.*
     # keys like mixed_precision must not force a dp-sharded plan onto a
     # batch of one).
+    if cfg.model_type == "t5":
+        # seq2seq: the prompt is the ENCODER source; decode starts from the
+        # start token (HF T5 uses pad id 0). Single-device path — the spmd
+        # generate wrapper is causal-only.
+        from hetu_galvatron_tpu.models.generate import generate_encdec
+
+        out = jax.jit(lambda p, t, k: generate_encdec(
+            p, t, cfg, n_new, key=k, **gen_kwargs))(params, prompt, key)
+        new_ids = np.asarray(out)[0, 1:].tolist()  # strip the start token
+        eod = getattr(tok, "eod_id", None)
+        if eod is not None and eod in new_ids:
+            new_ids = new_ids[:new_ids.index(eod)]
+        print(tok.decode(new_ids))
+        return 0
+
     world = len(jax.devices())
     degree_keys = ("parallel.global_tp_deg", "parallel.pp_deg",
                    "parallel.global_cp_deg", "parallel.global_ep_deg",

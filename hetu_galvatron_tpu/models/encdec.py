@@ -54,6 +54,22 @@ def init_cross_attention(key: jax.Array, cfg: ModelArgs) -> Tuple[Params, Params
     return p, a
 
 
+def cross_kv(p: Params, memory: jax.Array, cfg: ModelArgs,
+             compute_dtype=jnp.bfloat16) -> Tuple[jax.Array, jax.Array]:
+    """Project the encoder memory to cross-attention (k, v) [B, S, Nkv, D].
+    Decode caches this once per layer (the memory never changes during
+    generation) instead of re-projecting every step."""
+    nkv, hd = cfg.kv_heads, cfg.head_dim
+    kv = jnp.einsum("bsh,hf->bsf", memory.astype(compute_dtype),
+                    p["wkv"].astype(compute_dtype),
+                    preferred_element_type=jnp.float32)
+    if "bkv" in p:
+        kv = kv + p["bkv"]
+    k, v = jnp.split(kv.astype(compute_dtype), 2, axis=-1)
+    S = memory.shape[1]
+    return k.reshape(-1, S, nkv, hd), v.reshape(-1, S, nkv, hd)
+
+
 def apply_cross_attention(
     p: Params,
     x: jax.Array,       # decoder stream [B, T, H]
@@ -62,24 +78,19 @@ def apply_cross_attention(
     sdpa_fn: Callable[..., jax.Array] = M.xla_sdpa,
     compute_dtype=jnp.bfloat16,
     dropout_rng=None,
+    cached_kv: Optional[Tuple[jax.Array, jax.Array]] = None,
 ) -> jax.Array:
     B, T, H = x.shape
     hd = cfg.head_dim
-    nq, nkv = cfg.num_attention_heads, cfg.kv_heads
+    nq = cfg.num_attention_heads
     q = jnp.einsum("bth,hf->btf", x.astype(compute_dtype),
                    p["wq"].astype(compute_dtype),
                    preferred_element_type=jnp.float32)
     if "bq" in p:
         q = q + p["bq"]
-    kv = jnp.einsum("bsh,hf->bsf", memory.astype(compute_dtype),
-                    p["wkv"].astype(compute_dtype),
-                    preferred_element_type=jnp.float32)
-    if "bkv" in p:
-        kv = kv + p["bkv"]
     q = q.astype(compute_dtype).reshape(B, T, nq, hd)
-    k, v = jnp.split(kv.astype(compute_dtype), 2, axis=-1)
-    k = k.reshape(B, memory.shape[1], nkv, hd)
-    v = v.reshape(B, memory.shape[1], nkv, hd)
+    k, v = (cached_kv if cached_kv is not None
+            else cross_kv(p, memory, cfg, compute_dtype))
     # decoder sees the whole source; probability dropout mirrors
     # modules.apply_attention (HF T5Attention drops attention weights in
     # BOTH self- and cross-attention)
@@ -128,6 +139,7 @@ def apply_cross_decoder_layer(
     cross_sdpa_fn: Optional[Callable[..., jax.Array]] = None,
     compute_dtype=jnp.bfloat16,
     dropout_rng=None,
+    cached_cross_kv: Optional[Tuple[jax.Array, jax.Array]] = None,
 ) -> jax.Array:
     """Pre-norm: causal self-attention -> cross-attention -> MLP.
 
@@ -152,7 +164,8 @@ def apply_cross_decoder_layer(
     x = x + drop_h(apply_cross_attention(p["cross"], h, memory, cfg,
                                          sdpa_fn=cross_sdpa_fn or sdpa_fn,
                                          compute_dtype=compute_dtype,
-                                         dropout_rng=r_xattn), r2)
+                                         dropout_rng=r_xattn,
+                                         cached_kv=cached_cross_kv), r2)
     h = M.apply_norm(p["ln2"], x, cfg)
     x = x + drop_h(M.apply_mlp(p["mlp"], h, cfg,
                                compute_dtype=compute_dtype), r3)
@@ -189,6 +202,23 @@ def init_encdec(key: jax.Array, cfg: ModelArgs) -> Tuple[Params, Params]:
         "head": head_a,
     }
     return params, axes
+
+
+def encode(params: Params, enc_tokens: jax.Array, cfg: ModelArgs, *,
+           compute_dtype=jnp.bfloat16) -> jax.Array:
+    """Encoder-only forward -> memory [B, S, H] (the encoder runs ONCE per
+    generation; decode steps reuse the memory via cached cross k/v)."""
+    rope_enc = None
+    if cfg.position_embedding_type == "rope":
+        rope_enc = M.rope_cos_sin(enc_tokens.shape[1], cfg.head_dim,
+                                  cfg.rope_theta, scaling=cfg.rope_scaling)
+    mem = M.apply_embedding(params["embed"], enc_tokens, cfg,
+                            compute_dtype=compute_dtype)
+    for lp in params["enc_layers"]:
+        mem = M.apply_decoder_layer(lp, mem, cfg, rope=rope_enc,
+                                    compute_dtype=compute_dtype,
+                                    causal=False)
+    return M.apply_norm(params["enc_norm"], mem, cfg)
 
 
 def forward_encdec(

@@ -14,8 +14,9 @@ same hook the distributed layer uses for flash/ring/Ulysses attention, so
 any change to the block stays in one place.
 
 Scope: dense causal decoder families (gpt/llama/qwen/mistral: pre-norm,
-learned or rope positions, GQA, biases). MoE and encoder-decoder decode are
-out of scope here.
+learned or rope positions, GQA, biases) via generate(), plus t5-style
+encoder-decoder decode via generate_encdec() (encoder once, cached cross
+k/v). MoE decode is out of scope here.
 """
 
 from __future__ import annotations
@@ -35,7 +36,9 @@ def _check_supported(cfg: ModelArgs, params: Params) -> None:
     if cfg.post_norm or cfg.model_type == "bert":
         raise NotImplementedError("generate(): causal decoder families only")
     if cfg.model_type == "t5":
-        raise NotImplementedError("generate(): t5 decode not implemented")
+        raise NotImplementedError(
+            "generate() is the causal-decoder path; use generate_encdec() "
+            "for t5 (encoder once + cached cross-attention decode)")
     if any("moe" in lp for lp in params["layers"]):
         raise NotImplementedError("generate(): dense layers only")
 
@@ -168,20 +171,7 @@ def generate(
 
     cache, logits = prefill(params, tokens, cfg, total,
                             compute_dtype=compute_dtype)
-    # vocab-padding columns (padded_vocab_size > vocab_size) hold untrained
-    # head weights: never sample them
-    valid = jnp.arange(cfg.padded_vocab_size) < cfg.vocab_size
-
-    def pick(logits, k):
-        logits = jnp.where(valid, logits, jnp.finfo(logits.dtype).min)
-        if temperature <= 0.0:
-            return jnp.argmax(logits, axis=-1).astype(tokens.dtype)
-        logits = logits / temperature
-        if top_k:
-            kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
-            logits = jnp.where(logits < kth,
-                               jnp.finfo(logits.dtype).min, logits)
-        return jax.random.categorical(k, logits, axis=-1).astype(tokens.dtype)
+    pick = _sample_pick(cfg, tokens.dtype, temperature, top_k)
 
     def body(carry, _):
         cache, logits, pos, done, k = carry
@@ -200,3 +190,177 @@ def generate(
         body, (cache, logits, jnp.int32(S0), done0, key), None,
         length=max_new_tokens)
     return jnp.concatenate([tokens, toks.T], axis=1)
+
+
+# ---------------------------------------------------------------------------
+# encoder-decoder (t5) decode: encoder once + cached cross-attention k/v +
+# cached causal self-attention (reference ships only inference-context stubs,
+# transformer/attention.py inference params). NOTE: this runtime is
+# position-scheme agnostic (no T5 relative bias — models/encdec.py docstring
+# + the HF converter note, runtime/checkpoint.py _t5_hf_to_params), so
+# imported HF T5 weights fine-tune rather than bit-match HF generation; the
+# decode contract tested instead is incremental == full teacher-forced
+# forward (tests/models/test_t5.py).
+# ---------------------------------------------------------------------------
+
+
+def _sample_pick(cfg, tokens_dtype, temperature, top_k):
+    """Per-step token selection shared by the causal and encoder-decoder
+    decode loops: greedy / temperature / top-k, with the vocab-padding
+    columns (untrained head rows) never sampled."""
+    valid = jnp.arange(cfg.padded_vocab_size) < cfg.vocab_size
+
+    def pick(logits, k):
+        logits = jnp.where(valid, logits, jnp.finfo(logits.dtype).min)
+        if temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1).astype(tokens_dtype)
+        logits = logits / temperature
+        if top_k:
+            kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
+            logits = jnp.where(logits < kth,
+                               jnp.finfo(logits.dtype).min, logits)
+        return jax.random.categorical(k, logits, axis=-1).astype(tokens_dtype)
+
+    return pick
+
+
+def prefill_encdec(params: Params, mem: jax.Array, dec_tokens: jax.Array,
+                   cfg: ModelArgs, max_len: int, *,
+                   compute_dtype=jnp.bfloat16):
+    """Decoder prefill over the start tokens against encoder memory ``mem``:
+    fills the self-attention cache, projects + caches the cross k/v once
+    per layer. Returns (cache, cross_cache, logits_last [B, V])."""
+    from hetu_galvatron_tpu.models.encdec import (
+        apply_cross_decoder_layer,
+        cross_kv,
+    )
+
+    B, T0 = dec_tokens.shape
+    rope = None
+    if cfg.position_embedding_type == "rope":
+        rope = M.rope_cos_sin(T0, cfg.head_dim, cfg.rope_theta,
+                              scaling=cfg.rope_scaling)
+    cache = init_kv_cache(cfg, B, max_len, compute_dtype)
+    cross = [cross_kv(lp["cross"], mem, cfg, compute_dtype)
+             for lp in params["layers"]]
+    x = M.apply_embedding(params["embed"], dec_tokens, cfg,
+                          compute_dtype=compute_dtype)
+    for i, lp in enumerate(params["layers"]):
+        cell = {}
+
+        def sdpa(q, k, v, *, causal=True, cell=cell):
+            cell["k"], cell["v"] = k, v  # rope-applied, pre-attention
+            return M.xla_sdpa(q, k, v, causal=causal)
+
+        x = apply_cross_decoder_layer(lp, x, mem, cfg, rope=rope,
+                                      sdpa_fn=sdpa,
+                                      cross_sdpa_fn=M.xla_sdpa,
+                                      compute_dtype=compute_dtype,
+                                      cached_cross_kv=cross[i])
+        cache[i] = {
+            "k": jax.lax.dynamic_update_slice_in_dim(
+                cache[i]["k"], cell["k"].astype(cache[i]["k"].dtype), 0,
+                axis=1),
+            "v": jax.lax.dynamic_update_slice_in_dim(
+                cache[i]["v"], cell["v"].astype(cache[i]["v"].dtype), 0,
+                axis=1),
+        }
+    x = M.apply_norm(params["prenorm"], x, cfg)
+    logits = M.apply_lm_head(params["head"], x[:, -1:], cfg,
+                             wte=params["embed"]["wte"],
+                             compute_dtype=compute_dtype)
+    return cache, cross, logits[:, 0]
+
+
+def decode_step_encdec(params: Params, cache, cross, mem, tokens: jax.Array,
+                       pos, cfg: ModelArgs, *, rope_full=None,
+                       compute_dtype=jnp.bfloat16):
+    """One decoder token at absolute position ``pos``: cached causal
+    self-attention + cached cross k/v. Returns (cache, logits [B, V])."""
+    from hetu_galvatron_tpu.models.encdec import apply_cross_decoder_layer
+
+    x = _embed_at(params["embed"], tokens, pos, cfg, compute_dtype)
+    step_rope = None
+    if rope_full is not None:
+        cos, sin = rope_full
+        step_rope = (jax.lax.dynamic_slice_in_dim(cos, pos, 1),
+                     jax.lax.dynamic_slice_in_dim(sin, pos, 1))
+    for i, lp in enumerate(params["layers"]):
+        cell = {}
+
+        def sdpa(q, k, v, *, causal=True, i=i, cell=cell):
+            ck = jax.lax.dynamic_update_slice_in_dim(
+                cache[i]["k"], k.astype(cache[i]["k"].dtype), pos, axis=1)
+            cv = jax.lax.dynamic_update_slice_in_dim(
+                cache[i]["v"], v.astype(cache[i]["v"].dtype), pos, axis=1)
+            cell["k"], cell["v"] = ck, cv
+            return _cached_sdpa(q, ck, cv, pos)
+
+        x = apply_cross_decoder_layer(lp, x, mem, cfg, rope=step_rope,
+                                      sdpa_fn=sdpa,
+                                      cross_sdpa_fn=M.xla_sdpa,
+                                      compute_dtype=compute_dtype,
+                                      cached_cross_kv=cross[i])
+        cache[i] = {"k": cell["k"], "v": cell["v"]}
+    x = M.apply_norm(params["prenorm"], x, cfg)
+    logits = M.apply_lm_head(params["head"], x, cfg,
+                             wte=params["embed"]["wte"],
+                             compute_dtype=compute_dtype)
+    return cache, logits[:, 0]
+
+
+def generate_encdec(
+    params: Params,
+    enc_tokens: jax.Array,  # [B, S] source sequence
+    cfg: ModelArgs,
+    max_new_tokens: int,
+    *,
+    decoder_start_token_id: int = 0,
+    temperature: float = 0.0,  # 0 => greedy
+    top_k: Optional[int] = None,
+    eos_id: Optional[int] = None,
+    key: Optional[jax.Array] = None,
+    compute_dtype=jnp.bfloat16,
+) -> jax.Array:
+    """Seq2seq generation: encoder ONCE, then a `lax.scan` decode loop with
+    cached self-attention k/v and per-layer cached cross k/v. Returns the
+    decoder tokens [B, 1 + max_new_tokens] (start token included). Fully
+    jittable (static shapes)."""
+    from hetu_galvatron_tpu.models.encdec import encode
+
+    if cfg.model_type != "t5":
+        raise ValueError("generate_encdec() is the t5/encoder-decoder path")
+    B = enc_tokens.shape[0]
+    total = 1 + max_new_tokens
+    if total > cfg.max_position_embeddings and "wpe" in params["embed"]:
+        raise ValueError(f"{total} exceeds max_position_embeddings")
+    rope_full = None
+    if cfg.position_embedding_type == "rope":
+        rope_full = M.rope_cos_sin(total, cfg.head_dim, cfg.rope_theta,
+                                   scaling=cfg.rope_scaling)
+    if key is None:
+        key = jax.random.key(0)
+
+    mem = encode(params, enc_tokens, cfg, compute_dtype=compute_dtype)
+    start = jnp.full((B, 1), decoder_start_token_id, jnp.int32)
+    cache, cross, logits = prefill_encdec(params, mem, start, cfg, total,
+                                          compute_dtype=compute_dtype)
+    pick = _sample_pick(cfg, start.dtype, temperature, top_k)
+
+    def body(carry, _):
+        cache, logits, pos, done, k = carry
+        k, sub = jax.random.split(k)
+        nxt = pick(logits, sub)
+        if eos_id is not None:
+            nxt = jnp.where(done, jnp.asarray(eos_id, nxt.dtype), nxt)
+            done = done | (nxt == eos_id)
+        cache, logits = decode_step_encdec(
+            params, cache, cross, mem, nxt, pos, cfg,
+            rope_full=rope_full, compute_dtype=compute_dtype)
+        return (cache, logits, pos + 1, done, k), nxt
+
+    done0 = jnp.zeros((B,), bool)
+    (_, _, _, _, _), toks = jax.lax.scan(
+        body, (cache, logits, jnp.int32(1), done0, key), None,
+        length=max_new_tokens)
+    return jnp.concatenate([start, toks.T], axis=1)
