@@ -50,6 +50,11 @@ class ModelArgs(BaseModel):
     # "original_max_position_embeddings"} — llama-3.1+ checkpoints need it
     # for >8k contexts (BASELINE milestone 5)
     rope_scaling: Optional[Dict[str, Any]] = None
+    # multimodal rope (qwen2-vl style; reference rotary_pos_embedding.py):
+    # the head_dim//2 frequency dims split into per-axis sections
+    # (temporal, height, width); batches supply "mrope_position_ids"
+    # [3, B, S]. Text-only inputs reduce exactly to standard rope.
+    mrope_section: Optional[List[int]] = None
     tie_word_embeddings: bool = True
     use_flash_attn: bool = True
     # Pallas fused CE kernel for the single-device loss path (distributed

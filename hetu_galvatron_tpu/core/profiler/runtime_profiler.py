@@ -154,9 +154,25 @@ class RuntimeProfiler:
             bits.append(f"lr {lr:.3e}")
         if self.time_samples:
             bits.append(f"iter-time {self.time_samples[-1]:.1f}ms")
+        printing = (self.rank == 0 and self.args.logging.log_interval
+                    and it % self.args.logging.log_interval == 0)
+        if "moe" in metrics and printing:
+            # per-layer balance tracker (reference moe_utils.py:608-644
+            # track_moe_metrics log lines): aux/z-loss per MoE layer plus
+            # the tokens-per-expert imbalance max/mean. Formatted only when
+            # the line prints — float()/asarray() are blocking
+            # device-to-host syncs that must not tax every iteration
+            import numpy as _np
+
+            for name in sorted(metrics["moe"]):
+                st = metrics["moe"][name]
+                tpe = _np.asarray(st["tokens_per_expert"], dtype=float)
+                imb = float(tpe.max() / max(tpe.mean(), 1e-9))
+                bits.append(
+                    f"moe[{name}] aux {float(st['load_balance_loss']):.3e} "
+                    f"z {float(st['z_loss']):.3e} imb {imb:.2f}")
         line = " | ".join(bits)
-        if self.rank == 0 and self.args.logging.log_interval and \
-                it % self.args.logging.log_interval == 0:
+        if printing:
             print(line, flush=True)
         return line
 

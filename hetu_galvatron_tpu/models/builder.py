@@ -97,6 +97,7 @@ def forward_causal_lm(
     dropout_rng: Optional[jax.Array] = None,
     position_ids: Optional[jax.Array] = None,
     segment_ids: Optional[jax.Array] = None,
+    mrope_position_ids: Optional[jax.Array] = None,
 ) -> jax.Array:
     """tokens [B, S] -> logits [B, S, V].
 
@@ -122,7 +123,21 @@ def forward_causal_lm(
 
     S = tokens.shape[1]
     rope = None
-    if cfg.position_embedding_type == "rope":
+    if cfg.position_embedding_type == "rope" and cfg.mrope_section:
+        # multimodal rope: per-axis positions [3, B, S]; text-only callers
+        # (no mrope_position_ids) broadcast their 1-D positions, which is
+        # exactly standard rope (modules.mrope_cos_sin docstring)
+        mpos = mrope_position_ids
+        if mpos is None:
+            base = (position_ids if position_ids is not None
+                    else jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32),
+                                          tokens.shape))
+            mpos = jnp.broadcast_to(base[None],
+                                    (len(cfg.mrope_section),) + base.shape)
+        rope = M.mrope_cos_sin(mpos, cfg.head_dim, cfg.rope_theta,
+                               sections=cfg.mrope_section,
+                               scaling=cfg.rope_scaling)
+    elif cfg.position_embedding_type == "rope":
         cos, sin = M.rope_cos_sin(S, cfg.head_dim, cfg.rope_theta,
                                   scaling=cfg.rope_scaling)
         if position_ids is not None:
@@ -135,6 +150,7 @@ def forward_causal_lm(
                                        M.DROPOUT_STREAM_EMBED),
         position_ids=position_ids)
     aux_total = jnp.zeros((), jnp.float32)
+    moe_stats: Dict[str, Dict[str, jax.Array]] = {}
     for i, lp in enumerate(params["layers"]):
         if boundary_fn is not None:
             x = boundary_fn(i, x)
@@ -151,11 +167,14 @@ def forward_causal_lm(
         else:
             fn = lambda p, h, kw=kwargs: (
                 M.apply_decoder_layer(p, h, cfg, **kw),
-                jnp.zeros((), jnp.float32))
+                jnp.zeros((), jnp.float32), {})
         if remat_flags is not None and remat_flags[i]:
             fn = M.remat(fn, cfg)
-        x, aux = fn(lp, x)
+        x, aux, stats = fn(lp, x)
         aux_total = aux_total + aux
+        if stats:
+            # per-layer balance tracker (reference moe_utils.py:547-644)
+            moe_stats[f"layer{i}"] = stats
     if boundary_fn is not None:
         x = boundary_fn(len(params["layers"]), x)
     x = M.apply_norm(params["prenorm"], x, cfg)
@@ -164,7 +183,7 @@ def forward_causal_lm(
         wte=params["embed"]["wte"], compute_dtype=compute_dtype,
     )
     logits = logits if logits_fp32 else logits.astype(compute_dtype)
-    return (logits, aux_total) if with_aux else logits
+    return (logits, aux_total, moe_stats) if with_aux else logits
 
 
 def causal_lm_loss(
@@ -180,8 +199,11 @@ def causal_lm_loss(
     enc_layer_overrides: Optional[Dict[int, Dict[str, Any]]] = None,
     enc_boundary_fn: Optional[Callable[[int, jax.Array], jax.Array]] = None,
     fused_ce: Union[None, bool, Callable] = None,
+    with_moe_stats: bool = False,
 ) -> jax.Array:
-    """batch: tokens [B,S], labels [B,S], optional loss_mask [B,S] -> scalar.
+    """batch: tokens [B,S], labels [B,S], optional loss_mask [B,S] -> scalar
+    (or (scalar, per-layer MoE stats dict) with ``with_moe_stats=True`` —
+    the reference's aux-losses tracker, moe_utils.py:547-644).
 
     Equivalent role to the reference's loss closure from the dataloader
     (dataloader.py:558 _loss_func + train_dist.py forward_backward wiring).
@@ -197,7 +219,7 @@ def causal_lm_loss(
     if cfg.model_type == "t5":
         from hetu_galvatron_tpu.models.encdec import encdec_loss
 
-        return encdec_loss(params, batch, cfg, compute_dtype=compute_dtype,
+        loss = encdec_loss(params, batch, cfg, compute_dtype=compute_dtype,
                            remat_flags=remat_flags,
                            enc_remat_flags=enc_remat_flags,
                            boundary_fn=boundary_fn,
@@ -205,17 +227,20 @@ def causal_lm_loss(
                            layer_overrides=layer_overrides,
                            enc_layer_overrides=enc_layer_overrides,
                            fused_ce=fused)
-    logits, aux = forward_causal_lm(
+        return (loss, {}) if with_moe_stats else loss
+    logits, aux, moe_stats = forward_causal_lm(
         params, batch["tokens"], cfg,
         compute_dtype=compute_dtype, remat_flags=remat_flags,
         layer_overrides=layer_overrides, boundary_fn=boundary_fn,
         with_aux=True, dropout_rng=batch.get("dropout_rng"),
         position_ids=batch.get("position_ids"),
         segment_ids=batch.get("segment_ids"),
+        mrope_position_ids=batch.get("mrope_position_ids"),
     )
     ce = M.cross_entropy_loss(logits, batch["labels"], batch.get("loss_mask"),
                               fused=fused)
-    return ce + aux
+    loss = ce + aux
+    return (loss, moe_stats) if with_moe_stats else loss
 
 
 def param_count(params: Params) -> int:

@@ -77,9 +77,14 @@ def sinkhorn(logits: jax.Array, n_iters: int = 8) -> jax.Array:
 
 def route_tokens(
     p: Params, xt: jax.Array, cfg: ModelArgs, compute_dtype=jnp.bfloat16
-) -> Tuple[jax.Array, jax.Array, jax.Array]:
+) -> Tuple[jax.Array, jax.Array, jax.Array, Dict[str, jax.Array]]:
     """Router: [T, H] tokens -> (topk_idx [T,K] int, weights [T,K] fp32,
-    aux_loss scalar).
+    aux_loss scalar, stats dict).
+
+    ``stats`` carries the per-layer balance observables the reference logs
+    through its aux-losses tracker (moe_utils.py:547-644
+    save_to_aux_losses_tracker / reduce_aux_losses_tracker_across_ranks):
+    the load-balance loss, the z-loss, and tokens_per_expert [E].
 
     topk: softmax probs; selection optionally corrected by a no-grad expert
     bias (p["expert_bias"], reference moe_router_enable_expert_bias — the
@@ -106,10 +111,17 @@ def route_tokens(
                   else jax.nn.softmax(logits, axis=-1))
         w = jnp.take_along_axis(scores, topk_idx, axis=-1)
         aux = jnp.zeros((), jnp.float32)
+        zloss = jnp.zeros((), jnp.float32)
         if cfg.moe_z_loss_coeff:
             z = jax.scipy.special.logsumexp(logits, axis=-1)
-            aux = cfg.moe_z_loss_coeff * jnp.mean(jnp.square(z))
-        return topk_idx, w.astype(jnp.float32), aux
+            zloss = cfg.moe_z_loss_coeff * jnp.mean(jnp.square(z))
+            aux = zloss
+        counts = jnp.sum(jax.nn.one_hot(topk_idx, E, dtype=jnp.float32),
+                         axis=(0, 1))
+        stats = {"load_balance_loss": jnp.zeros((), jnp.float32),
+                 "z_loss": zloss,
+                 "tokens_per_expert": jax.lax.stop_gradient(counts)}
+        return topk_idx, w.astype(jnp.float32), aux, stats
 
     probs = jax.nn.softmax(logits, axis=-1)  # [T, E]
     select_scores = probs
@@ -139,15 +151,21 @@ def route_tokens(
 
     # aux losses (reference router.py aux/z-loss; moe_utils.py:166 scaling)
     sel = jax.nn.one_hot(topk_idx, E, dtype=jnp.float32)  # [T, K, E]
+    tokens_per_expert = jnp.sum(sel, axis=(0, 1))  # [E]
     frac_tokens = jnp.mean(jnp.sum(sel, axis=1), axis=0)  # f_e
     frac_probs = jnp.mean(probs, axis=0)  # P_e
-    aux = cfg.moe_aux_loss_coeff * E * jnp.sum(frac_tokens * frac_probs)
+    balance = cfg.moe_aux_loss_coeff * E * jnp.sum(frac_tokens * frac_probs)
+    zloss = jnp.zeros((), jnp.float32)
     if cfg.moe_z_loss_coeff:
         z = jax.scipy.special.logsumexp(logits, axis=-1)
-        aux = aux + cfg.moe_z_loss_coeff * jnp.mean(jnp.square(z))
+        zloss = cfg.moe_z_loss_coeff * jnp.mean(jnp.square(z))
+    aux = balance + zloss
     if bias_term is not None:
         aux = aux + bias_term  # value 0; carries the bias-maintenance grad
-    return topk_idx, topk_probs.astype(jnp.float32), aux
+    stats = {"load_balance_loss": jax.lax.stop_gradient(balance),
+             "z_loss": jax.lax.stop_gradient(zloss),
+             "tokens_per_expert": jax.lax.stop_gradient(tokens_per_expert)}
+    return topk_idx, topk_probs.astype(jnp.float32), aux, stats
 
 
 def update_expert_bias(expert_bias: jax.Array, tokens_per_expert: jax.Array,
@@ -279,8 +297,8 @@ def apply_moe_mlp(
     cfg: ModelArgs,
     compute_dtype=jnp.bfloat16,
     capacity_factor: Optional[float] = None,
-) -> Tuple[jax.Array, jax.Array]:
-    """x [B,S,H] -> (y [B,S,H], aux_loss scalar).
+) -> Tuple[jax.Array, jax.Array, Dict[str, jax.Array]]:
+    """x [B,S,H] -> (y [B,S,H], aux_loss scalar, router stats dict).
 
     Router per ``cfg.moe_router_type`` (see :func:`route_tokens`), dispatch
     per ``cfg.moe_dispatcher``: "capacity" (GShard, ep-shardable) or
@@ -288,7 +306,7 @@ def apply_moe_mlp(
     """
     B, S, H = x.shape
     xt = x.reshape(B * S, H)
-    topk_idx, w, aux = route_tokens(p, xt, cfg, compute_dtype)
+    topk_idx, w, aux, stats = route_tokens(p, xt, cfg, compute_dtype)
     if cfg.moe_dispatcher == "dropless":
         y = _dropless_dispatch(p, xt, topk_idx, w, cfg, compute_dtype)
     else:
@@ -297,7 +315,7 @@ def apply_moe_mlp(
     if "shared" in p:
         y = y + M.apply_mlp(p["shared"], xt[None], cfg,
                             compute_dtype=compute_dtype)[0]
-    return y.reshape(B, S, H).astype(compute_dtype), aux
+    return y.reshape(B, S, H).astype(compute_dtype), aux, stats
 
 
 def init_moe_decoder_layer(key: jax.Array, cfg: ModelArgs
@@ -322,8 +340,10 @@ def apply_moe_decoder_layer(
     compute_dtype=jnp.bfloat16,
     dropout_rng=None,
     segment_ids=None,
-) -> Tuple[jax.Array, jax.Array]:
-    """Pre-norm block with an MoE FFN; returns (x, aux_loss)."""
+) -> Tuple[jax.Array, jax.Array, Dict[str, jax.Array]]:
+    """Pre-norm block with an MoE FFN; returns (x, aux_loss, router
+    stats) — stats feed the per-layer balance tracker (reference
+    moe_utils.py:547-644)."""
     r_attn = r_res1 = r_res2 = None
     if dropout_rng is not None:
         r_attn, r_res1, r_res2 = jax.random.split(dropout_rng, 3)
@@ -334,5 +354,6 @@ def apply_moe_decoder_layer(
                           segment_ids=segment_ids),
         cfg.hidden_dropout, r_res1)
     h = M.apply_norm(p["ln2"], x, cfg)
-    y, aux = apply_moe_mlp(p["moe"], h, cfg, compute_dtype=compute_dtype)
-    return x + M.dropout(y, cfg.hidden_dropout, r_res2), aux
+    y, aux, stats = apply_moe_mlp(p["moe"], h, cfg,
+                                  compute_dtype=compute_dtype)
+    return x + M.dropout(y, cfg.hidden_dropout, r_res2), aux, stats

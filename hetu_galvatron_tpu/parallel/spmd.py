@@ -253,6 +253,7 @@ def build_spmd_loss_fn(
     *,
     compute_dtype=jnp.bfloat16,
     layer_overrides: Optional[Dict[int, Dict[str, Any]]] = None,
+    with_moe_stats: bool = False,
 ):
     """The plan-lowered loss closure shared by the train and eval steps:
     per-layer shardings, boundary constraints, attention-impl dispatch,
@@ -310,7 +311,7 @@ def build_spmd_loss_fn(
             p, batch, cfg, compute_dtype=compute_dtype,
             remat_flags=remat if any(remat) else None,
             layer_overrides=layer_overrides, boundary_fn=boundary,
-            fused_ce=fused_ce, **enc_kwargs)
+            fused_ce=fused_ce, with_moe_stats=with_moe_stats, **enc_kwargs)
 
     return loss_fn, pspecs, batch_shd, per_layer, vocab, enc_per
 
@@ -365,15 +366,16 @@ def make_spmd_train_step(
     if hpc.pp_deg != 1:
         raise ValueError("make_spmd_train_step is the pp=1 path; use the "
                          "pipeline engine for pp>1")
+    moe_stats = bool(cfg.num_experts)
     loss_fn, pspecs, batch_shd, per_layer, vocab, enc_per = (
         build_spmd_loss_fn(
             cfg, hpc, mesh, axes_tree, compute_dtype=compute_dtype,
-            layer_overrides=layer_overrides))
+            layer_overrides=layer_overrides, with_moe_stats=moe_stats))
     opt_pspecs = param_specs(axes_tree, per_layer, vocab, opt=True,
                              enc_per_layer=enc_per or None)
     opt_specs = opt_state_specs(tx, params, opt_pspecs)
     chunks = max(chunks if chunks is not None else hpc.chunks, 1)
-    step = make_train_step(loss_fn, tx, chunks=chunks)
+    step = make_train_step(loss_fn, tx, chunks=chunks, aux_stats=moe_stats)
 
     nshd = lambda tree: jax.tree.map(
         lambda s: NamedSharding(mesh, s), tree,

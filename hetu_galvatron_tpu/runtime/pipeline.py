@@ -371,10 +371,12 @@ class PipelineEngine:
                                dropout_rng=layer_rng(j),
                                **seg_kw, **overrides.get(j, {}))
                 fn = lambda p, h, b=base: (b(p, h),
-                                           jnp.zeros((), jnp.float32))
+                                           jnp.zeros((), jnp.float32), {})
             if sh.checkpoint:
                 fn = M.remat(fn, cfg)
-            x, aux = fn(lp, x)
+            # per-layer router stats are an spmd-path feature; the stage
+            # programs fold only the aux scalar into the loss
+            x, aux, _ = fn(lp, x)
             aux_total = aux_total + aux
         if not st.has_head:
             # a stage may carry zero decoder layers (embed-only stage 0)

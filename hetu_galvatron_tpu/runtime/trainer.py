@@ -46,13 +46,34 @@ def make_train_step(
     tx: optax.GradientTransformation,
     *,
     chunks: int = 1,
+    aux_stats: bool = False,
 ) -> Callable:
     """Returns train_step(params, opt_state, batch) -> (params, opt_state,
     metrics). ``chunks`` splits the global batch into microbatches scanned
     with fp32 grad accumulation (reference chunks semantics,
-    hybrid_parallel_config.py:359)."""
+    hybrid_parallel_config.py:359).
 
-    grad_fn = jax.value_and_grad(loss_fn)
+    ``aux_stats=True`` means loss_fn returns (loss, stats_pytree); the
+    stats land in metrics["moe"] — the reference's per-layer aux-losses
+    tracker (moe_utils.py:547-644). Loss-like stats are token-weighted
+    across microbatches; "tokens_per_expert" leaves are summed."""
+
+    if aux_stats:
+        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+    else:
+        _plain = jax.value_and_grad(loss_fn)
+
+        def grad_fn(p, b):
+            l, g = _plain(p, b)
+            return (l, {}), g
+
+    def _reduce_stats(stacked, weights):
+        def red(path, s):
+            if any("tokens_per_expert" in str(k) for k in path):
+                return jnp.sum(s, axis=0)
+            w = weights.reshape((-1,) + (1,) * (s.ndim - 1))
+            return jnp.sum(w * s, axis=0)
+        return jax.tree_util.tree_map_with_path(red, stacked)
 
     def step(params, opt_state, batch):
         # a "dropout_rng" key rides in the batch dict (so every execution
@@ -64,7 +85,7 @@ def make_train_step(
         if chunks <= 1:
             if rng is not None:
                 batch["dropout_rng"] = rng
-            loss, grads = grad_fn(params, batch)
+            (loss, stats), grads = grad_fn(params, batch)
         else:
             bsz = batch["tokens"].shape[0]
             if bsz % chunks:
@@ -88,19 +109,24 @@ def make_train_step(
 
             def microbatch(acc, xs):
                 mb, w = xs
-                l, g = grad_fn(params, mb)
+                (l, st), g = grad_fn(params, mb)
                 acc = jax.tree.map(
                     lambda a, b: a + w * b.astype(jnp.float32), acc, g)
-                return acc, w * l
+                return acc, (w * l, st)
 
             zeros = jax.tree.map(
                 lambda p: jnp.zeros(p.shape, jnp.float32), params)
-            grads, wlosses = jax.lax.scan(microbatch, zeros, (mbs, weights))
+            grads, (wlosses, stacked) = jax.lax.scan(
+                microbatch, zeros, (mbs, weights))
             loss = jnp.sum(wlosses)
+            stats = _reduce_stats(stacked, weights) if aux_stats else {}
         gnorm = global_grad_norm(grads)
         updates, new_opt = tx.update(grads, opt_state, params)
         new_params = optax.apply_updates(params, updates)
-        return new_params, new_opt, {"loss": loss, "grad_norm": gnorm}
+        metrics = {"loss": loss, "grad_norm": gnorm}
+        if aux_stats:
+            metrics["moe"] = stats
+        return new_params, new_opt, metrics
 
     return step
 

@@ -106,6 +106,15 @@ def populate_model_args_from_hf(
     values["position_embedding_type"] = (
         "rope" if family in _ROPE_FAMILIES else "learned"
     )
+    scaling = values.get("rope_scaling")
+    if isinstance(scaling, dict) and "mrope_section" in scaling:
+        # qwen2-vl style multimodal rope: rope_scaling carries the section
+        # split (type "mrope"/"default"), not a frequency-scaling recipe —
+        # route it to mrope_section so _scale_inv_freq never sees it
+        values["mrope_section"] = list(scaling["mrope_section"])
+        rest = {k: v for k, v in scaling.items()
+                if k not in ("mrope_section", "type", "rope_type")}
+        values["rope_scaling"] = rest or None
     # bias detection (reference hf_config_adapter.py:196-290 reads
     # attention_bias / mlp_bias / family defaults)
     bias_free = _ROPE_FAMILIES | {"t5"}  # llama-likes and t5 default to no biases

@@ -45,7 +45,7 @@ def test_moe_mlp_routing_and_aux():
     p, axes = init_moe_mlp(jax.random.key(0), MOE_CFG)
     assert axes["win"] == ("expert", "embed", "mlp")
     x = jax.random.normal(jax.random.key(1), (2, 16, 32))
-    y, aux = apply_moe_mlp(p, x, MOE_CFG, compute_dtype=jnp.float32)
+    y, aux, stats = apply_moe_mlp(p, x, MOE_CFG, compute_dtype=jnp.float32)
     assert y.shape == x.shape
     assert np.isfinite(float(aux)) and float(aux) > 0
     # perfectly balanced router would give aux = coeff * E * E * (1/E)^2
@@ -189,9 +189,9 @@ def test_dropless_matches_uncapped_capacity():
     cfg = MOE_CFG
     p = _moe_params(cfg)
     x = jax.random.normal(jax.random.key(1), (2, 16, 32))
-    y_cap, aux_cap = apply_moe_mlp(p, x, cfg, compute_dtype=jnp.float32,
+    y_cap, aux_cap, _ = apply_moe_mlp(p, x, cfg, compute_dtype=jnp.float32,
                                    capacity_factor=100.0)
-    y_dl, aux_dl = apply_moe_mlp(
+    y_dl, aux_dl, _ = apply_moe_mlp(
         p, x, cfg.model_copy(update={"moe_dispatcher": "dropless"}),
         compute_dtype=jnp.float32)
     np.testing.assert_allclose(np.asarray(y_dl), np.asarray(y_cap),
@@ -206,8 +206,8 @@ def test_capacity_overflow_drops_and_renormalizes():
     cfg = MOE_CFG.model_copy(update={"moe_capacity_factor": 0.25})
     p = _moe_params(cfg)
     x = jax.random.normal(jax.random.key(2), (2, 16, 32))
-    y_cap, _ = apply_moe_mlp(p, x, cfg, compute_dtype=jnp.float32)
-    y_dl, _ = apply_moe_mlp(
+    y_cap, _, _ = apply_moe_mlp(p, x, cfg, compute_dtype=jnp.float32)
+    y_dl, _, _ = apply_moe_mlp(
         p, x, cfg.model_copy(update={"moe_dispatcher": "dropless"}),
         compute_dtype=jnp.float32)
     assert np.all(np.isfinite(np.asarray(y_cap)))
@@ -223,7 +223,7 @@ def test_dropless_grads_flow():
     x = jax.random.normal(jax.random.key(3), (2, 8, 32))
 
     def loss(p_):
-        y, aux = apply_moe_mlp(p_, x, cfg, compute_dtype=jnp.float32)
+        y, aux, _ = apply_moe_mlp(p_, x, cfg, compute_dtype=jnp.float32)
         return jnp.sum(jnp.square(y)) + aux
 
     g = jax.grad(loss)(p)
@@ -241,7 +241,7 @@ def test_sinkhorn_router():
                                      "moe_z_loss_coeff": 0.0})
     p = _moe_params(cfg)
     xt = jax.random.normal(jax.random.key(4), (64, 32))
-    idx, w, aux = route_tokens(p, xt, cfg, compute_dtype=jnp.float32)
+    idx, w, aux, _ = route_tokens(p, xt, cfg, compute_dtype=jnp.float32)
     assert idx.shape == (64, 2) and w.shape == (64, 2)
     assert float(aux) == 0.0
     # sinkhorn normalization balances the assignment matrix
@@ -254,7 +254,7 @@ def test_sinkhorn_router():
     with pytest.raises(ValueError):
         route_tokens(p, xt, bad, compute_dtype=jnp.float32)
     # end-to-end through the layer
-    y, _ = apply_moe_mlp(p, xt[None], cfg, compute_dtype=jnp.float32)
+    y, _, _ = apply_moe_mlp(p, xt[None], cfg, compute_dtype=jnp.float32)
     assert np.all(np.isfinite(np.asarray(y)))
 
 
@@ -266,10 +266,10 @@ def test_expert_bias_steers_selection():
     p = _moe_params(cfg)
     assert "expert_bias" in p
     xt = jax.random.normal(jax.random.key(6), (128, 32))
-    idx0, w0, _ = route_tokens(p, xt, cfg, compute_dtype=jnp.float32)
+    idx0, w0, _, _ = route_tokens(p, xt, cfg, compute_dtype=jnp.float32)
     # bias expert 3 way up: every token must now select it...
     p2 = dict(p, expert_bias=jnp.array([-10., -10., -10., 10.]))
-    idx1, w1, _ = route_tokens(p2, xt, cfg, compute_dtype=jnp.float32)
+    idx1, w1, _, _ = route_tokens(p2, xt, cfg, compute_dtype=jnp.float32)
     assert np.all(np.asarray(idx1) == 3)
     # ...but combine weights still come from the unbiased probs
     sel_same = np.asarray(idx0) == 3
@@ -361,3 +361,73 @@ def test_expert_bias_updates_during_training():
     w1 = np.asarray(new_params["layers"][0]["attn"]["wqkv"])
     assert not np.allclose(w0, w1), "model weights must still train"
     assert np.isfinite(float(loss))
+
+
+def test_per_layer_aux_tracker_in_train_metrics(cpu_devices):
+    """Per-layer aux/z-loss + tokens-per-expert ride the train-step metrics
+    (reference aux-losses tracker, moe_utils.py:547-644), spmd path with
+    microbatching."""
+    from hetu_galvatron_tpu.core.args_schema import CoreArgs
+    from hetu_galvatron_tpu.models.builder import init_causal_lm
+    from hetu_galvatron_tpu.parallel.spmd import (
+        make_spmd_train_step,
+        shard_params,
+    )
+    from hetu_galvatron_tpu.runtime.dataloader import make_batch
+    from hetu_galvatron_tpu.runtime.hybrid_config import (
+        get_hybrid_parallel_config,
+    )
+    from hetu_galvatron_tpu.runtime.mesh import build_mesh
+    from hetu_galvatron_tpu.runtime.optimizer import make_optimizer
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    args = CoreArgs.model_validate({
+        "model": {
+            "model_type": "moe", "hidden_size": 32, "num_hidden_layers": 4,
+            "num_attention_heads": 2, "vocab_size": 64, "seq_length": 8,
+            "max_position_embeddings": 16, "num_experts": 4,
+            "moe_layer_freq": 2, "moe_aux_loss_coeff": 1e-2,
+            "moe_z_loss_coeff": 1e-3, "hidden_act": "swiglu",
+            "normalization": "rmsnorm", "position_embedding_type": "rope",
+            "tie_word_embeddings": False, "add_bias_linear": False,
+            "add_qkv_bias": False, "make_vocab_size_divisible_by": 1,
+            "ffn_hidden_size": 64,
+        },
+        "parallel": {"global_tp_deg": 2, "default_dp_type": "zero3",
+                     "vocab_tp": 1, "global_train_batch_size": 8,
+                     "chunks": 2, "global_ep_deg": 2},
+    })
+    mesh = build_mesh(8, 1, devices=cpu_devices)
+    hpc = get_hybrid_parallel_config(args, 8)
+    params, axes = init_causal_lm(jax.random.key(0), args.model)
+    tx = make_optimizer(args.train)
+    step, pspecs, ospecs, batch_shd = make_spmd_train_step(
+        args.model, hpc, mesh, axes, tx, params,
+        compute_dtype=jnp.float32, donate=False)
+    sp = shard_params(params, pspecs, mesh)
+    opt = jax.jit(tx.init, out_shardings=jax.tree.map(
+        lambda s: NamedSharding(mesh, s), ospecs,
+        is_leaf=lambda x: isinstance(x, PartitionSpec)))(sp)
+    data = np.random.RandomState(0).randint(
+        0, args.model.padded_vocab_size, (8, 9))
+    batch = jax.device_put(jax.tree.map(jnp.asarray, make_batch(data)),
+                           batch_shd)
+    _, _, metrics = step(sp, opt, batch)
+    moe = metrics["moe"]
+    # layers 1 and 3 are MoE (freq 2); 0 and 2 dense
+    assert set(moe) == {"layer1", "layer3"}, set(moe)
+    total_tokens = 8 * 8 * args.model.moe_topk
+    for st in moe.values():
+        assert float(st["load_balance_loss"]) > 0
+        assert float(st["z_loss"]) > 0
+        tpe = np.asarray(st["tokens_per_expert"])
+        assert tpe.shape == (4,)
+        assert int(tpe.sum()) == total_tokens, (tpe, total_tokens)
+    # the iteration log renders the tracker
+    from hetu_galvatron_tpu.core.profiler.runtime_profiler import (
+        RuntimeProfiler,
+    )
+
+    prof = RuntimeProfiler(args, world_size=8, rank=0)
+    line = prof.iteration_log(0, metrics)
+    assert "moe[layer1]" in line and "imb" in line
