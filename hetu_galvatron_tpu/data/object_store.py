@@ -1,0 +1,145 @@
+"""S3-backed corpus prefixes: download-once local caching.
+
+Capability parity with the reference's S3 indexed-dataset support
+(runtime/datasets/megatron/indexed_dataset.py:506 ``S3 path detection`` +
+object_storage_utils cache_dir download): an ``s3://bucket/key`` corpus
+prefix is localized by downloading ``<prefix>.idx`` / ``<prefix>.bin``
+(and the optional ``<prefix>.meta.json`` tokenizer sidecar) into a local
+cache, after which the mmap dataset machinery runs unchanged — TPU VMs
+read training shards from GCS/S3 exactly this way.
+
+The client is injected (anything with ``download_file(bucket, key, path)``)
+so tests run without boto3; the default client requires boto3 at call time
+with an actionable error (this image does not bundle it).
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Optional
+
+_SCHEME = "s3://"
+
+
+def is_object_path(path: str) -> bool:
+    return str(path).startswith(_SCHEME)
+
+
+def _default_cache_dir() -> str:
+    return os.environ.get(
+        "HGTPU_OBJECT_CACHE",
+        os.path.join(os.path.expanduser("~"), ".cache",
+                     "hetu_galvatron_tpu", "s3"))
+
+
+def _default_client():
+    try:
+        import boto3
+    except ImportError as e:
+        raise RuntimeError(
+            "s3:// data paths need boto3 (not bundled in this image): "
+            "pip install boto3, or pre-download the corpus and point "
+            "data.data_path at the local prefix") from e
+    return boto3.client("s3")
+
+
+_ABSENT_MARKERS = ("nosuchkey", "nosuchbucket", "not found", "404")
+
+
+def _is_absent_error(e: Exception) -> bool:
+    """Whether a client exception means 'object does not exist' (the only
+    error an OPTIONAL file may swallow — a throttle/auth failure on the
+    meta sidecar must not silently disable eod masking / vocab checks)."""
+    return any(m in f"{type(e).__name__}: {e}".lower()
+               for m in _ABSENT_MARKERS)
+
+
+def _validate_pair(local_prefix: str) -> bool:
+    """The cached .idx/.bin must be the SAME corpus version: the index's
+    declared token count times the dtype width must equal the bin size
+    (a crash between the two atomic renames, or a re-uploaded remote,
+    could otherwise pair an old index with a new bin)."""
+    import struct
+
+    import numpy as np
+
+    from hetu_galvatron_tpu.data.indexed_dataset import _DTYPES, _MAGIC
+
+    try:
+        with open(local_prefix + ".idx", "rb") as f:
+            if f.read(len(_MAGIC)) != _MAGIC:
+                return False
+            _, dtype_code, num_docs = struct.unpack("<HHq", f.read(12))
+            offsets = np.fromfile(f, np.int64, num_docs + 1)
+        expect = int(offsets[-1]) * np.dtype(_DTYPES[dtype_code]).itemsize
+        return os.path.getsize(local_prefix + ".bin") == expect
+    except (OSError, KeyError, struct.error, IndexError):
+        return False
+
+
+def localize_prefix(prefix: str, cache_dir: Optional[str] = None,
+                    client=None) -> str:
+    """``s3://bucket/path/corpus`` -> local cached prefix. Downloads
+    ``.idx`` and ``.bin`` (required) plus ``.meta.json`` (optional) once;
+    subsequent calls hit the cache (and need no client at all). Downloads
+    land in a temp file and are renamed atomically; the .idx/.bin pair is
+    size-validated together, with one purge-and-refetch on mismatch."""
+    if not is_object_path(prefix):
+        return prefix
+    rest = prefix[len(_SCHEME):]
+    if "/" not in rest:
+        raise ValueError(f"malformed s3 prefix {prefix!r} "
+                         "(want s3://bucket/key)")
+    bucket, key = rest.split("/", 1)
+    cache_dir = cache_dir or _default_cache_dir()
+    local_prefix = os.path.join(cache_dir, bucket, key)
+    os.makedirs(os.path.dirname(local_prefix), exist_ok=True)
+
+    def get_client():
+        nonlocal client
+        if client is None:
+            # lazy: a fully-warmed cache must work without boto3
+            client = _default_client()
+        return client
+
+    def fetch(ext: str, required: bool) -> None:
+        target = local_prefix + ext
+        if os.path.exists(target):
+            return
+        cl = get_client()  # outside the try: a missing-boto3 RuntimeError
+        # must surface as itself, not as a fetch failure
+        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(target),
+                                   prefix=".dl_")
+        os.close(fd)
+        try:
+            cl.download_file(bucket, key + ext, tmp)
+        except Exception as e:  # noqa: BLE001 — client-specific error types
+            os.unlink(tmp)
+            if required:
+                raise FileNotFoundError(
+                    f"failed to fetch {prefix}{ext} from object storage: "
+                    f"{e}") from e
+            if not _is_absent_error(e):
+                raise RuntimeError(
+                    f"transient error fetching optional {prefix}{ext}: "
+                    f"{e} — refusing to silently run without the "
+                    "tokenizer sidecar") from e
+            return
+        os.replace(tmp, target)
+
+    for attempt in range(2):
+        for ext, required in ((".idx", True), (".bin", True),
+                              (".meta.json", False)):
+            fetch(ext, required)
+        if _validate_pair(local_prefix):
+            break
+        if attempt == 1:
+            raise ValueError(
+                f"cached {local_prefix}.idx/.bin disagree on corpus size "
+                "even after refetch; clear the cache dir and check the "
+                "remote corpus integrity")
+        for ext in (".idx", ".bin"):
+            if os.path.exists(local_prefix + ext):
+                os.unlink(local_prefix + ext)
+    return local_prefix

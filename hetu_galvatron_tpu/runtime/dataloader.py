@@ -136,9 +136,15 @@ def get_data_iterator(
                                seed=args.train.seed + 101 * split_idx)
     elif data.dataset == "indexed":
         from hetu_galvatron_tpu.data.indexed_dataset import indexed_batches
+        from hetu_galvatron_tpu.data.object_store import localize_prefix
 
         if not data.data_path:
             raise ValueError("data.dataset=indexed requires data.data_path")
+        # s3:// prefixes download-once into the local cache (reference S3
+        # indexed datasets, indexed_dataset.py:506); local paths unchanged
+        data = data.model_copy(
+            update={"data_path": [localize_prefix(p)
+                                  for p in data.data_path]})
         meta = corpus_meta(data.data_path)
         if meta.get("vocab_size", 0) > args.model.padded_vocab_size:
             raise ValueError(
