@@ -358,16 +358,17 @@ def apply_attention(
                 "cp/ulysses layers or set model.attention_dropout=0; "
                 "hidden_dropout works with every kernel")
     elif segment_ids is not None:
-        # packed-document masking: the XLA core and the Pallas flash kernel
-        # implement it (flash masks per tile in-kernel); ring/Ulysses do not
+        # packed-document masking: the XLA core, the Pallas flash kernel
+        # (per-tile in-kernel) and ring attention (k-side segments rotate
+        # with their block) implement it; Ulysses does not
         if sdpa_fn is xla_sdpa or getattr(sdpa_fn, "supports_segments",
                                           False):
             out = sdpa_fn(q, k, v, causal=causal, segment_ids=segment_ids)
         else:
             raise NotImplementedError(
                 "reset_attention_mask is not supported by the installed "
-                "ring/Ulysses attention kernel; use flash or the XLA core "
-                "for packed-document layers, or set "
+                "Ulysses attention kernel; use flash, ring, or the XLA "
+                "core for packed-document layers, or set "
                 "data.reset_attention_mask=false")
     else:
         out = sdpa_fn(q, k, v, causal=causal)

@@ -47,9 +47,12 @@ def _positions(rank, length, cp, zigzag):
                      (2 * cp - 1 - rank) * h + (i - h))
 
 
-def _fold_block(step, acc, *, q, k, v, my_idx, cp, causal, zigzag):
+def _fold_block(step, acc, *, q, k, v, my_idx, cp, causal, zigzag,
+                qseg=None, kseg=None):
     """Fold the key/value block currently held (from rank
-    (my_idx - step) mod cp) into the streaming softmax accumulator."""
+    (my_idx - step) mod cp) into the streaming softmax accumulator.
+    ``qseg`` [B, Sq] / ``kseg`` [B, Sk] block-diagonalize packed documents
+    (reference reset_attention_mask); kseg rotates with its k/v block."""
     o, m, l = acc
     B, Sq, K, G, D = q.shape
     src_block = (my_idx - step) % cp
@@ -58,6 +61,10 @@ def _fold_block(step, acc, *, q, k, v, my_idx, cp, causal, zigzag):
         qpos = _positions(my_idx, Sq, cp, zigzag)[:, None]
         kpos = _positions(src_block, k.shape[1], cp, zigzag)[None, :]
         scores = jnp.where(qpos >= kpos, scores, NEG_INF)
+    if qseg is not None:
+        same = (qseg[:, None, None, :, None]
+                == kseg[:, None, None, None, :])  # [B,1,1,Sq,Sk]
+        scores = jnp.where(same, scores, NEG_INF)
     block_max = jnp.max(scores, axis=-1)  # [B,K,G,Sq]
     new_m = jnp.maximum(m, block_max)
     # guard fully-masked rows: exp(NEG_INF - NEG_INF) would be 1
@@ -71,20 +78,24 @@ def _fold_block(step, acc, *, q, k, v, my_idx, cp, causal, zigzag):
     return new_o, new_m, new_l
 
 
-def _ring_body(step, carry, *, q, my_idx, cp, causal, zigzag, axis):
-    """One ring step: fold the current block, then rotate k/v onward."""
-    o, m, l, k, v = carry
+def _ring_body(step, carry, *, q, qseg, my_idx, cp, causal, zigzag, axis):
+    """One ring step: fold the current block, then rotate k/v (and the
+    k-side segment ids) onward."""
+    o, m, l, k, v, kseg = carry
     o, m, l = _fold_block(step, (o, m, l), q=q, k=k, v=v, my_idx=my_idx,
-                          cp=cp, causal=causal, zigzag=zigzag)
+                          cp=cp, causal=causal, zigzag=zigzag,
+                          qseg=qseg, kseg=kseg)
     perm = [(i, (i + 1) % cp) for i in range(cp)]
     k = jax.lax.ppermute(k, axis, perm)
     v = jax.lax.ppermute(v, axis, perm)
-    return o, m, l, k, v
+    if kseg is not None:
+        kseg = jax.lax.ppermute(kseg, axis, perm)
+    return o, m, l, k, v, kseg
 
 
-def _ring_attention_local(q, k, v, *, axis, causal, zigzag=False):
+def _ring_attention_local(q, k, v, seg=None, *, axis, causal, zigzag=False):
     """Per-shard kernel under shard_map: q/k/v are the local sequence blocks
-    [B, S/cp, N|K, D]."""
+    [B, S/cp, N|K, D]; ``seg`` [B, S/cp] packed-document segment ids."""
     cp = jax.lax.axis_size(axis)
     my_idx = jax.lax.axis_index(axis)
     B, Sq, N, D = q.shape
@@ -94,12 +105,15 @@ def _ring_attention_local(q, k, v, *, axis, causal, zigzag=False):
     o = jnp.zeros((B, K, G, Sq, D), jnp.float32)
     m = jnp.full((B, K, G, Sq), NEG_INF, jnp.float32)
     l = jnp.zeros((B, K, G, Sq), jnp.float32)
-    body = partial(_ring_body, q=qg, my_idx=my_idx, cp=cp,
+    body = partial(_ring_body, q=qg, qseg=seg, my_idx=my_idx, cp=cp,
                    causal=causal, zigzag=zigzag, axis=axis)
     # cp-1 fold+rotate steps, then the final fold without the wasted rotate
-    o, m, l, k, v = jax.lax.fori_loop(0, cp - 1, body, (o, m, l, k, v))
+    # (seg=None is a structure-only pytree leaf: one loop serves both cases)
+    o, m, l, k, v, kseg = jax.lax.fori_loop(
+        0, cp - 1, body, (o, m, l, k, v, seg))
     o, m, l = _fold_block(cp - 1, (o, m, l), q=qg, k=k, v=v, my_idx=my_idx,
-                          cp=cp, causal=causal, zigzag=zigzag)
+                          cp=cp, causal=causal, zigzag=zigzag,
+                          qseg=seg, kseg=kseg)
     o = o / jnp.maximum(l, 1e-20)[..., None]
     return o.transpose(0, 3, 1, 2, 4).reshape(B, Sq, N, D).astype(q.dtype)
 
@@ -362,7 +376,7 @@ def make_ring_sdpa(
     for a in cp_axes:
         cp *= mesh.shape[a]
 
-    def sdpa(q, k, v, *, causal=True):
+    def sdpa(q, k, v, *, causal=True, segment_ids=None):
         S = q.shape[1]
         if S % cp:
             raise ValueError(f"sequence {S} not divisible by cp {cp}")
@@ -371,22 +385,32 @@ def make_ring_sdpa(
                 f"zigzag layout needs sequence {S} divisible by 2*cp "
                 f"= {2 * cp} (two half-blocks per rank)")
         floor = 8 if interpret else 128
-        if use_flash and ring_flash_blocks_fit(S // cp, zigzag, floor):
+        has_seg = segment_ids is not None
+        if (use_flash and not has_seg
+                and ring_flash_blocks_fit(S // cp, zigzag, floor)):
             local = partial(_ring_flash_sdpa_local, axis=axis, cp=cp,
                             causal=causal, zigzag=zigzag,
                             interpret=interpret, floor=floor)
         else:
+            # packed documents ride the dense fold: k-side segment ids
+            # rotate with their k/v block; the flash-in-ring kernels would
+            # need unequal-length q/k segment operands (future work)
             local = partial(_ring_attention_local, axis=axis, causal=causal,
                             zigzag=zigzag)
+        seg_spec = P(spec[0], cp_axes)
+        in_specs = (spec, spec, spec) + ((seg_spec,) if has_seg else ())
         fn = jax.shard_map(
             local,
-            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            mesh=mesh, in_specs=in_specs, out_specs=spec,
             check_vma=False)
         if zigzag:
             q, k, v = (zigzag_layout(t, cp) for t in (q, k, v))
-        out = fn(q, k, v)
+            if has_seg:
+                segment_ids = zigzag_layout(segment_ids, cp)
+        out = fn(q, k, v, *((segment_ids,) if has_seg else ()))
         return zigzag_unlayout(out, cp) if zigzag else out
 
+    sdpa.supports_segments = True
     return sdpa
 
 
