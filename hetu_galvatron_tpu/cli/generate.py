@@ -124,8 +124,9 @@ def main(argv=None) -> int:
     # batch of one).
     if cfg.model_type == "t5":
         # seq2seq: the prompt is the ENCODER source; decode starts from the
-        # start token (HF T5 uses pad id 0). Single-device path — the spmd
-        # generate wrapper is causal-only.
+        # start token (HF T5 uses pad id 0). The CLI decodes single-device
+        # (one prompt); make_spmd_generate also handles t5 for sharded
+        # programmatic decoding.
         from hetu_galvatron_tpu.models.generate import generate_encdec
 
         out = jax.jit(lambda p, t, k: generate_encdec(

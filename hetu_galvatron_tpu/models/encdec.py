@@ -93,15 +93,19 @@ def apply_cross_attention(
             else cross_kv(p, memory, cfg, compute_dtype))
     # decoder sees the whole source; probability dropout mirrors
     # modules.apply_attention (HF T5Attention drops attention weights in
-    # BOTH self- and cross-attention)
+    # BOTH self- and cross-attention): the XLA core and dropout-capable
+    # kernels (flash) implement it in-place; others refuse loudly
     if dropout_rng is not None and cfg.attention_dropout > 0.0:
-        if sdpa_fn is not M.xla_sdpa:
+        if sdpa_fn is M.xla_sdpa or getattr(sdpa_fn, "supports_dropout",
+                                            False):
+            out = sdpa_fn(q, k, v, causal=False,
+                          dropout_rate=cfg.attention_dropout,
+                          dropout_rng=dropout_rng)
+        else:
             raise NotImplementedError(
-                "attention_dropout > 0 is only supported with the XLA "
-                "attention core (see modules.apply_attention)")
-        out = M.xla_sdpa(q, k, v, causal=False,
-                         dropout_rate=cfg.attention_dropout,
-                         dropout_rng=dropout_rng)
+                "attention_dropout > 0 needs the XLA attention core or a "
+                "dropout-capable kernel (flash) for cross-attention "
+                "(see modules.apply_attention)")
     else:
         out = sdpa_fn(q, k, v, causal=False)
     y = jnp.einsum("btf,fh->bth", out.reshape(B, T, nq * hd),

@@ -433,13 +433,10 @@ def make_spmd_generate(
     Returns (generate_fn(params, tokens, key) -> tokens, pspecs, batch_shd).
     Params must be placed with :func:`shard_params` first.
     """
-    from hetu_galvatron_tpu.models.generate import generate
+    from hetu_galvatron_tpu.models.generate import generate, generate_encdec
 
     if hpc.pp_deg != 1:
         raise ValueError("make_spmd_generate is the pp=1 path")
-    if cfg.model_type == "t5":
-        # fail at build time with the real reason, not at trace time
-        raise NotImplementedError("generate(): t5 decode not implemented")
     _, per_layer, vocab, pspecs = _lower_specs(hpc, mesh, axes_tree)
     # tokens: batch over the first layer's dp axes only (sequence stays
     # local — the decode step is one position wide)
@@ -449,9 +446,17 @@ def make_spmd_generate(
         lambda s: NamedSharding(mesh, s), tree,
         is_leaf=lambda x: isinstance(x, P))
 
+    if cfg.model_type == "t5":
+        # seq2seq: tokens are the ENCODER source; the decoder stream and
+        # both caches take their shardings from propagation exactly like
+        # the causal path (cross k/v shard off the tp-sharded wkv)
+        decode = lambda p, tokens, key: generate_encdec(
+            p, tokens, cfg, max_new_tokens, key=key, **gen_kwargs)
+    else:
+        decode = lambda p, tokens, key: generate(
+            p, tokens, cfg, max_new_tokens, key=key, **gen_kwargs)
     fn = jax.jit(
-        lambda p, tokens, key: generate(
-            p, tokens, cfg, max_new_tokens, key=key, **gen_kwargs),
+        decode,
         in_shardings=(nshd(pspecs), batch_shd, NamedSharding(mesh, P())),
         out_shardings=batch_shd,
     )

@@ -41,6 +41,7 @@ _SLOW_TESTS = {
     "test_t5_interleaved_virtual_stages",
     "test_t5_heterogeneous_combined_plan",
     "test_t5_ring_cp_matches_xla",
+    "test_t5_spmd_generate_matches_single_device",
     "test_t5_train_dist_cli",
     "test_t5_search_then_train_combined_stack",
     "test_init_structure_and_loss",

@@ -443,3 +443,69 @@ def test_t5_generate_cli_smoke(capsys):
                    "prompt=translate this", "max_new_tokens=4"])
     assert rc == 0
     assert capsys.readouterr().out.strip() != ""
+
+
+@pytest.mark.distributed
+def test_t5_spmd_generate_matches_single_device(cpu_devices):
+    """make_spmd_generate routes t5 configs through generate_encdec under
+    the plan's GSPMD shardings; tp2 x dp2 greedy decode == single-device."""
+    from hetu_galvatron_tpu.models.generate import generate_encdec
+    from hetu_galvatron_tpu.parallel.spmd import (
+        make_spmd_generate,
+        shard_params,
+    )
+    from hetu_galvatron_tpu.runtime.hybrid_config import (
+        get_hybrid_parallel_config,
+    )
+    from hetu_galvatron_tpu.runtime.mesh import build_mesh
+
+    params, axes = init_causal_lm(jax.random.key(2), T5)
+    args = CoreArgs(model=T5.model_dump())
+    args.parallel.global_tp_deg = 2
+    args.parallel.vocab_tp = 2
+    args.parallel.global_train_batch_size = 4
+    mesh = build_mesh(4, 1, devices=cpu_devices[:4])
+    hpc = get_hybrid_parallel_config(args, 4)
+    enc = jnp.asarray(np.random.RandomState(8).randint(0, 64, (4, 8)))
+    # fp32 on both sides: bf16 + resharded reduction order could flip an
+    # argmax tie and cascade through the greedy decode (same convention as
+    # the causal spmd-generate parity test)
+    ref = generate_encdec(params, enc, T5, 5, compute_dtype=jnp.float32)
+    fn, pspecs, batch_shd = make_spmd_generate(
+        T5, hpc, mesh, axes, 5, compute_dtype=jnp.float32)
+    sp = shard_params(params, pspecs, mesh)
+    out = fn(sp, jax.device_put(enc, batch_shd), jax.random.key(0))
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_t5_cross_attention_dropout_with_capable_kernel():
+    """Cross-attention dropout routes through dropout-capable kernels
+    (flash) instead of refusing; incapable kernels still refuse."""
+    from hetu_galvatron_tpu.models.encdec import apply_cross_attention
+    from hetu_galvatron_tpu.models.modules import xla_sdpa
+
+    cfg = T5.model_copy(update={"attention_dropout": 0.2})
+    from hetu_galvatron_tpu.models.encdec import init_cross_attention
+
+    p, _ = init_cross_attention(jax.random.key(0), cfg)
+    x = jax.random.normal(jax.random.key(1), (2, 6, 32), jnp.float32)
+    mem = jax.random.normal(jax.random.key(2), (2, 8, 32), jnp.float32)
+
+    def capable(q, k, v, **kw):
+        kw.pop("dropout_rate", None)
+        kw.pop("dropout_rng", None)
+        return xla_sdpa(q, k, v, **kw)
+
+    capable.supports_dropout = True
+    out = apply_cross_attention(p, x, mem, cfg, sdpa_fn=capable,
+                                compute_dtype=jnp.float32,
+                                dropout_rng=jax.random.key(3))
+    assert np.all(np.isfinite(np.asarray(out)))
+
+    def incapable(q, k, v, **kw):
+        return xla_sdpa(q, k, v, **kw)
+
+    with pytest.raises(NotImplementedError, match="dropout-capable"):
+        apply_cross_attention(p, x, mem, cfg, sdpa_fn=incapable,
+                              compute_dtype=jnp.float32,
+                              dropout_rng=jax.random.key(3))
