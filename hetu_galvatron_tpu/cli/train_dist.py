@@ -55,7 +55,7 @@ def train(args) -> Dict[str, Any]:
     tx = make_optimizer(args.train)
     schedule = make_lr_schedule(args.train)
     base_iter, valid_iter, test_iter = get_train_valid_test_data_iterators(
-        args, global_batch_size=hpc.global_bsz)
+        args, global_batch_size=hpc.global_bsz, hpc=hpc)
     data_iter = RerunDataIterator(base_iter)
     profiler = RuntimeProfiler(args, world_size=world,
                                rank=jax.process_index())

@@ -143,6 +143,12 @@ class ParallelArgs(BaseModel):
     global_tp_deg: int = 1
     global_tp_consec: int = 1
     global_cp_deg: int = 1
+    # zigzag-balanced cp with the layout applied in the DATALOADER
+    # (reference get_batch zigzag slice, utils.py:295): sequences arrive
+    # pre-permuted, position ids ride the batch, and ring layers skip the
+    # per-call layout reshard — the long-sequence deployment mode. Needs a
+    # uniform cp degree across all layers (causal families only).
+    cp_zigzag: bool = False
     global_ep_deg: int = 1  # expert parallel (MoE), carved from dp
     global_etp_deg: int = 1  # tp inside each expert
     sdp: int = 0  # 1 => force zero3 on all layers

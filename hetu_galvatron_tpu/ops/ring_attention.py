@@ -350,6 +350,7 @@ def make_ring_sdpa(
     zigzag: bool = False,
     use_flash: bool = False,
     interpret: bool = False,
+    data_zigzagged: bool = False,
 ):
     """sdpa_fn for modules.apply_attention: reshards q/k/v so the sequence
     lives on the cp axes, runs the ring kernel under shard_map, and hands the
@@ -367,9 +368,17 @@ def make_ring_sdpa(
     (the reference's flash-in-ring, attention_impl.py:564-905) instead of
     the dense per-block XLA fold — O(block) memory per step at MXU speed.
     Falls back to the dense fold per call when no lane-aligned flash block
-    tiles the local sequence. ``interpret=True`` is for CPU tests."""
+    tiles the local sequence. ``interpret=True`` is for CPU tests.
+
+    ``data_zigzagged=True`` (with ``zigzag=True``) declares the inputs
+    ALREADY in zigzag order — the dataloader applied the layout
+    (runtime/dataloader.py zigzag_cp_batches) — so the entry/exit
+    permutes are skipped entirely: zero reshard cost per call."""
     if not cp_axes:
         raise ValueError("ring attention needs at least one cp axis")
+    if data_zigzagged and not zigzag:
+        raise ValueError("data_zigzagged requires zigzag=True (the kernel "
+                         "must mask by zigzag global positions)")
     axis = cp_axes if len(cp_axes) > 1 else cp_axes[0]
     spec = P(dp_axes or None, cp_axes, tp_axes or None, None)
     cp = 1
@@ -403,12 +412,13 @@ def make_ring_sdpa(
             local,
             mesh=mesh, in_specs=in_specs, out_specs=spec,
             check_vma=False)
-        if zigzag:
+        relayout = zigzag and not data_zigzagged
+        if relayout:
             q, k, v = (zigzag_layout(t, cp) for t in (q, k, v))
             if has_seg:
                 segment_ids = zigzag_layout(segment_ids, cp)
         out = fn(q, k, v, *((segment_ids,) if has_seg else ()))
-        return zigzag_unlayout(out, cp) if zigzag else out
+        return zigzag_unlayout(out, cp) if relayout else out
 
     sdpa.supports_segments = True
     return sdpa

@@ -118,6 +118,7 @@ def attention_overrides(
     *,
     use_flash: Optional[bool] = None,
     with_cross: bool = False,
+    cp_zigzag: bool = False,
 ) -> Dict[int, Dict[str, Any]]:
     """Per-layer attention-impl dispatch (reference attention.py:664-720):
     cp > 1 layers swap in the ring-attention kernel over their cp axes;
@@ -148,7 +149,8 @@ def attention_overrides(
         if sh.cp_axes:
             out[i] = {"sdpa_fn": make_ring_sdpa(
                 mesh, sh.cp_axes, dp_axes=sh.dp_axes, tp_axes=sh.tp_axes,
-                use_flash=use_flash)}
+                use_flash=use_flash, zigzag=cp_zigzag,
+                data_zigzagged=cp_zigzag)}
             if with_cross:
                 out[i]["cross_sdpa_fn"] = xla_sdpa
         elif sh.ulysses and sh.tp_axes:
@@ -266,7 +268,8 @@ def build_spmd_loss_fn(
     use_flash = None if cfg.use_flash_attn else False
     ring = attention_overrides(
         per_layer, mesh, use_flash=use_flash,
-        with_cross=cfg.model_type == "t5")
+        with_cross=cfg.model_type == "t5",
+        cp_zigzag=getattr(hpc, "cp_zigzag", False))
     enc_overrides = (attention_overrides(enc_per, mesh, use_flash=use_flash)
                      if enc_per else None)
     if ring:

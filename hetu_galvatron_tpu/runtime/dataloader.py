@@ -117,9 +117,46 @@ def synthetic_batches(
 _SPLIT_INDEX = {"train": 0, "valid": 1, "test": 2}
 
 
+def _zigzag_perm(seq: int, cp: int) -> np.ndarray:
+    """Slot -> global-position permutation of the zigzag cp layout (rank r
+    holds global half-blocks r and 2cp-1-r; ops/ring_attention.py
+    zigzag_layout over arange)."""
+    blocks = np.split(np.arange(seq), 2 * cp)
+    order = []
+    for r in range(cp):
+        order.append(blocks[r])
+        order.append(blocks[2 * cp - 1 - r])
+    return np.concatenate(order)
+
+
+def zigzag_cp_batches(it: Iterator[Dict[str, np.ndarray]], cp: int
+                      ) -> Iterator[Dict[str, np.ndarray]]:
+    """Apply the zigzag cp layout in the LOADER (reference get_batch zigzag
+    slice, utils.py:295): every [B, S] field is permuted along the sequence
+    and ``position_ids`` carry each slot's true global (or packed
+    doc-relative) position so rope stays correct — ring layers then run
+    ``data_zigzagged`` and skip the per-call layout reshard entirely."""
+    perm = None
+    for batch in it:
+        S = batch["tokens"].shape[1]
+        if S % (2 * cp):
+            raise ValueError(
+                f"cp_zigzag needs sequence {S} divisible by 2*cp = {2 * cp}")
+        if perm is None or perm.size != S:
+            perm = _zigzag_perm(S, cp)
+        out = {}
+        for k, v in batch.items():
+            v = np.asarray(v)
+            out[k] = (v[:, perm] if v.ndim >= 2 and v.shape[1] == S else v)
+        if "position_ids" not in out:
+            out["position_ids"] = np.broadcast_to(
+                perm.astype(np.int32), batch["tokens"].shape).copy()
+        yield out
+
+
 def get_data_iterator(
     args: CoreArgs, *, global_batch_size: Optional[int] = None,
-    split: str = "train",
+    split: str = "train", hpc=None,
 ) -> Iterator[Dict[str, np.ndarray]]:
     """One split's batch iterator (see
     :func:`get_train_valid_test_data_iterators` for the reference-shaped
@@ -184,11 +221,14 @@ def get_data_iterator(
                                    if data.eod_mask_loss else None))
     if args.model.model_type == "t5":
         return seq2seq_batches(it)
+    if hpc is not None and getattr(hpc, "cp_zigzag", False):
+        # plan validated by get_hybrid_parallel_config: uniform cp, causal
+        it = zigzag_cp_batches(it, hpc.layers[0].cp_size)
     return it
 
 
 def get_train_valid_test_data_iterators(
-    args: CoreArgs, *, global_batch_size: Optional[int] = None,
+    args: CoreArgs, *, global_batch_size: Optional[int] = None, hpc=None,
 ):
     """(train, valid, test) iterators (reference
     get_train_valid_test_data_iterators, runtime/dataloader.py:462). The
@@ -198,13 +238,14 @@ def get_train_valid_test_data_iterators(
     import sys
 
     train_it = get_data_iterator(args, global_batch_size=global_batch_size,
-                                 split="train")
+                                 split="train", hpc=hpc)
     valid_it = test_it = None
     if args.train.eval_interval and args.train.eval_iters:
         for name in ("valid", "test"):
             try:
                 it = get_data_iterator(
-                    args, global_batch_size=global_batch_size, split=name)
+                    args, global_batch_size=global_batch_size, split=name,
+                    hpc=hpc)
             except ValueError as e:
                 # an undersized split must degrade eval, not crash a run
                 # after the training compute is spent (the small-corpus case

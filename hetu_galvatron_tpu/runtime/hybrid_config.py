@@ -48,6 +48,10 @@ class HybridParallelConfig:
     # combined stack, so a stage may hold encoder layers, decoder layers, or
     # the enc->dec boundary.
     num_encoder_layers: int = 0
+    # Dataloader-side zigzag cp layout (reference get_batch zigzag slice,
+    # utils.py:295): sequences arrive pre-permuted; ring layers skip the
+    # in-layer layout reshard. Only set with a uniform cp > 1.
+    cp_zigzag: bool = False
     # Interleaved virtual stages (beyond the reference): pp_division has
     # pp_deg * vpp_deg entries; chunk c runs on physical group c % pp_deg.
     vpp_deg: int = 1
@@ -182,9 +186,27 @@ def get_hybrid_parallel_config(
         raise ValueError(
             f"global_bsz {global_bsz} must be a multiple of "
             f"world//pp//min_tp//min_cp = {grain}")
+    cp_zigzag = bool(getattr(args.parallel, "cp_zigzag", False))
+    if cp_zigzag:
+        cps = {s.cp_size for s in layers}
+        if len(cps) != 1:
+            # a non-ring layer would causally mask PERMUTED data by its
+            # array order — silently wrong; demand an all-ring stack
+            raise ValueError(
+                "parallel.cp_zigzag needs a UNIFORM cp degree across all "
+                f"layers (plan has {sorted(cps)}): pre-permuted sequences "
+                "are only correct when every attention layer is zigzag "
+                "ring")
+        if cps == {1}:
+            cp_zigzag = False  # no cp: the flag is a no-op
+        elif args.model.model_type in ("bert", "t5"):
+            raise ValueError(
+                "parallel.cp_zigzag is a causal-LM data layout "
+                "(bert/t5 batches are not zigzag-slicable)")
     return HybridParallelConfig(
         layers=list(layers), vocab=vocab, pp_deg=pp_deg,
         pp_division=list(pp_division), chunks=chunks, global_bsz=global_bsz,
         pipeline_type=pipeline_type, default_dp_type=default_dp,
         world_size=world_size, num_encoder_layers=n_enc, vpp_deg=vpp,
+        cp_zigzag=cp_zigzag,
     )

@@ -352,7 +352,8 @@ class PipelineEngine:
 
         overrides = attention_overrides(
             st.shardings, st.mesh,
-            use_flash=None if cfg.use_flash_attn else False)
+            use_flash=None if cfg.use_flash_attn else False,
+            cp_zigzag=getattr(self.hpc, "cp_zigzag", False))
         seg_kw = ({"segment_ids": segment_ids}
                   if segment_ids is not None else {})
         aux_total = jnp.zeros((), jnp.float32)
