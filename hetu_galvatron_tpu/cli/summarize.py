@@ -1,0 +1,169 @@
+"""Run-summary CLI: ``python -m hetu_galvatron_tpu.cli.summarize
+<metrics.jsonl>``.
+
+Reads the JSONL metrics stream a telemetry-enabled run writes
+(``observability/sinks.py`` record schema) and prints a human-readable
+throughput / MFU / memory / span summary. Counters and gauges carry their
+current value at each flush, so the LAST record per (name, labels) is the
+end-of-run state; histograms likewise snapshot cumulative percentiles.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+
+def load_records(path: str) -> List[Dict[str, Any]]:
+    """Parse the JSONL stream, tolerating a truncated tail: a run killed
+    mid-write (OOM/SIGKILL during a sink flush) leaves a partial final
+    line, and the post-mortem tool must still summarize everything before
+    it. Unparseable lines are counted and warned about, not fatal."""
+    out = []
+    bad = 0
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                bad += 1
+    if bad:
+        print(f"warning: skipped {bad} unparseable line(s) in {path} "
+              "(truncated by a crashed run?)", file=sys.stderr)
+    return out
+
+
+def last_by_name(records: List[Dict[str, Any]]
+                 ) -> Dict[Tuple[str, str, str], Dict[str, Any]]:
+    """Last record per (kind, name, labels); later lines win."""
+    latest: Dict[Tuple[str, str, str], Dict[str, Any]] = {}
+    for r in records:
+        if r.get("kind") == "event":
+            continue
+        key = (r.get("kind", ""), r.get("name", ""),
+               json.dumps(r.get("labels") or {}, sort_keys=True))
+        latest[key] = r
+    return latest
+
+
+def _fmt(v: Any) -> str:
+    if isinstance(v, float):
+        if v == 0:
+            return "0"
+        if abs(v) >= 1000:
+            return f"{v:,.0f}"
+        if abs(v) >= 1:
+            return f"{v:.2f}"
+        return f"{v:.4g}"
+    return str(v)
+
+
+def _label_str(labels: str) -> str:
+    d = json.loads(labels)
+    if not d:
+        return ""
+    return "{" + ",".join(f"{k}={v}" for k, v in sorted(d.items())) + "}"
+
+
+def summarize(path: str, out=None) -> Dict[str, Any]:
+    """Print the summary; returns the headline numbers (for tests)."""
+    out = out or sys.stdout
+    w = lambda s="": print(s, file=out)
+    records = load_records(path)
+    latest = last_by_name(records)
+
+    def get(kind: str, name: str, labels: str = "{}"
+            ) -> Optional[Dict[str, Any]]:
+        return latest.get((kind, name, labels))
+
+    headline: Dict[str, Any] = {}
+    w(f"== run summary: {path} ({len(records)} records) ==")
+    steps = get("counter", "train/steps")
+    tokens = get("counter", "train/tokens")
+    if steps:
+        headline["steps"] = steps["value"]
+        w(f"steps            {steps['value']:,.0f}")
+    if tokens:
+        headline["tokens"] = tokens["value"]
+        w(f"tokens           {tokens['value']:,.0f}")
+    st = get("histogram", "train/step_time_ms") or \
+        get("histogram", "profiler/iter_time_ms")
+    if st and st.get("count"):
+        headline["step_time_p50_ms"] = st["p50"]
+        w(f"step time ms     p50 {_fmt(st['p50'])} | p90 {_fmt(st['p90'])}"
+          f" | p99 {_fmt(st['p99'])} | mean {_fmt(st['mean'])}"
+          f" (n={st['count']})")
+    tps = get("gauge", "train/tokens_per_sec")
+    if tps:
+        headline["tokens_per_sec"] = tps["value"]
+        w(f"tokens/sec       {_fmt(tps['value'])}")
+    tfl = get("gauge", "train/model_tflops")
+    if tfl:
+        w(f"model TFLOP/s    {_fmt(tfl['value'])}")
+    mfu = get("gauge", "train/mfu")
+    if mfu:
+        headline["mfu"] = mfu["value"]
+        w(f"MFU              {mfu['value'] * 100:.1f}%")
+    for key in ("loss", "grad_norm"):
+        g = get("gauge", f"train/{key}")
+        if g:
+            w(f"final {key:<10} {_fmt(g['value'])}")
+    mems = [(lb, r) for (k, n, lb), r in latest.items()
+            if k == "gauge" and n == "device/mem_mb"]
+    if mems:
+        parts = " | ".join(
+            f"{json.loads(lb).get('stat', '?')} {_fmt(r['value'])}"
+            for lb, r in sorted(mems))
+        w(f"device mem MB    {parts}")
+    plan = get("gauge", "plan/comm_total_mb")
+    if plan:
+        w(f"plan comm MB/step (predicted)  {_fmt(plan['value'])}")
+
+    spans = [(json.loads(lb).get("path", "?"), r)
+             for (k, n, lb), r in latest.items()
+             if k == "histogram" and n == "span_ms" and r.get("count")]
+    if spans:
+        w()
+        w("-- spans (host ms) --")
+        w(f"{'path':<24}{'count':>8}{'mean':>10}{'p50':>10}{'p99':>10}")
+        for p, r in sorted(spans):
+            w(f"{p:<24}{r['count']:>8}{_fmt(r['mean']):>10}"
+              f"{_fmt(r['p50']):>10}{_fmt(r['p99']):>10}")
+
+    rest = [((k, n, lb), r) for (k, n, lb), r in sorted(latest.items())
+            if k in ("counter", "gauge")
+            and not n.startswith(("train/", "device/", "plan/"))]
+    if rest:
+        w()
+        w("-- other counters/gauges --")
+        for (k, n, lb), r in rest:
+            w(f"{n + _label_str(lb):<40} {_fmt(r['value'])}")
+
+    events = [r for r in records if r.get("kind") == "event"]
+    if events:
+        w()
+        w(f"-- events ({len(events)}) --")
+        by_name: Dict[str, int] = {}
+        for e in events:
+            by_name[e.get("name", "?")] = by_name.get(e.get("name", "?"), 0) + 1
+        for n, c in sorted(by_name.items()):
+            w(f"{n:<40} {c}")
+    return headline
+
+
+def main(argv=None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    if not argv or argv[0] in ("-h", "--help"):
+        print("usage: python -m hetu_galvatron_tpu.cli.summarize "
+              "<metrics.jsonl>")
+        return 0 if argv else 2
+    summarize(argv[0])
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

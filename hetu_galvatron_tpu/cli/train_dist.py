@@ -57,6 +57,25 @@ def train(args) -> Dict[str, Any]:
     base_iter, valid_iter, test_iter = get_train_valid_test_data_iterators(
         args, global_batch_size=hpc.global_bsz, hpc=hpc)
     data_iter = RerunDataIterator(base_iter)
+    # unified telemetry (observability/): configures the process-wide
+    # registry with JSONL (+optional TensorBoard) sinks, so the profiler's
+    # histograms, the rerun machine's counters, and the derived
+    # throughput/MFU stats all land in one metrics stream
+    telemetry = None
+    # rank-gated like the profiler's printing and TraceCapture: on a
+    # multi-host pod only process 0 writes the metrics stream (every
+    # process appending to one shared-storage JSONL would interleave)
+    if args.observability.enabled and jax.process_index() == 0:
+        from hetu_galvatron_tpu.observability.telemetry import (
+            emit_plan_telemetry,
+        )
+        from hetu_galvatron_tpu.runtime.trainer import make_telemetry
+
+        telemetry = make_telemetry(args, world_size=world,
+                                   global_batch_size=hpc.global_bsz)
+        emit_plan_telemetry(
+            telemetry.registry, hpc, cfg,
+            mixed_precision=args.parallel.mixed_precision != "fp32")
     profiler = RuntimeProfiler(args, world_size=world,
                                rank=jax.process_index())
     rerun = RerunStateMachine(args.rerun)
@@ -221,6 +240,15 @@ def train(args) -> Dict[str, Any]:
                 # re-execute the step for fault attribution
                 prev = (sp, so) if rerun.enabled else None
                 sp, so, metrics = step_fn(sp, so, batch)
+                if telemetry is not None:
+                    # before any sync below: the hook's own timing must see
+                    # the async cadence, and it never touches device values.
+                    # During a batch-size ramp the tokens-per-step must
+                    # track the RUNNING batch size, not the target
+                    if calc is not None:
+                        telemetry.global_batch_size = \
+                            calc.current_running_global_batch_size
+                    telemetry(it, metrics)
                 profiler.time_end(it, sync=metrics.get("loss"))
                 profiler.iteration_log(it, metrics, lr=float(schedule(it)))
                 rerun.validate_result(
@@ -257,9 +285,11 @@ def train(args) -> Dict[str, Any]:
                                         hpc=hpc)
                     break
         finally:
-            # crash-safe: flush an open XLA trace window so the
-            # capture survives the exception it may help debug
+            # crash-safe: flush an open XLA trace window + the metrics
+            # stream so both survive the exception they may help debug
             profiler.stop_trace()
+            if telemetry is not None:
+                telemetry.close()
         return sp, so
 
     if hpc.pp_deg > 1:

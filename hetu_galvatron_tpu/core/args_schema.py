@@ -266,6 +266,24 @@ class LoggingArgs(BaseModel):
     log_level: str = "info"
 
 
+class ObservabilityArgs(BaseModel):
+    """Unified telemetry layer knobs (``observability/``): metrics registry
+    sinks, derived training stats, and the flush cadence."""
+
+    enabled: bool = False
+    # JSONL metrics file; None derives <logging.tensorboard_dir or .>/
+    # metrics.jsonl at train time
+    metrics_path: Optional[str] = None
+    # mirror metrics into TensorBoard event files (needs tensorboardX /
+    # torch; silently skipped when absent — the path CI exercises)
+    tensorboard: bool = False
+    flush_interval: int = 16  # steps between registry flushes
+    # per-chip peak TFLOP/s override for MFU when the device_kind table
+    # (observability/telemetry.py) does not know the hardware (CPU smoke
+    # runs, new TPU generations); 0 = autodetect-or-skip
+    peak_tflops: float = 0.0
+
+
 class RerunArgs(BaseModel):
     """Fault-detection state machine knobs (reference rerun_state_machine.py)."""
 
@@ -321,6 +339,10 @@ class SearchArgs(BaseModel):
     use_cpp_core: bool = True
     parallel_search: bool = False
     log_dir: str = "logs"
+    # non-empty => append one JSONL record per explored (bsz, chunks, pp,
+    # mode, tp-cap) task + the winning plan, so search decisions are
+    # auditable after the fact (observability/sinks.py schema)
+    search_trace_path: Optional[str] = None
     output_config_path: Optional[str] = None
     # profiled-data locations
     time_profiling_path: Optional[str] = None
@@ -387,6 +409,7 @@ class CoreArgs(BaseModel):
     data: DataArgs = Field(default_factory=DataArgs)
     profile: ProfileArgs = Field(default_factory=ProfileArgs)
     logging: LoggingArgs = Field(default_factory=LoggingArgs)
+    observability: ObservabilityArgs = Field(default_factory=ObservabilityArgs)
     rerun: RerunArgs = Field(default_factory=RerunArgs)
     search: SearchArgs = Field(default_factory=SearchArgs)
     model_profiler: ModelProfileArgs = Field(default_factory=ModelProfileArgs)

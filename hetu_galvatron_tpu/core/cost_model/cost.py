@@ -409,6 +409,53 @@ def embed_memory_cost(
 
 
 # ---------------------------------------------------------------------------
+# model FLOPs accounting (telemetry: MFU denominator numerator)
+# ---------------------------------------------------------------------------
+
+
+def model_flops_per_token(model: Any, seq_length: Optional[int] = None
+                          ) -> float:
+    """Matmul FLOPs per token for one training step (forward + backward,
+    backward counted as 2x forward). ``model`` is a
+    ``core.args_schema.ModelArgs``-shaped object (duck-typed so this module
+    stays import-light).
+
+    Conventions (the standard MFU accounting, PaLM appendix B style):
+    the [S, S] attention score/value matmuls are counted dense — no causal
+    discount — and non-matmul work (norms, softmax, embedding lookup) is
+    ignored. MoE layers count only the ACTIVE experts (top-k + shared);
+    with ``moe_layer_freq = k`` every k-th layer is MoE and the rest are
+    dense (models/builder.py layer alternation).
+    """
+    h = model.hidden_size
+    s = seq_length or model.seq_length
+    nd = model.num_attention_heads * model.head_dim
+    kd = model.kv_heads * model.head_dim
+    # q/k/v/out projections + the two [S, S] batched matmuls (QK^T, PV)
+    attn = 2 * h * nd + 2 * 2 * h * kd + 2 * nd * h + 2 * 2 * s * nd
+    gated = model.hidden_act in ("swiglu", "geglu")
+
+    def mlp_flops(ffn: int) -> float:
+        return (3 if gated else 2) * 2 * h * ffn
+
+    dense_layer = attn + mlp_flops(model.ffn_dim)
+    layers = model.num_hidden_layers + (model.num_encoder_layers or 0
+                                        if model.model_type == "t5" else 0)
+    if model.num_experts:
+        moe_ffn = model.moe_ffn_hidden_size or model.ffn_dim
+        active = model.moe_topk + model.num_shared_experts
+        moe_layer = (attn + 2 * h * model.num_experts  # router
+                     + active * mlp_flops(moe_ffn))
+        freq = max(model.moe_layer_freq, 1)
+        n_moe = layers // freq
+        fwd = n_moe * moe_layer + (layers - n_moe) * dense_layer
+    else:
+        fwd = layers * dense_layer
+    fwd += 2 * h * model.padded_vocab_size  # LM head
+    return 3.0 * fwd
+
+
+# ---------------------------------------------------------------------------
 # pipeline schedule cost
 # ---------------------------------------------------------------------------
 

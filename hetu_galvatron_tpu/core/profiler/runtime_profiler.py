@@ -12,6 +12,14 @@ time once warm), memory uses `device.memory_stats()` when the backend
 provides it (TPU does) and falls back to the jitted executable's
 `memory_analysis()` — XLA's own static accounting — on backends without
 allocator stats (CPU tests).
+
+Everything measured here is also routed through the observability metrics
+registry (``observability/registry.py``): iteration times land in the
+``profiler/iter_time_ms`` histogram, memory probes in ``profiler/mem_mb``
+gauges, and the MoE balance tracker in ``moe/*`` gauges, so a configured
+JSONL/TensorBoard sink sees the profiler's view of the run without any
+extra plumbing. The XLA trace window is delegated to
+``observability.tracing.TraceCapture``.
 """
 
 from __future__ import annotations
@@ -25,6 +33,11 @@ import jax
 
 from hetu_galvatron_tpu.core.args_schema import CoreArgs
 from hetu_galvatron_tpu.core.search_engine.profiles import write_json
+from hetu_galvatron_tpu.observability.registry import (
+    MetricsRegistry,
+    get_registry,
+)
+from hetu_galvatron_tpu.observability.tracing import TraceCapture
 
 MB = 1024 * 1024
 
@@ -65,37 +78,40 @@ class RuntimeProfiler:
     memory probes at phase boundaries (reference profile_memory :105,
     post_profile_memory :134, profile_time_start :218)."""
 
-    def __init__(self, args: CoreArgs, world_size: int = 1, rank: int = 0):
+    def __init__(self, args: CoreArgs, world_size: int = 1, rank: int = 0,
+                 registry: Optional[MetricsRegistry] = None):
         self.args = args
         self.world_size = world_size
         self.rank = rank
+        # None = late-bind the process default at USE time, so a profiler
+        # constructed before the train launcher configures sinks still
+        # lands its metrics in the configured stream
+        self._registry = registry
         self.time_samples: List[float] = []
         self.memory_samples: Dict[str, Dict[str, float]] = {}
         self._t0: Optional[float] = None
         self.enabled = bool(args.profile.profile)
-        self._tracing = False
-        self._traced_iters = 0
+        p = args.profile
+        self._trace = TraceCapture(
+            p.trace_dir, start_iter=p.profile_warmup,
+            num_iters=p.trace_iters, enabled=bool(p.trace_dir and rank == 0))
+        self._tracing_now = False
+
+    @property
+    def registry(self) -> MetricsRegistry:
+        return self._registry if self._registry is not None else get_registry()
 
     # -- timing -------------------------------------------------------------
 
     def time_start(self, it: int) -> None:
-        p = self.args.profile
-        if p.trace_dir and self.rank == 0:
-            # XLA trace window [warmup, warmup + trace_iters): the TPU
-            # counterpart of the reference's torch.profiler capture.
-            # Window-based (not ==) so checkpoint-resumed runs whose first
-            # iteration is already past warmup still capture a window.
-            if (not self._tracing and self._traced_iters == 0
-                    and it >= p.profile_warmup):
-                jax.profiler.start_trace(p.trace_dir)
-                self._tracing = True
-            elif self._tracing:
-                self._traced_iters += 1
-                if self._traced_iters >= p.trace_iters:
-                    self.stop_trace()
+        # XLA trace window [warmup, warmup + trace_iters): the TPU
+        # counterpart of the reference's torch.profiler capture
+        # (observability/tracing.py — window-based so checkpoint-resumed
+        # runs whose first iteration is already past warmup still capture)
+        self._tracing_now = self._trace.step(it)
         if not self.enabled or it < self.args.profile.profile_warmup:
             return
-        if self._tracing:
+        if self._tracing_now:
             # trace instrumentation inflates step time; traced iterations
             # stay out of time_samples so filtered_time_ms (and the
             # computation profiles the search engine fits) stay clean
@@ -104,16 +120,16 @@ class RuntimeProfiler:
 
     def stop_trace(self) -> None:
         """Idempotent; also called at loop exit so short runs still flush."""
-        if self._tracing:
-            jax.profiler.stop_trace()
-            self._tracing = False
+        self._trace.stop()
 
     def time_end(self, it: int, sync: Any = None) -> None:
         if self._t0 is None:
             return
         if sync is not None:
             jax.block_until_ready(sync)
-        self.time_samples.append((time.perf_counter() - self._t0) * 1000.0)
+        ms = (time.perf_counter() - self._t0) * 1000.0
+        self.time_samples.append(ms)
+        self.registry.histogram("profiler/iter_time_ms").observe(ms)
         self._t0 = None
 
     def filtered_time_ms(self) -> float:
@@ -134,17 +150,36 @@ class RuntimeProfiler:
         stats = device_memory_mb(device)
         if stats is not None:
             self.memory_samples[phase] = stats
+            for stat, v in stats.items():
+                self.registry.gauge("profiler/mem_mb", phase=phase,
+                                    stat=stat).set(v)
 
     def record_static_memory(self, compiled) -> None:
         if not self.enabled:
             return
-        self.memory_samples["compiled"] = compiled_memory_mb(compiled)
+        mem = compiled_memory_mb(compiled)
+        self.memory_samples["compiled"] = mem
+        for stat, v in mem.items():
+            self.registry.gauge("profiler/mem_mb", phase="compiled",
+                                stat=stat).set(v)
 
     # -- logging + output ---------------------------------------------------
 
     def iteration_log(self, it: int, metrics: Dict[str, Any],
                       lr: Optional[float] = None) -> str:
-        """One line per iteration (reference runtime_profiler.py:333-370)."""
+        """One line per iteration (reference runtime_profiler.py:333-370).
+
+        Returns EXACTLY the line that was printed, or "" on non-printing
+        iterations (rank != 0 or off the log interval) — the return value
+        is consistent for every caller, and off-interval iterations pay
+        ZERO device-to-host syncs: all float()/asarray() formatting
+        (including the MoE balance tracker) is gated behind the interval,
+        never half of it.
+        """
+        printing = (self.rank == 0 and self.args.logging.log_interval
+                    and it % self.args.logging.log_interval == 0)
+        if not printing:
+            return ""
         bits = [f"iter {it}"]
         if "loss" in metrics:
             bits.append(f"loss {float(metrics['loss']):.4f}")
@@ -154,26 +189,24 @@ class RuntimeProfiler:
             bits.append(f"lr {lr:.3e}")
         if self.time_samples:
             bits.append(f"iter-time {self.time_samples[-1]:.1f}ms")
-        printing = (self.rank == 0 and self.args.logging.log_interval
-                    and it % self.args.logging.log_interval == 0)
-        if "moe" in metrics and printing:
+        if "moe" in metrics:
             # per-layer balance tracker (reference moe_utils.py:608-644
             # track_moe_metrics log lines): aux/z-loss per MoE layer plus
-            # the tokens-per-expert imbalance max/mean. Formatted only when
-            # the line prints — float()/asarray() are blocking
-            # device-to-host syncs that must not tax every iteration
-            import numpy as _np
-
+            # the tokens-per-expert imbalance max/mean; the converted
+            # scalars also land in the registry as moe/* gauges
             for name in sorted(metrics["moe"]):
                 st = metrics["moe"][name]
-                tpe = _np.asarray(st["tokens_per_expert"], dtype=float)
+                tpe = np.asarray(st["tokens_per_expert"], dtype=float)
                 imb = float(tpe.max() / max(tpe.mean(), 1e-9))
-                bits.append(
-                    f"moe[{name}] aux {float(st['load_balance_loss']):.3e} "
-                    f"z {float(st['z_loss']):.3e} imb {imb:.2f}")
+                aux = float(st["load_balance_loss"])
+                z = float(st["z_loss"])
+                bits.append(f"moe[{name}] aux {aux:.3e} "
+                            f"z {z:.3e} imb {imb:.2f}")
+                self.registry.gauge("moe/aux_loss", layer=name).set(aux)
+                self.registry.gauge("moe/z_loss", layer=name).set(z)
+                self.registry.gauge("moe/imbalance", layer=name).set(imb)
         line = " | ".join(bits)
-        if printing:
-            print(line, flush=True)
+        print(line, flush=True)
         return line
 
     def computation_profile_key(self, layertype: int, bsz: int,

@@ -252,14 +252,50 @@ class SearchEngine:
             with cf.ThreadPoolExecutor(max_workers=min(8, len(tasks))) as ex:
                 results = list(ex.map(solve, tasks))
         else:
-            results = map(solve, tasks)
+            results = list(map(solve, tasks))
         best = TaskResult()
         for r in results:
             if r.throughput > best.throughput:
                 best = r
+        self._write_search_trace(tasks, results, best)
         if best.throughput > 0:
             self.save_results(best)
         return best.throughput
+
+    def _write_search_trace(self, tasks, results, best: TaskResult) -> None:
+        """Audit trail: one JSONL event per explored task + the winner
+        (args.search_trace_path; observability/sinks.py record schema), so
+        "why did the search pick this plan" is answerable after the fact."""
+        if not self.args.search_trace_path:
+            return
+        import time as _time
+
+        from hetu_galvatron_tpu.observability.sinks import JsonlSink
+        from hetu_galvatron_tpu.utils.strategy import form_strategy
+
+        sink = JsonlSink(self.args.search_trace_path)
+        for (gbsz, chunks, pp, mode, cap), r in zip(tasks, results):
+            data = {"bsz": gbsz, "chunks": chunks, "pp": pp, "mode": mode,
+                    "max_tp": cap, "throughput": r.throughput,
+                    "time_cost": (None if r.time_cost == float("inf")
+                                  else r.time_cost),
+                    "feasible": r.strategy_list is not None}
+            if r.strategy_list is not None:
+                data["pp_division"] = r.pp_stage_list
+                data["memory_cost_mb"] = r.memory_cost
+                data["vocab"] = {"vtp": r.vocab_tp_sp, "vsp": r.vocab_sp,
+                                 "embed_sdp": r.vocab_sdp}
+            sink.write({"t": _time.time(), "kind": "event",
+                        "name": "search_task", "data": data})
+        win = {"throughput": best.throughput, "bsz": best.bsz,
+               "chunks": best.chunks, "pp": best.pp_size,
+               "feasible": best.strategy_list is not None}
+        if best.strategy_list is not None:
+            win["strategies"] = [form_strategy(s.to_runtime())
+                                 for s in best.strategy_list]
+        sink.write({"t": _time.time(), "kind": "event",
+                    "name": "search_best", "data": win})
+        sink.close()
 
     # ---------------- per-task DP ----------------
 

@@ -45,12 +45,25 @@ def _default_client():
 
 
 _ABSENT_MARKERS = ("nosuchkey", "nosuchbucket", "not found", "404")
+_ABSENT_CODES = {"404", "NoSuchKey", "NoSuchBucket"}
 
 
 def _is_absent_error(e: Exception) -> bool:
     """Whether a client exception means 'object does not exist' (the only
     error an OPTIONAL file may swallow — a throttle/auth failure on the
-    meta sidecar must not silently disable eod masking / vocab checks)."""
+    meta sidecar must not silently disable eod masking / vocab checks).
+
+    boto3 ``ClientError``s are classified STRUCTURALLY via
+    ``e.response['Error']['Code']``; other botocore exceptions (connection
+    / endpoint failures, whose stringification can accidentally contain
+    'not found' — e.g. DNS 'host not found') are never absence. The string
+    heuristic survives only for injected test clients that raise plain
+    exceptions."""
+    resp = getattr(e, "response", None)
+    if isinstance(resp, dict) and isinstance(resp.get("Error"), dict):
+        return str(resp["Error"].get("Code", "")) in _ABSENT_CODES
+    if type(e).__module__.partition(".")[0] in ("botocore", "boto3"):
+        return False  # structured error without an absence code: transient
     return any(m in f"{type(e).__name__}: {e}".lower()
                for m in _ABSENT_MARKERS)
 
@@ -107,6 +120,11 @@ def localize_prefix(prefix: str, cache_dir: Optional[str] = None,
         target = local_prefix + ext
         if os.path.exists(target):
             return
+        if not required and os.path.exists(target + ".absent"):
+            # negatively-cached 404: a meta-less corpus with a warm
+            # .idx/.bin cache must not construct an S3 client (and demand
+            # boto3 + network) on every startup just to re-confirm absence
+            return
         cl = get_client()  # outside the try: a missing-boto3 RuntimeError
         # must surface as itself, not as a fetch failure
         fd, tmp = tempfile.mkstemp(dir=os.path.dirname(target),
@@ -125,6 +143,8 @@ def localize_prefix(prefix: str, cache_dir: Optional[str] = None,
                     f"transient error fetching optional {prefix}{ext}: "
                     f"{e} — refusing to silently run without the "
                     "tokenizer sidecar") from e
+            with open(target + ".absent", "w") as f:
+                f.write("confirmed absent; delete to re-probe\n")
             return
         os.replace(tmp, target)
 
@@ -139,7 +159,11 @@ def localize_prefix(prefix: str, cache_dir: Optional[str] = None,
                 f"cached {local_prefix}.idx/.bin disagree on corpus size "
                 "even after refetch; clear the cache dir and check the "
                 "remote corpus integrity")
-        for ext in (".idx", ".bin"):
+        # purge the pair AND the meta sidecar/absence marker: the refetched
+        # corpus version may have gained, changed, or dropped its sidecar —
+        # pairing v2 tokens with v1's vocab/eod metadata would be silent
+        # corruption of exactly the kind _validate_pair exists to stop
+        for ext in (".idx", ".bin", ".meta.json", ".meta.json.absent"):
             if os.path.exists(local_prefix + ext):
                 os.unlink(local_prefix + ext)
     return local_prefix

@@ -1,0 +1,64 @@
+"""Unified training telemetry: metrics registry, trace spans, derived stats.
+
+The measurement substrate the ROADMAP's "measurably faster" contract needs:
+
+* :mod:`registry` — process-wide counters/gauges/histograms with labels,
+  flushed to pluggable sinks (JSONL always available; TensorBoard when
+  ``tensorboardX``/``torch``/``tf`` is importable, else a no-op).
+* :mod:`sinks` — the sink implementations and the JSONL record schema.
+* :mod:`tracing` — host-side ``span("fwd")`` context managers that also
+  emit ``jax.profiler.TraceAnnotation`` so the same names show up inside
+  XLA device traces, plus the windowed ``jax.profiler.start_trace`` hook.
+* :mod:`telemetry` — derived training stats: tokens/sec, step-time
+  percentiles, model-FLOPs utilization (FLOPs accounting lives in
+  ``core/cost_model/cost.py``), device memory gauges, and per-strategy
+  predicted comm volume from the plan JSON.
+
+Everything here is host-side and sync-free: nothing in the hot loop calls
+``float()`` on a device value (see ``TrainingTelemetry``'s lagged drain),
+so attaching telemetry never serializes XLA's async dispatch.
+"""
+
+from hetu_galvatron_tpu.observability.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    configure,
+    get_registry,
+    set_registry,
+)
+from hetu_galvatron_tpu.observability.sinks import (
+    JsonlSink,
+    NullSink,
+    TensorBoardSink,
+    make_tensorboard_sink,
+)
+from hetu_galvatron_tpu.observability.tracing import (
+    TraceCapture,
+    span,
+)
+from hetu_galvatron_tpu.observability.telemetry import (
+    TrainingTelemetry,
+    peak_device_tflops,
+    plan_comm_volume,
+)
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "configure",
+    "get_registry",
+    "set_registry",
+    "JsonlSink",
+    "NullSink",
+    "TensorBoardSink",
+    "make_tensorboard_sink",
+    "TraceCapture",
+    "span",
+    "TrainingTelemetry",
+    "peak_device_tflops",
+    "plan_comm_volume",
+]

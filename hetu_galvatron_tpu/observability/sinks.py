@@ -1,0 +1,164 @@
+"""Metric sinks: where registry snapshots and span/event records land.
+
+Record schema (one JSON object per line in the JSONL sink):
+
+  {"t": <unix seconds>, "kind": "counter"|"gauge",
+   "name": ..., "value": ..., "labels": {...}, "step": <int|null>}
+  {"t": ..., "kind": "histogram", "name": ..., "labels": {...},
+   "count": n, "mean": ..., "min": ..., "max": ...,
+   "p50": ..., "p90": ..., "p99": ..., "step": ...}
+  {"t": ..., "kind": "event", "name": ..., "data": {...}}
+
+Counters/gauges carry their CURRENT value at flush time (not deltas), so
+the last record per name in a file is the end-of-run value and any record
+stream is trivially resumable. ``cli/summarize.py`` consumes this schema.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+
+class NullSink:
+    """Swallows everything; the sink CI exercises on tensorboard-less
+    images."""
+
+    def write(self, record: Dict[str, Any]) -> None:
+        pass
+
+    def flush(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+class JsonlSink:
+    """Append-only JSONL file sink (always available — no deps).
+
+    Records are buffered in memory and written on ``flush()`` so the hot
+    loop never blocks on file I/O; ``close()`` flushes. The file is opened
+    lazily on first flush so constructing a sink for a run that emits
+    nothing leaves no artifact behind.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        self._buf: List[str] = []
+        self._f = None
+
+    def write(self, record: Dict[str, Any]) -> None:
+        self._buf.append(json.dumps(record, separators=(",", ":"),
+                                    default=_jsonable))
+    def flush(self) -> None:
+        if not self._buf:
+            return
+        if self._f is None:
+            d = os.path.dirname(os.path.abspath(self.path))
+            os.makedirs(d, exist_ok=True)
+            self._f = open(self.path, "a")
+        self._f.write("\n".join(self._buf) + "\n")
+        self._f.flush()
+        self._buf.clear()
+
+    def close(self) -> None:
+        self.flush()
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+
+def _jsonable(x):
+    """Last-resort encoder: numpy / jax scalars -> python numbers."""
+    try:
+        return float(x)
+    except (TypeError, ValueError):
+        return str(x)
+
+
+class TensorBoardSink:
+    """Scalar forwarding to a TensorBoard event file via whichever writer
+    the image has (tensorboardX, torch, or tf.summary). Use
+    :func:`make_tensorboard_sink` to construct one — it degrades to
+    ``None`` (caller skips the sink) when no writer library is importable
+    or ``HGTPU_NO_TENSORBOARD`` is set, which is the path CI exercises."""
+
+    def __init__(self, writer):
+        self._w = writer
+        self._last_step = 0
+
+    def write(self, record: Dict[str, Any]) -> None:
+        kind = record.get("kind")
+        step = record.get("step")
+        if step is None:
+            # step-less flushes (telemetry.close() at loop exit) extend the
+            # last seen step instead of stomping the chart's x=0 point
+            step = self._last_step
+        else:
+            step = int(step)
+            self._last_step = max(self._last_step, step)
+        name = record.get("name", "")
+        labels = record.get("labels") or {}
+        if labels:
+            name += "{" + ",".join(f"{k}={v}" for k, v in
+                                   sorted(labels.items())) + "}"
+        if kind in ("counter", "gauge"):
+            self._w.add_scalar(name, float(record["value"]), step)
+        elif kind == "histogram" and record.get("count"):
+            for q in ("p50", "p90", "p99"):
+                self._w.add_scalar(f"{name}/{q}", float(record[q]), step)
+
+    def flush(self) -> None:
+        self._w.flush()
+
+    def close(self) -> None:
+        self.flush()
+        if hasattr(self._w, "close"):
+            self._w.close()
+
+
+class _TfScalarWriter:
+    """add_scalar-shaped adapter over ``tf.summary`` (the fallback for
+    images that bundle TensorFlow but neither tensorboardX nor torch)."""
+
+    def __init__(self, logdir: str):
+        import tensorflow as tf
+
+        self._tf = tf
+        self._w = tf.summary.create_file_writer(logdir)
+
+    def add_scalar(self, name: str, value: float, step: int) -> None:
+        with self._w.as_default():
+            self._tf.summary.scalar(name, value, step=step)
+
+    def flush(self) -> None:
+        self._w.flush()
+
+    def close(self) -> None:
+        self._w.close()
+
+
+def make_tensorboard_sink(logdir: str) -> Optional[TensorBoardSink]:
+    """TensorBoardSink via whichever writer library the image has
+    (tensorboardX -> torch -> tf.summary), else None.
+
+    ``HGTPU_NO_TENSORBOARD=1`` forces the None path (how CI pins the
+    no-tensorboard behaviour on images that do bundle a writer)."""
+    if os.environ.get("HGTPU_NO_TENSORBOARD"):
+        return None
+    try:
+        from tensorboardX import SummaryWriter
+        return TensorBoardSink(SummaryWriter(logdir))
+    except ImportError:
+        pass
+    try:
+        from torch.utils.tensorboard import SummaryWriter
+        return TensorBoardSink(SummaryWriter(logdir))
+    except ImportError:
+        pass
+    try:
+        return TensorBoardSink(_TfScalarWriter(logdir))
+    except ImportError:
+        return None

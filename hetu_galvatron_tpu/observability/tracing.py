@@ -1,0 +1,107 @@
+"""Host-side trace spans + windowed XLA device-trace capture.
+
+``span("fwd")`` measures host wall-clock for a code region AND enters a
+``jax.profiler.TraceAnnotation``, so the same name shows up on the host
+track of an XLA device trace (captured with :class:`TraceCapture` /
+``jax.profiler.start_trace``, viewed in tensorboard/xprof). Under async
+dispatch a host span around jitted calls measures DISPATCH time, not device
+time — that is the point: a hot dispatch loop (e.g. the pipeline
+controller) shows up here, while device time lives in the captured trace
+under the same annotation names.
+
+Span durations aggregate into the registry as ``span_ms`` histograms
+labelled by the nesting path (``train/step``, ``pp/fwd_s0``, ...), so
+per-iteration spans cost one histogram observe — no per-span records, no
+unbounded JSONL growth.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from typing import Optional
+
+from hetu_galvatron_tpu.observability.registry import (
+    MetricsRegistry,
+    get_registry,
+)
+
+_tls = threading.local()
+
+
+def current_span_path() -> str:
+    """Slash-joined names of the open spans on this thread ('' outside)."""
+    return "/".join(getattr(_tls, "stack", []))
+
+
+@contextmanager
+def span(name: str, registry: Optional[MetricsRegistry] = None):
+    """Measure a region; nests ('train/step' inside 'train' -> path
+    'train/train/step' is avoided by naming spans hierarchically at the
+    call site). Re-entrant and thread-safe (per-thread stacks)."""
+    import jax
+
+    reg = registry or get_registry()
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _tls.stack = []
+    stack.append(name)
+    path = "/".join(stack)
+    t0 = time.perf_counter()
+    try:
+        with jax.profiler.TraceAnnotation(name):
+            yield
+    finally:
+        dur_ms = (time.perf_counter() - t0) * 1000.0
+        stack.pop()
+        reg.histogram("span_ms", path=path).observe(dur_ms)
+
+
+class TraceCapture:
+    """Opt-in windowed ``jax.profiler.start_trace`` capture.
+
+    ``step(it)`` starts the trace when ``it`` ENTERS the window
+    [start_iter, start_iter + num_iters) and stops it on leaving; the
+    window test is ">= start" (not "=="), so a checkpoint-resumed run whose
+    first iteration is already past ``start_iter`` still captures a full
+    window. One capture per process lifetime; rank-gating is the caller's
+    job (pass ``enabled=False`` on non-zero ranks).
+    """
+
+    def __init__(self, trace_dir: str, start_iter: int = 0,
+                 num_iters: int = 3, enabled: bool = True):
+        self.trace_dir = trace_dir
+        self.start_iter = start_iter
+        self.num_iters = num_iters
+        self.enabled = bool(enabled and trace_dir)
+        self.active = False
+        self._captured = 0
+
+    def step(self, it: int) -> bool:
+        """Advance the window; returns True while this iteration is being
+        traced (callers keep traced iterations out of timing stats — the
+        instrumentation inflates step time)."""
+        if not self.enabled:
+            return False
+        if self.active:
+            self._captured += 1
+            if self._captured >= self.num_iters:
+                self.stop()
+            return self.active
+        if self._captured == 0 and it >= self.start_iter:
+            import jax
+
+            jax.profiler.start_trace(self.trace_dir)
+            self.active = True
+            return True
+        return False
+
+    def stop(self) -> None:
+        """Idempotent; call at loop exit so short/crashing runs still flush
+        the capture."""
+        if self.active:
+            import jax
+
+            jax.profiler.stop_trace()
+            self.active = False

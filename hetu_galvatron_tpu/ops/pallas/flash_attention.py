@@ -29,18 +29,27 @@ NEG_INF = float(jnp.finfo(jnp.float32).min)
 
 def keep_mask(seed, bn, qpos, kpos, s_total: int, rate: float):
     """Deterministic counter-based dropout keep-mask (splitmix32 finalizer
-    over a per-element counter). Depends only on GLOBAL coordinates
+    chain over global coordinates). Depends only on GLOBAL coordinates
     (seed, batch*heads index, q position, k position), so forward/backward
     kernels regenerate identical masks regardless of tile sizes — the same
     property the reference gets from flash-attn's saved philox state. Plain
     integer ops only: lowers under Mosaic AND interpret mode (pltpu.prng_*
     has no CPU lowering), and a pure-JAX caller over full index grids is
     the test reference. qpos/kpos are int32 arrays broadcastable to the
-    mask shape; returns bool (True = keep)."""
+    mask shape; returns bool (True = keep).
+
+    ``s_total`` is unused by the hash and kept only for call-site
+    compatibility: qpos and kpos are mixed through SEPARATE finalizer
+    rounds instead of a linear ``qpos * s_total + kpos`` counter, which
+    wrapped uint32 once s_total exceeded 2**16 (S^2 >= 2^32) and aliased
+    masks between distant (qpos, kpos) pairs within one head. With the
+    chained mix there is no sequence-length bound; distinct coordinate
+    pairs collide only by hash accident, like head streams."""
     import numpy as np
 
     # numpy scalar literals (NOT jnp arrays): closed-over jnp constants are
     # rejected by the pallas_call lowering
+    del s_total  # no longer bounds validity; see docstring
     u32 = jnp.uint32
     c = np.uint32
 
@@ -55,8 +64,7 @@ def keep_mask(seed, bn, qpos, kpos, s_total: int, rate: float):
     # would wrap every 2^32/S^2 heads and hand distant heads bit-identical
     # masks; after avalanche, head streams collide only by hash accident
     key = fin(seed.astype(u32) * c(0x9E3779B9) + bn.astype(u32))
-    ctr = qpos.astype(u32) * c(s_total) + kpos.astype(u32)
-    x = fin(ctr ^ key)
+    x = fin(fin(qpos.astype(u32) ^ key) ^ kpos.astype(u32))
     keep_prob = 1.0 - rate
     threshold = c(min(int(keep_prob * 2.0 ** 32), 2 ** 32 - 1))
     return x < threshold

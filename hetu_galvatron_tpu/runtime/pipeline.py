@@ -58,6 +58,7 @@ from hetu_galvatron_tpu.runtime.mesh import (
     lower_strategy,
     lower_vocab_strategy,
 )
+from hetu_galvatron_tpu.observability.tracing import span
 from hetu_galvatron_tpu.runtime.optimizer import make_lr_schedule
 
 Params = Dict[str, Any]
@@ -706,9 +707,12 @@ class PipelineEngine:
                 ctx["losses"].append(None)  # filled by the backward
             else:
                 pos, seg = extras[s]
-                y = self._fwd_jits[s](stage_params[s], x,
-                                      self._mb_rng(ctx, m, s), pos, seg)
-                x = self._transfer(y, s + 1)
+                # host span = dispatch cost; the TraceAnnotation inside
+                # carries the stage name into captured XLA device traces
+                with span(f"pp/fwd_s{s}"):
+                    y = self._fwd_jits[s](stage_params[s], x,
+                                          self._mb_rng(ctx, m, s), pos, seg)
+                    x = self._transfer(y, s + 1)
         ctx["inputs"].append(inputs)
         ctx["extras"].append(extras)
 
@@ -720,10 +724,10 @@ class PipelineEngine:
         seed = jnp.asarray(w, jnp.float32)
         n_stages = len(self.stages)
         pos, seg = extras[-1]
-        dp, dx, loss = self._bwd_jits[-1](stage_params[-1], inputs[-1], lbl,
-                                          msk, seed,
-                                          self._mb_rng(ctx, m, n_stages - 1),
-                                          pos, seg)
+        with span(f"pp/bwd_s{n_stages - 1}"):
+            dp, dx, loss = self._bwd_jits[-1](
+                stage_params[-1], inputs[-1], lbl, msk, seed,
+                self._mb_rng(ctx, m, n_stages - 1), pos, seg)
         # keep loss/aux as lazy device scalars — any host sync here would
         # serialize the schedule; train_step folds them once at the end
         aux_parts = []
@@ -731,9 +735,10 @@ class PipelineEngine:
         for s in range(n_stages - 2, -1, -1):
             dy = self._put_cotangent(dx, s)
             pos, seg = extras[s]
-            dp, dx, aux = self._bwd_jits[s](stage_params[s], inputs[s], dy,
-                                            seed, self._mb_rng(ctx, m, s),
-                                            pos, seg)
+            with span(f"pp/bwd_s{s}"):
+                dp, dx, aux = self._bwd_jits[s](
+                    stage_params[s], inputs[s], dy, seed,
+                    self._mb_rng(ctx, m, s), pos, seg)
             if self.cfg.num_experts:
                 aux_parts.append(aux)
             grad_acc[s] = _tree_add(grad_acc[s], dp)
@@ -835,13 +840,14 @@ class PipelineEngine:
         gnorm_dev, scale_dev = self._clip_jit(total_sq)
 
         new_params, new_opts = [], []
-        for s in range(len(self.stages)):
-            scale_s = (scale_dev if s == 0 else jax.device_put(
-                scale_dev, NamedSharding(self.stages[s].mesh, P())))
-            p, o = self._update_jits[s](stage_params[s], stage_opts[s],
-                                        grad_acc[s], scale_s)
-            new_params.append(p)
-            new_opts.append(o)
+        with span("pp/update"):
+            for s in range(len(self.stages)):
+                scale_s = (scale_dev if s == 0 else jax.device_put(
+                    scale_dev, NamedSharding(self.stages[s].mesh, P())))
+                p, o = self._update_jits[s](stage_params[s], stage_opts[s],
+                                            grad_acc[s], scale_s)
+                new_params.append(p)
+                new_opts.append(o)
         # single host sync at the very end (all device work already queued)
         loss = sum(float(w) * (float(l) + sum(float(a) for a in aux))
                    for w, l, aux in zip(weights, ctx["losses"], ctx["aux"]))

@@ -24,6 +24,7 @@ from enum import Enum
 from typing import Any, Callable, Dict, Iterator, List, Optional
 
 from hetu_galvatron_tpu.core.args_schema import RerunArgs
+from hetu_galvatron_tpu.observability.registry import get_registry
 
 # reference exit codes (rerun_state_machine.py:33-37)
 EXIT_CODE_RESUME_TO_DISAMBIGUATE = 16
@@ -155,7 +156,7 @@ class RerunStateMachine:
     """Wraps the host train loop's step result (reference
     should_run_forward_backward :251 / validate_result :434)."""
 
-    def __init__(self, args: RerunArgs):
+    def __init__(self, args: RerunArgs, registry=None):
         self.args = args
         self.state = RerunState.NOT_RUNNING_YET
         self.records: List[RerunRecord] = []
@@ -164,6 +165,18 @@ class RerunStateMachine:
         self._ema: Optional[float] = None
         self._last_exit_code: Optional[int] = None
         self.determinism_stats = DeterminismStats()
+        # state transitions double as observability counters (rerun/*), so
+        # a fleet dashboard sees fault attribution without parsing logs.
+        # None late-binds the process default at increment time (the train
+        # launcher may configure sinks after constructing this machine)
+        self._registry = registry
+
+    @property
+    def registry(self):
+        return self._registry if self._registry is not None else get_registry()
+
+    def _count(self, name: str, **labels) -> None:
+        self.registry.counter(f"rerun/{name}", **labels).inc()
 
     @property
     def enabled(self) -> bool:
@@ -201,6 +214,7 @@ class RerunStateMachine:
             return RerunDiagnostic.CORRECT
         value = self.injector.maybe_corrupt(value, iteration, attempt=0)
         self.state = RerunState.RUNNING
+        self._count("validated")
 
         if self.args.mode == "report_stats":
             # determinism-stats mode (reference REPORT_DETERMINISM_STATS,
@@ -210,6 +224,7 @@ class RerunStateMachine:
             # difference is exactly 0 — any nonzero entry is a finding.
             if rerun_fn is not None:
                 self.state = RerunState.RERUNNING_IN_PLACE
+                self._count("rerun_in_place")
                 if data_iterator is not None:
                     data_iterator.rewind()
                 # injector applies to the re-run too (attempt=1), matching
@@ -225,6 +240,7 @@ class RerunStateMachine:
                 if not (math.isnan(rerun_value) and math.isnan(value)):
                     self.determinism_stats.record(rerun_value, value)
                 if not same:
+                    self._count("determinism_mismatch")
                     self.records.append(RerunRecord(
                         iteration=iteration, value=value,
                         rerun_value=rerun_value,
@@ -239,10 +255,12 @@ class RerunStateMachine:
             self._update_ema(value)
             return RerunDiagnostic.CORRECT
 
+        self._count("suspect")
         diagnostic = RerunDiagnostic.PERSISTENT_ERROR
         rerun_value: Optional[float] = None
         if rerun_fn is not None:
             self.state = RerunState.RERUNNING_IN_PLACE
+            self._count("rerun_in_place")
             if data_iterator is not None:
                 data_iterator.rewind()
             rerun_value = self.injector.maybe_corrupt(
@@ -254,12 +272,14 @@ class RerunStateMachine:
         self.records.append(RerunRecord(
             iteration=iteration, value=value, rerun_value=rerun_value,
             diagnostic=diagnostic, reason=reason))
+        self._count(diagnostic.value)  # transient_error / persistent_error
         self.state = RerunState.RUNNING
         if self.args.mode == "validate_results":
             self._last_exit_code = (
                 EXIT_CODE_FAILED_ON_RESULT_VALIDATION
                 if diagnostic == RerunDiagnostic.PERSISTENT_ERROR
                 else EXIT_CODE_RESUME_TO_DISAMBIGUATE)
+            self._count("exit_requested", code=self._last_exit_code)
         return diagnostic
 
     def exit_code_requested(self) -> Optional[int]:

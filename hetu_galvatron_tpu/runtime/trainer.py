@@ -140,11 +140,26 @@ def train_loop(
     tx: Optional[optax.GradientTransformation] = None,
     device_put: Callable[[Dict[str, Any]], Dict[str, jax.Array]] = None,
     hooks: Tuple[Callable, ...] = (),
+    telemetry: Optional[Any] = None,
 ) -> Tuple[Any, Any, list]:
     """Host-side iteration driver (reference train_dist.py:49-73): fetch
     batch, run jitted step, invoke profiler/logging hooks. Returns final
-    (params, opt_state, losses)."""
+    (params, opt_state, losses).
+
+    ``hooks`` are ``h(it, metrics)`` callables invoked after every step
+    with the step's (possibly still in-flight) device metrics — hooks must
+    not force a device sync. ``telemetry`` is an optional
+    ``observability.TrainingTelemetry`` appended to the hooks; it is
+    final-flushed when the loop exits (even on error) and left open for
+    the caller to reuse/close. When ``args.observability.enabled`` and no
+    instance is passed, one is built from the args (JSONL sink at
+    ``observability.metrics_path``) and closed with the loop."""
     from hetu_galvatron_tpu.models.modules import compute_dtype_of
+    from hetu_galvatron_tpu.observability.tracing import span
+
+    owns_telemetry = telemetry is None and args.observability.enabled
+    if owns_telemetry:
+        telemetry = make_telemetry(args)
 
     tx = tx or make_optimizer(args.train)
     if train_step is None:
@@ -162,15 +177,62 @@ def train_loop(
     use_dropout = (args.model.hidden_dropout > 0.0
                    or args.model.attention_dropout > 0.0)
     drop_key = jax.random.key(args.train.seed) if use_dropout else None
-    for it in range(args.train.train_iters):
-        batch = put(next(data_iter))
-        if use_dropout:
-            batch["dropout_rng"] = jax.random.fold_in(drop_key, it)
-        params, opt_state, metrics = train_step(params, opt_state, batch)
-        # keep losses on device — a float() here would block async dispatch
-        # and serialize host batch-prep against device compute
-        device_losses.append(metrics["loss"])
-        for h in hooks:
-            h(it, metrics)
+    all_hooks = hooks + ((telemetry,) if telemetry is not None else ())
+    try:
+        for it in range(args.train.train_iters):
+            with span("train/fetch"):
+                batch = put(next(data_iter))
+            if use_dropout:
+                batch["dropout_rng"] = jax.random.fold_in(drop_key, it)
+            with span("train/step"):
+                params, opt_state, metrics = train_step(
+                    params, opt_state, batch)
+            # keep losses on device — a float() here would block async
+            # dispatch and serialize host batch-prep against device compute
+            device_losses.append(metrics["loss"])
+            for h in all_hooks:
+                h(it, metrics)
+    finally:
+        # a loop-owned telemetry is closed here; a caller-supplied one is
+        # only final-flushed (the caller may reuse it across loops and
+        # closes it when done — close() re-arms on the next __call__)
+        if telemetry is not None:
+            if owns_telemetry:
+                telemetry.close()
+            else:
+                telemetry.flush(final=True)
     losses = [float(l) for l in device_losses]
     return params, opt_state, losses
+
+
+def make_telemetry(args: CoreArgs, *, registry: Any = None,
+                   world_size: int = 1, global_batch_size: Optional[int] = None
+                   ) -> Any:
+    """Build a ``TrainingTelemetry`` hook (plus its JSONL/TensorBoard
+    sinks) from ``args.observability``. When no ``registry`` is passed the
+    process-wide default registry is (re)configured with the sinks, so
+    library-level instrumentation (rerun counters, profiler histograms,
+    spans) lands in the same file."""
+    import os
+
+    from hetu_galvatron_tpu.observability.registry import configure
+    from hetu_galvatron_tpu.observability.telemetry import TrainingTelemetry
+
+    obs = args.observability
+    if registry is None:
+        path = obs.metrics_path or os.path.join(
+            args.logging.tensorboard_dir or ".", "metrics.jsonl")
+        registry = configure(
+            jsonl_path=path,
+            tensorboard_dir=(args.logging.tensorboard_dir
+                             if obs.tensorboard else None))
+    return TrainingTelemetry(
+        registry,
+        model=args.model,
+        global_batch_size=(global_batch_size
+                           or args.parallel.global_train_batch_size),
+        seq_length=args.model.seq_length,
+        world_size=world_size,
+        peak_tflops_per_device=obs.peak_tflops,
+        flush_interval=obs.flush_interval,
+    )

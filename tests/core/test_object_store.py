@@ -154,6 +154,67 @@ def test_transient_meta_error_is_loud(tmp_path):
                         client=ThrottledS3(tmp_path / "remote"))
 
 
+def test_absent_error_classification():
+    """ADVICE r5: boto3 ClientErrors classify via the structured error
+    code; a transient error whose TEXT contains 'not found' (DNS) must not
+    read as object-absent."""
+    from hetu_galvatron_tpu.data.object_store import _is_absent_error
+
+    class FakeClientError(Exception):
+        def __init__(self, code):
+            super().__init__(f"An error occurred ({code})")
+            self.response = {"Error": {"Code": code}}
+
+    assert _is_absent_error(FakeClientError("NoSuchKey"))
+    assert _is_absent_error(FakeClientError("404"))
+    assert _is_absent_error(FakeClientError("NoSuchBucket"))
+    assert not _is_absent_error(FakeClientError("SlowDown"))
+    assert not _is_absent_error(FakeClientError("AccessDenied"))
+
+    # a botocore exception WITHOUT an absence code is never absence, even
+    # when its stringification contains an absence marker (DNS failures)
+    class EndpointError(Exception):
+        pass
+
+    EndpointError.__module__ = "botocore.exceptions"
+    assert not _is_absent_error(
+        EndpointError('Could not connect: host not found'))
+    # plain injected test clients keep the string heuristic
+    assert _is_absent_error(IOError("NoSuchKey: bkt/x.meta.json"))
+    assert not _is_absent_error(IOError("SlowDown: rate exceeded"))
+
+
+def test_absent_meta_negatively_cached(tmp_path):
+    """ADVICE r5: a confirmed-absent meta sidecar writes a
+    .meta.json.absent marker, so a fully-warmed .idx/.bin cache localizes
+    WITHOUT constructing an S3 client (boto3-less TPU images)."""
+    from hetu_galvatron_tpu.data.indexed_dataset import write_indexed_dataset
+
+    prefix = os.path.join(str(tmp_path), "remote", "bkt", "x")
+    os.makedirs(os.path.dirname(prefix), exist_ok=True)
+    write_indexed_dataset(prefix, [np.arange(10, dtype=np.int32)])
+    client = FakeS3(tmp_path / "remote")
+    cache = str(tmp_path / "cache")
+    local = localize_prefix("s3://bkt/x", cache_dir=cache, client=client)
+    assert os.path.exists(local + ".meta.json.absent")
+    n_calls = len(client.calls)
+    # warm cache: no client passed — default-client construction would
+    # raise RuntimeError(boto3) on this image, so success proves the
+    # absence marker short-circuits the probe
+    again = localize_prefix("s3://bkt/x", cache_dir=cache)
+    assert again == local
+    assert len(client.calls) == n_calls
+    # the marker is purged with the pair on a version-mismatch refetch,
+    # so a re-uploaded corpus that GAINED a sidecar is noticed
+    with open(local + ".bin", "ab") as f:
+        f.write(b"\x00" * 64)
+    with open(prefix + ".meta.json", "w") as f:
+        f.write('{"vocab_size": 16, "eod_id": null}')
+    localize_prefix("s3://bkt/x", cache_dir=cache, client=client)
+    assert os.path.exists(local + ".meta.json")
+    assert not os.path.exists(local + ".meta.json.absent")
+
+
 def test_mixed_version_pair_is_refetched(tmp_path):
     """A torn cache (old .idx with a differently-sized .bin) is purged and
     refetched as a unit instead of serving garbage tokens."""

@@ -141,3 +141,37 @@ def test_report_stats_nan_handling():
     d = rep2["determinism"]
     assert d["mismatches"] == 1 and d["nonfinite"] == 1
     assert d["mean_rel_diff"] == 0.0  # finite mean unpoisoned
+
+
+def test_state_transitions_emit_counters():
+    """Fault-detection state transitions increment observability counters
+    (rerun/*) so dashboards see attribution without parsing logs."""
+    from hetu_galvatron_tpu.observability.registry import MetricsRegistry
+
+    reg = MetricsRegistry()
+    m = RerunStateMachine(RerunArgs(enable=True, mode="validate_results"),
+                          registry=reg)
+    m.validate_result(1.0, 0, rerun_fn=lambda: 1.0)        # clean
+    m.validate_result(float("nan"), 1, rerun_fn=lambda: 1.0)  # transient
+    m.validate_result(float("nan"), 2,
+                      rerun_fn=lambda: float("nan"))       # persistent
+    assert reg.counter("rerun/validated").value == 3
+    assert reg.counter("rerun/suspect").value == 2
+    assert reg.counter("rerun/rerun_in_place").value == 2
+    assert reg.counter("rerun/transient_error").value == 1
+    assert reg.counter("rerun/persistent_error").value == 1
+    assert reg.counter(
+        "rerun/exit_requested",
+        code=EXIT_CODE_RESUME_TO_DISAMBIGUATE).value == 1
+    assert reg.counter(
+        "rerun/exit_requested",
+        code=EXIT_CODE_FAILED_ON_RESULT_VALIDATION).value == 1
+
+    # report_stats mode: determinism mismatches count too
+    reg2 = MetricsRegistry()
+    m2 = RerunStateMachine(RerunArgs(enable=True, mode="report_stats"),
+                           registry=reg2)
+    m2.validate_result(1.0, 0, rerun_fn=lambda: 1.0)
+    m2.validate_result(1.0, 1, rerun_fn=lambda: 1.5)
+    assert reg2.counter("rerun/determinism_mismatch").value == 1
+    assert reg2.counter("rerun/rerun_in_place").value == 2

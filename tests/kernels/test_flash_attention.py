@@ -354,3 +354,46 @@ def test_distributed_flash_dropout(cpu_devices):
                                          dropout_rate=0.3,
                                          dropout_rng=rng) ** 2))(q)
     assert np.all(np.isfinite(np.asarray(g)))
+
+
+def test_keep_mask_no_long_sequence_aliasing():
+    """ADVICE r5: the old per-element counter qpos*s_total+kpos wrapped
+    uint32 once s_total exceeded 2**16, handing distant (qpos, kpos) pairs
+    within one head bit-identical dropout masks. The chained finalizer mix
+    has no sequence-length bound: rows that PROVABLY aliased under the old
+    scheme (qpos * s_total === 0 mod 2**32) must now differ."""
+    from hetu_galvatron_tpu.ops.pallas.flash_attention import keep_mask
+
+    s_total = 2 ** 17
+    bn = jnp.zeros((1,), jnp.int32)
+    kpos = jnp.arange(4096)[None, :]
+    rows = []
+    # old counters: 0*s+k, (2**15)*s+k = 2**32+k = k, (2**16)*s+k = k —
+    # all three rows were identical
+    for q in (0, 2 ** 15, 2 ** 16):
+        rows.append(np.asarray(keep_mask(
+            jnp.int32(7), bn, jnp.full((1, 1), q, jnp.int32), kpos,
+            s_total, 0.5)))
+    assert not np.array_equal(rows[0], rows[1])
+    assert not np.array_equal(rows[0], rows[2])
+    assert not np.array_equal(rows[1], rows[2])
+    # keep fraction stays calibrated at extreme lengths
+    for r in rows:
+        assert abs(float(r.mean()) - 0.5) < 0.05
+
+
+def test_keep_mask_tile_invariance_property():
+    """The mask depends only on global coordinates: slicing the full-grid
+    mask equals computing the mask on the slice's coordinates (the
+    property that keeps fwd/bwd kernels tile-size independent)."""
+    from hetu_galvatron_tpu.ops.pallas.flash_attention import keep_mask
+
+    S = 128
+    bn = jnp.zeros((1,), jnp.int32)
+    full = np.asarray(keep_mask(jnp.int32(3), bn,
+                                jnp.arange(S)[:, None],
+                                jnp.arange(S)[None, :], S, 0.3))
+    tile = np.asarray(keep_mask(jnp.int32(3), bn,
+                                (32 + jnp.arange(16))[:, None],
+                                (64 + jnp.arange(16))[None, :], S, 0.3))
+    np.testing.assert_array_equal(full[32:48, 64:80], tile)

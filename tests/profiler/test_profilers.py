@@ -79,6 +79,57 @@ def test_runtime_profiler_timing_and_log():
     assert "loss 1.0000" in line
 
 
+def test_iteration_log_consistent_and_sync_free(capsys):
+    """ADVICE r5: the returned string equals the PRINTED line (MoE stats
+    included) on printing iterations, and non-printing iterations return
+    "" with ZERO device-to-host conversions — never a half-formatted
+    line."""
+
+    class NoSync:
+        def __float__(self):
+            raise AssertionError("device sync on a non-printing iteration")
+
+    moe = {"layer1": {"load_balance_loss": 0.5, "z_loss": 0.25,
+                      "tokens_per_expert": np.array([3.0, 1.0])}}
+    args = CoreArgs.model_validate({"logging": {"log_interval": 2}})
+    prof = RuntimeProfiler(args)
+    # off-interval: no formatting at all -> NoSync never converted
+    assert prof.iteration_log(
+        1, {"loss": NoSync(), "grad_norm": NoSync(), "moe": moe}) == ""
+    # non-zero rank: same
+    prof_r1 = RuntimeProfiler(args, rank=1)
+    assert prof_r1.iteration_log(
+        0, {"loss": NoSync(), "grad_norm": NoSync()}) == ""
+    capsys.readouterr()
+    # printing iteration: the full line — MoE stats included — is BOTH
+    # returned and printed
+    line = prof.iteration_log(2, {"loss": 1.0, "grad_norm": 0.5,
+                                  "moe": moe})
+    printed = capsys.readouterr().out.strip()
+    assert line == printed
+    assert "moe[layer1]" in line and "imb 1.50" in line
+    # ...and the converted stats land in the metrics registry
+    assert prof.registry.gauge("moe/aux_loss", layer="layer1").value == 0.5
+    assert prof.registry.gauge("moe/imbalance", layer="layer1").value == 1.5
+
+
+def test_runtime_profiler_routes_registry(tmp_path):
+    """Iteration timing flows through the observability registry."""
+    from hetu_galvatron_tpu.observability.registry import MetricsRegistry
+
+    reg = MetricsRegistry()
+    args = CoreArgs.model_validate({"profile": {"profile": 1,
+                                                "profile_warmup": 0}})
+    prof = RuntimeProfiler(args, registry=reg)
+    for it in range(3):
+        prof.time_start(it)
+        prof.time_end(it)
+    h = reg.histogram("profiler/iter_time_ms")
+    assert h.count == 3
+    assert h.snapshot()["mean"] == pytest.approx(
+        float(np.mean(prof.time_samples)), rel=1e-6)
+
+
 def test_model_profiler_computation_schema(tmp_path):
     args = CoreArgs.model_validate({
         "model": TINY,

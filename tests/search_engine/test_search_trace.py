@@ -1,0 +1,55 @@
+"""Search-trace JSONL: every explored task + the winner is auditable
+(observability/sinks.py record schema)."""
+
+import json
+import os
+
+import pytest
+
+from hetu_galvatron_tpu.core.args_schema import SearchArgs
+from hetu_galvatron_tpu.core.search_engine.engine import SearchEngine, TaskResult
+from hetu_galvatron_tpu.core.search_engine.strategies import SearchStrategy
+from hetu_galvatron_tpu.utils.strategy import DPType
+
+pytestmark = pytest.mark.search_engine
+
+
+def _engine(trace_path):
+    args = SearchArgs(search_trace_path=trace_path)
+    return SearchEngine(args)
+
+
+def test_write_search_trace_schema(tmp_path):
+    path = str(tmp_path / "trace.jsonl")
+    eng = _engine(path)
+    tasks = [(64, 8, 1, "tp_only", 4), (64, 8, 2, "tp_with_sp", 8)]
+    s = SearchStrategy(pp=2, tp=2, dp=2, dp_type=DPType.ZERO2)
+    results = [
+        TaskResult(),  # infeasible
+        TaskResult(throughput=2.5, time_cost=25.6, strategy_list=[s, s],
+                   pp_size=2, pp_stage_list=[1, 1], memory_cost=[10.0, 9.0],
+                   vocab_tp_sp=2, bsz=64, chunks=8),
+    ]
+    eng._write_search_trace(tasks, results, results[1])
+    recs = [json.loads(l) for l in open(path)]
+    assert len(recs) == 3
+    assert [r["name"] for r in recs] == ["search_task", "search_task",
+                                         "search_best"]
+    assert all(r["kind"] == "event" for r in recs)
+    t0, t1, best = (r["data"] for r in recs)
+    assert t0 == {"bsz": 64, "chunks": 8, "pp": 1, "mode": "tp_only",
+                  "max_tp": 4, "throughput": -1.0, "time_cost": None,
+                  "feasible": False}
+    assert t1["feasible"] and t1["throughput"] == 2.5
+    assert t1["pp_division"] == [1, 1]
+    assert t1["vocab"] == {"vtp": 2, "vsp": 0, "embed_sdp": 0}
+    assert best["throughput"] == 2.5
+    assert len(best["strategies"]) == 2
+    assert "tp2" in best["strategies"][0].replace(" ", "") or \
+        "2" in best["strategies"][0]  # human-readable form_strategy string
+
+
+def test_no_trace_path_writes_nothing(tmp_path):
+    eng = _engine(None)
+    eng._write_search_trace([], [], TaskResult())
+    assert not os.listdir(tmp_path)
