@@ -27,11 +27,13 @@ def train(args) -> Dict[str, Any]:
     from hetu_galvatron_tpu.runtime.checkpoint import (
         latest_checkpoint,
         load_checkpoint,
+        read_checkpoint_meta,
         save_checkpoint,
         wait_for_checkpoints,
     )
     from hetu_galvatron_tpu.runtime.dataloader import (
         get_train_valid_test_data_iterators,
+        skip_batches,
     )
     from hetu_galvatron_tpu.runtime.hybrid_config import get_hybrid_parallel_config
     from hetu_galvatron_tpu.runtime.initialize import initialize
@@ -39,9 +41,11 @@ def train(args) -> Dict[str, Any]:
     from hetu_galvatron_tpu.runtime.optimizer import make_lr_schedule, make_optimizer
     from hetu_galvatron_tpu.runtime.pipeline import PipelineEngine
     from hetu_galvatron_tpu.runtime.rerun_machine import (
+        FaultDrill,
         RerunDataIterator,
         RerunStateMachine,
     )
+    from hetu_galvatron_tpu.runtime.supervisor import PreemptionGuard
     from hetu_galvatron_tpu.utils.hf_config_adapter import resolve_model_config
 
     args = resolve_model_config(args)
@@ -79,6 +83,10 @@ def train(args) -> Dict[str, Any]:
     profiler = RuntimeProfiler(args, world_size=world,
                                rank=jax.process_index())
     rerun = RerunStateMachine(args.rerun)
+    # preemption guard + at-step-k fault drill (runtime/supervisor.py):
+    # SIGTERM/SIGINT become a checkpoint-and-exit at the next step boundary
+    guard = PreemptionGuard(enabled=args.supervisor.graceful_signals)
+    drill = FaultDrill(args.rerun)
     start_iter = 0
 
     # batch-size ramp (reference --rampup-batch-size): the micro size
@@ -129,31 +137,51 @@ def train(args) -> Dict[str, Any]:
               for _ in range(max(args.train.eval_iters, 1))]
         return float(np.mean(vs))
 
+    exit_code = None
+    consumed_box = [0]  # ramped-run sample counter (survives maybe_resume)
+
+    def train_state_at(step, samples, batches=None):
+        """Full-state-resume payload stored in the checkpoint's meta.json:
+        data-stream position (committed batches at fixed batch size —
+        ``data_iter.batches_consumed``, which stays exact even after a
+        geometry-changed resume — or consumed samples under a ramp), the
+        RNG seed the per-step dropout keys derive from, the rerun
+        machine's fault history, and the telemetry step."""
+        if batches is None:
+            batches = step
+        ts = {"step": step, "seed": args.train.seed, "telemetry_step": step,
+              "batches_consumed": batches if calc is None else None,
+              "consumed_samples": samples if calc is not None else None}
+        if rerun.enabled:
+            ts["rerun"] = rerun.state_dict()
+        return ts
+
     def maybe_save(it, sp, so):
         ck = args.ckpt
         if ck.save and ck.save_interval and (it + 1) % ck.save_interval == 0:
             save_checkpoint(ck.save, it + 1, sp, so, hpc=hpc,
-                            async_save=ck.async_save)
+                            async_save=ck.async_save,
+                            train_state=train_state_at(
+                                it + 1, consumed_box[0],
+                                batches=data_iter.batches_consumed),
+                            keep_last=ck.keep_last)
             state.log(f"saved checkpoint at iter {it + 1}")
 
     def maybe_resume(sp, so):
         """Restore (sp, so, start_iter) and fast-forward the data stream so
         a resumed run consumes the batches an uninterrupted run would.
 
-        Even when plan resharding is allowed (strict_plan off), the stored
-        plan's global_bsz is compared so the fast-forward skips the SAMPLES
-        the original run consumed, not `start` batches at the new size —
-        preserving data order across a batch-size-changing resume (ADVICE
-        r2; the reference asserts plan equality unconditionally)."""
-        import json as _json
+        Checkpoints written by this runtime carry a ``train_state`` payload
+        (exact data position, seed, rerun history, telemetry step) making
+        the resume step-for-step continuous. Checkpoints without it (older
+        runs, converted imports) fall back to reconstructing the position
+        from the step number; even when plan resharding is allowed
+        (strict_plan off), the stored plan's global_bsz is compared so the
+        fast-forward skips the SAMPLES the original run consumed, not
+        `start` batches at the new size — preserving data order across a
+        batch-size-changing resume (ADVICE r2; the reference asserts plan
+        equality unconditionally)."""
         import math as _math
-        import os as _os
-
-        def stored_plan(ckdir):
-            mp = _os.path.join(ckdir, "meta.json")
-            if not _os.path.exists(mp):
-                return {}
-            return _json.load(open(mp)).get("hybrid_parallel_config") or {}
 
         start = 0
         if args.ckpt.load:
@@ -163,8 +191,20 @@ def train(args) -> Dict[str, Any]:
                     ckdir, sp, so, hpc=hpc,
                     strict_plan=args.ckpt.distributed_checkpoint)
                 state.log(f"resumed from {ckdir} at iter {start}")
-                stored = stored_plan(ckdir)
+                meta = read_checkpoint_meta(ckdir)
+                stored = meta.get("hybrid_parallel_config") or {}
+                ts = meta.get("train_state") or {}
                 sbsz = stored.get("global_bsz")
+                if ts.get("seed") not in (None, args.train.seed):
+                    state.log(
+                        f"warning: checkpoint seed {ts['seed']} != current "
+                        f"{args.train.seed}: the replayed data stream and "
+                        "dropout keys will differ from the original run")
+                if ts.get("rerun") and rerun.enabled:
+                    # fault history + spike EMA survive the restart, so a
+                    # resume-to-disambiguate relaunch still knows the
+                    # suspect iteration and thresholds stay warm
+                    rerun.load_state_dict(ts["rerun"])
                 if calc is not None:
                     # replay the ramp: skip exactly the samples the original
                     # run consumed over its first `start` iterations. This
@@ -187,28 +227,45 @@ def train(args) -> Dict[str, Any]:
                         n = calc.current_running_global_batch_size
                         rebatch.next_batch(n)
                         consumed += n
+                    if ts.get("consumed_samples") not in (None, consumed):
+                        state.log(
+                            f"warning: replayed ramp consumed {consumed} "
+                            f"samples but the checkpoint recorded "
+                            f"{ts['consumed_samples']}: the ramp schedule "
+                            "changed since the original run")
                     consumed_box[0] = consumed
+                    if telemetry is not None:
+                        # ramped run: token accounting must use the SAMPLES
+                        # actually consumed, not step * target batch size
+                        telemetry.resume_from(
+                            ts.get("telemetry_step", start),
+                            samples=consumed)
                     return sp, so, start
-                skip = start
+                skip = ts.get("batches_consumed")
+                if skip is None:
+                    skip = start  # legacy checkpoint: position := step
+                resumed_samples = None
                 if sbsz and sbsz != hpc.global_bsz:
-                    skip = int(_math.ceil(start * sbsz / hpc.global_bsz))
+                    # token accounting must reflect what the ORIGINAL run
+                    # consumed, not step * the new batch size
+                    resumed_samples = skip * sbsz
+                    skip = int(_math.ceil(skip * sbsz / hpc.global_bsz))
                     state.log(
                         f"warning: resuming a run trained at global_bsz "
                         f"{sbsz} with global_bsz {hpc.global_bsz}; "
                         f"fast-forwarding {skip} batches "
-                        f"({start * sbsz} samples) to preserve data order")
+                        f"({resumed_samples} samples) to preserve data "
+                        "order")
                 elif stored.get("chunks") not in (None, hpc.chunks):
                     state.log(
                         f"warning: checkpoint chunks {stored.get('chunks')} "
                         f"!= current {hpc.chunks}; gradient accumulation "
                         "boundaries will differ from the original run")
-                for _ in range(skip):
-                    next(data_iter)
-                    data_iter.advance()
+                if telemetry is not None:
+                    telemetry.resume_from(ts.get("telemetry_step", start),
+                                          samples=resumed_samples)
+                skip_batches(data_iter, skip)
         return sp, so, start
-
-    exit_code = None
-    consumed_box = [0]  # ramped-run sample counter (survives maybe_resume)
 
     use_dropout = (cfg.hidden_dropout > 0.0 or cfg.attention_dropout > 0.0)
     drop_key = jax.random.key(args.train.seed) if use_dropout else None
@@ -217,9 +274,13 @@ def train(args) -> Dict[str, Any]:
         """Shared iteration driver for both execution paths. step_fn(sp, so,
         raw_batch) -> (sp, so, metrics)."""
         nonlocal exit_code
+        drill.arm(start_iter)
+        consumed_prev = consumed_box[0]
+        guard.__enter__()  # trap SIGTERM/SIGINT for the loop's duration
         try:
             for it in range(start_iter, args.train.train_iters):
                 profiler.time_start(it)
+                consumed_prev = consumed_box[0]
                 if calc is not None:
                     if calc.update(consumed_box[0]):
                         state.log(f"ramping global batch size to "
@@ -251,15 +312,20 @@ def train(args) -> Dict[str, Any]:
                     telemetry(it, metrics)
                 profiler.time_end(it, sync=metrics.get("loss"))
                 profiler.iteration_log(it, metrics, lr=float(schedule(it)))
+                # at-step-k fault drill: may corrupt the loss (nan/spike,
+                # exercising the rerun machine), raise InjectedCrash, or
+                # deliver a real SIGTERM the guard converts to a
+                # boundary stop — all AFTER the update, BEFORE any save
+                lossf = drill.apply(float(metrics["loss"]), it)
                 rerun.validate_result(
-                    float(metrics["loss"]), it,
+                    lossf, it,
                     rerun_fn=(
                         (lambda: float(step_fn(*prev, batch)[2]["loss"]))
                         if prev is not None else None),
                     data_iterator=data_iter if calc is None else None)
                 if calc is None:
                     data_iter.advance()
-                losses.append(float(metrics["loss"]))
+                losses.append(lossf)
                 if (valid_iter is not None and "fn" in eval_box
                         and args.train.eval_interval
                         and (it + 1) % args.train.eval_interval == 0):
@@ -281,10 +347,48 @@ def train(args) -> Dict[str, Any]:
                         # update must not be persisted, and the relaunch re-runs
                         # the suspect iteration to disambiguate
                         wait_for_checkpoints()  # never race an in-flight save
-                        save_checkpoint(args.ckpt.save, it, prev[0], prev[1],
-                                        hpc=hpc)
+                        save_checkpoint(
+                            args.ckpt.save, it, prev[0], prev[1], hpc=hpc,
+                            # position excludes the suspect iteration's
+                            # batch: the relaunch must re-consume it
+                            train_state=train_state_at(
+                                it, consumed_prev,
+                                batches=data_iter.batches_consumed - 1),
+                            keep_last=args.ckpt.keep_last)
+                    break
+                if guard.requested():
+                    # preemption/interrupt at a step boundary: the update
+                    # for iter `it` is complete, so checkpoint the
+                    # POST-update state at step it+1 and exit — SIGTERM
+                    # maps to restartable 18, an operator's SIGINT to
+                    # non-restartable 130 (auto_restart must not resurrect
+                    # a deliberately stopped run)
+                    exit_code = guard.exit_code()
+                    state.log("stop signal received; checkpointing "
+                              f"at iter {it + 1} and exiting "
+                              f"(code {exit_code})")
+                    ck = args.ckpt
+                    if ck.save and not (ck.save_interval and
+                                        (it + 1) % ck.save_interval == 0):
+                        # the interval save above did not already cover
+                        # this exact step
+                        wait_for_checkpoints()
+                        save_checkpoint(
+                            ck.save, it + 1, sp, so, hpc=hpc,
+                            train_state=train_state_at(
+                                it + 1, consumed_box[0],
+                                batches=data_iter.batches_consumed),
+                            keep_last=ck.keep_last)
                     break
         finally:
+            guard.__exit__()
+            try:
+                # drain async saves even on the crash path: a supervised
+                # in-process restart must never inherit live background
+                # writes or stale pending commits from a dead attempt
+                wait_for_checkpoints()
+            except Exception as e:  # noqa: BLE001 — never mask the crash
+                state.log(f"warning: async checkpoint drain failed: {e}")
             # crash-safe: flush an open XLA trace window + the metrics
             # stream so both survive the exception they may help debug
             profiler.stop_trace()
@@ -379,12 +483,7 @@ def train(args) -> Dict[str, Any]:
             "exit_code": exit_code}
 
 
-def main(argv=None) -> int:
-    from hetu_galvatron_tpu.core.arguments import args_from_cli
-
-    args = args_from_cli(argv if argv is not None else sys.argv[1:],
-                         mode="train_dist")
-    out = train(args)
+def _finish(out: Dict[str, Any]) -> int:
     if out.get("exit_code") is not None:
         return out["exit_code"]  # the reference's 16/17 fault contract
     if not out["losses"]:
@@ -394,6 +493,52 @@ def main(argv=None) -> int:
     final = out["losses"][-1]
     print(f"training done: {len(out['losses'])} iters, final loss {final:.4f}")
     return 0 if np.isfinite(final) else 1
+
+
+def main(argv=None) -> int:
+    from hetu_galvatron_tpu.core.arguments import args_from_cli
+
+    args = args_from_cli(argv if argv is not None else sys.argv[1:],
+                         mode="train_dist")
+    sup = args.supervisor
+    if not sup.auto_restart:
+        return _finish(train(args))
+
+    # supervised mode: checkpoint-and-exit codes (16 resume-to-
+    # disambiguate, 18 preempted) and crashes auto-restart with jittered
+    # backoff, resuming from the last committed checkpoint; a persistent
+    # validation fault (17) surfaces immediately
+    from hetu_galvatron_tpu.runtime.supervisor import run_with_restarts
+
+    last: Dict[str, Any] = {}
+
+    from hetu_galvatron_tpu.runtime.checkpoint import latest_checkpoint
+
+    def attempt() -> int:
+        if args.ckpt.save and (not args.ckpt.load
+                               or latest_checkpoint(args.ckpt.save)):
+            # resume from this run's own progress as soon as it has a
+            # committed checkpoint — a warm-start ckpt.load pointing
+            # elsewhere must not make every restart retrain from the
+            # warm-start step; until the first save lands, the original
+            # load path (or a fresh start) still applies
+            args.ckpt.load = args.ckpt.save
+        out = train(args)
+        last["out"] = out
+        return out.get("exit_code") or 0
+
+    rc = run_with_restarts(
+        attempt, max_restarts=sup.max_restarts,
+        base_delay=sup.backoff_base_s, max_delay=sup.backoff_max_s,
+        restart_on_error=sup.restart_on_error,
+        # the budget bounds crash LOOPS: whenever an attempt committed a
+        # new checkpoint, the restart counter resets, so a long run on a
+        # preemptible fleet survives unbounded preemptions
+        progress_fn=((lambda: latest_checkpoint(args.ckpt.save))
+                     if args.ckpt.save else None))
+    if rc != 0:
+        return rc
+    return _finish(last["out"])
 
 
 if __name__ == "__main__":

@@ -17,7 +17,7 @@ from __future__ import annotations
 
 from typing import Any, Dict, List, Literal, Optional
 
-from pydantic import BaseModel, Field, model_validator
+from pydantic import BaseModel, Field, field_validator, model_validator
 
 
 class ModelArgs(BaseModel):
@@ -227,6 +227,9 @@ class CheckpointArgs(BaseModel):
     load_format: Literal["galvatron", "hf"] = "galvatron"
     async_save: bool = False
     distributed_checkpoint: bool = True
+    # retention: keep only the newest N committed step dirs (0 = keep all);
+    # partial dirs from crashed saves are garbage-collected either way
+    keep_last: int = 0
 
 
 class DataArgs(BaseModel):
@@ -298,6 +301,39 @@ class RerunArgs(BaseModel):
     check_for_nan: bool = True
     check_for_spike: bool = True
     spike_factor: float = 10.0
+    # deterministic at-step-k fault drills (runtime/rerun_machine.FaultDrill):
+    # corrupt ("nan"/"spike"), crash ("crash" raises InjectedCrash), or
+    # preempt ("preempt" delivers a real SIGTERM) exactly once, at
+    # inject_at_iter, on fresh (non-resumed) runs
+    inject_kind: Literal["none", "nan", "spike", "crash", "preempt"] = "none"
+    inject_at_iter: int = -1
+    inject_spike_scale: float = 100.0
+
+    @field_validator("inject_kind", mode="before")
+    @classmethod
+    def _nan_is_a_name_here(cls, v):
+        # the YAML override parser reads a bare `inject_kind=nan` as float
+        # NaN; in this field it names the drill kind
+        import math
+
+        if isinstance(v, float) and math.isnan(v):
+            return "nan"
+        return v
+
+
+class SupervisorArgs(BaseModel):
+    """Preemption/restart supervisor knobs (runtime/supervisor.py)."""
+
+    # trap SIGTERM/SIGINT and checkpoint-and-exit at the next step boundary
+    graceful_signals: bool = True
+    # wrap the training attempt in run_with_restarts: restartable exit
+    # codes (16 resume-to-disambiguate, 18 preempted) and crashes resume
+    # from the last committed checkpoint; code 17 surfaces immediately
+    auto_restart: bool = False
+    max_restarts: int = 3
+    backoff_base_s: float = 1.0
+    backoff_max_s: float = 60.0
+    restart_on_error: bool = True
 
 
 class SearchArgs(BaseModel):
@@ -411,6 +447,7 @@ class CoreArgs(BaseModel):
     logging: LoggingArgs = Field(default_factory=LoggingArgs)
     observability: ObservabilityArgs = Field(default_factory=ObservabilityArgs)
     rerun: RerunArgs = Field(default_factory=RerunArgs)
+    supervisor: SupervisorArgs = Field(default_factory=SupervisorArgs)
     search: SearchArgs = Field(default_factory=SearchArgs)
     model_profiler: ModelProfileArgs = Field(default_factory=ModelProfileArgs)
     hardware_profiler: HardwareProfileArgs = Field(default_factory=HardwareProfileArgs)

@@ -220,7 +220,8 @@ class RuntimeProfiler:
 
         existing = {}
         if os.path.exists(path):
-            existing = json.load(open(path))
+            with open(path) as f:
+                existing = json.load(f)
         existing.update(entries)
         write_json(existing, path)
 
@@ -229,6 +230,7 @@ class RuntimeProfiler:
 
         existing = {}
         if os.path.exists(path):
-            existing = json.load(open(path))
+            with open(path) as f:
+                existing = json.load(f)
         existing.update(entries)
         write_json(existing, path)

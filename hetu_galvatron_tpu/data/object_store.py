@@ -19,7 +19,16 @@ import os
 import tempfile
 from typing import Optional
 
+from hetu_galvatron_tpu.utils.retrying import retry_call
+
 _SCHEME = "s3://"
+
+# per-object download attempts for transient failures (throttling, 5xx,
+# connection resets); 404-class absence never retries. Override via env
+# for flaky links (HGTPU_S3_RETRIES) — backoff is jittered exponential
+# from the shared utils/retrying policy.
+def _fetch_attempts() -> int:
+    return max(int(os.environ.get("HGTPU_S3_RETRIES", "3")), 1)
 
 
 def is_object_path(path: str) -> bool:
@@ -131,7 +140,15 @@ def localize_prefix(prefix: str, cache_dir: Optional[str] = None,
                                    prefix=".dl_")
         os.close(fd)
         try:
-            cl.download_file(bucket, key + ext, tmp)
+            # transient errors (throttling, 5xx, resets) retry with
+            # jittered backoff; absence (404/NoSuchKey) is permanent and
+            # fails fast so the required/optional branches below classify
+            # the ORIGINAL error, not a retry-exhaustion wrapper
+            retry_call(
+                lambda: cl.download_file(bucket, key + ext, tmp),
+                attempts=_fetch_attempts(), base=0.2, cap=5.0,
+                retryable=lambda e: not _is_absent_error(e),
+                op="object_store.fetch")
         except Exception as e:  # noqa: BLE001 — client-specific error types
             os.unlink(tmp)
             if required:

@@ -141,6 +141,25 @@ class TrainingTelemetry:
         if self._steps_seen % self.flush_interval == 0:
             self.flush(step=it)
 
+    def resume_from(self, step: int, *, samples: Optional[int] = None
+                    ) -> None:
+        """Carry the telemetry step across a checkpoint resume: the
+        cumulative ``train/steps`` / ``train/tokens`` counters restart at
+        the checkpointed totals instead of zero, so a preempted-and-resumed
+        run's metrics stream is continuous (throughput windows and step
+        timing stay process-local — wall-clock did genuinely restart).
+        ``samples`` overrides the consumed-sample count for runs whose
+        batch size varied (a rampup): ``step * global_batch_size`` would
+        overstate the tokens the original run actually trained on."""
+        if step <= 0:
+            return
+        self._steps_seen = int(step)
+        self.registry.counter("train/steps").inc(step)
+        tokens = (samples * self.seq_length if samples is not None
+                  else step * self.global_batch_size * self.seq_length)
+        if tokens:
+            self.registry.counter("train/tokens").inc(tokens)
+
     # -- flushing -----------------------------------------------------------
 
     def _drain_pending(self, final: bool) -> None:

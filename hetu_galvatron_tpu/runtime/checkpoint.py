@@ -18,7 +18,9 @@ from __future__ import annotations
 
 import json
 import os
-from typing import Any, Dict, Optional
+import shutil
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
 
 import jax
 import numpy as np
@@ -26,8 +28,51 @@ import numpy as np
 import orbax.checkpoint as ocp
 
 from hetu_galvatron_tpu.core.args_schema import ModelArgs
+from hetu_galvatron_tpu.utils.retrying import retry_call
 
 Params = Dict[str, Any]
+
+# Atomic-commit protocol: a step directory is materialized under
+# ``step_<n>.tmp``, fully written (params/opt_state shards + meta.json),
+# stamped with the marker file below, and only then renamed to
+# ``step_<n>``. Readers treat a step dir without the marker as partial
+# garbage from a mid-save crash: never selected, eligible for GC. The
+# marker (not just the rename) is kept because object stores mounted via
+# FUSE can surface a directory rename non-atomically.
+COMMIT_MARKER = "COMMITTED"
+_TMP_SUFFIX = ".tmp"
+_OLD_SUFFIX = ".old"  # previous committed payload during an overwrite
+
+# transient-read retry policy for checkpoint I/O (flaky object-store
+# mounts); override attempts via HGTPU_CKPT_RETRIES
+def _io_retries() -> int:
+    return max(int(os.environ.get("HGTPU_CKPT_RETRIES", "3")), 1)
+
+
+def _count(name: str, **labels) -> None:
+    from hetu_galvatron_tpu.observability.registry import get_registry
+
+    get_registry().counter(f"checkpoint/{name}", **labels).inc()
+
+
+def _step_of(entry: str) -> Optional[int]:
+    """``step_<int>`` -> int; anything else (orbax temp dirs,
+    ``step_5.partial``, our ``.tmp`` staging dirs) -> None."""
+    if not entry.startswith("step_"):
+        return None
+    suffix = entry[len("step_"):]
+    if not suffix.isdigit():
+        return None
+    return int(suffix)
+
+
+def is_committed(ckpt_dir: str) -> bool:
+    """A step dir counts as committed when it carries the commit marker
+    (new protocol) or a meta.json (pre-marker checkpoints, which wrote
+    meta.json last) — partial dirs from a mid-save crash have neither
+    under their final name."""
+    return (os.path.exists(os.path.join(ckpt_dir, COMMIT_MARKER))
+            or os.path.exists(os.path.join(ckpt_dir, "meta.json")))
 
 
 def _plan_fingerprint(hpc) -> Dict[str, Any]:
@@ -43,6 +88,46 @@ def _plan_fingerprint(hpc) -> Dict[str, Any]:
     return cfg
 
 
+@dataclass
+class _PendingSave:
+    """An async save still being written by orbax: the commit (marker +
+    rename + retention GC) runs only after ``wait_until_finished``."""
+
+    ckptrs: List[Any]
+    tmp_dir: str
+    final_dir: str
+    root: str
+    keep_last: int = 0
+
+
+_PENDING: List[_PendingSave] = []
+
+
+def _commit(tmp_dir: str, final_dir: str) -> None:
+    """Publish a fully-written staging dir: marker first (fsynced), then
+    the atomic rename onto the final step name."""
+    marker = os.path.join(tmp_dir, COMMIT_MARKER)
+    with open(marker, "w") as f:
+        f.write("committed\n")
+        f.flush()
+        os.fsync(f.fileno())
+    old = None
+    if os.path.isdir(final_dir):
+        # overwriting an existing step (re-save after a rollback): keep
+        # the previous payload selectable until the new one lands — rename
+        # aside, replace, then delete, so a crash at any point in between
+        # still leaves a committed dir (the .old name is never selected
+        # and is GC'd as stale)
+        old = final_dir + _OLD_SUFFIX
+        if os.path.isdir(old):
+            shutil.rmtree(old)
+        os.replace(final_dir, old)
+    os.replace(tmp_dir, final_dir)
+    if old is not None:
+        shutil.rmtree(old, ignore_errors=True)
+    _count("committed")
+
+
 def save_checkpoint(
     path: str,
     step: int,
@@ -51,47 +136,207 @@ def save_checkpoint(
     hpc=None,
     *,
     async_save: bool = False,
+    train_state: Optional[Dict[str, Any]] = None,
+    keep_last: int = 0,
 ) -> str:
     """Write step directory ``<path>/step_<n>`` with params/opt_state plus
-    the hybrid-parallel plan JSON (reference hybrid_parallel_configs.json)."""
-    global _PENDING
+    the hybrid-parallel plan JSON (reference hybrid_parallel_configs.json).
+
+    The write is atomic: everything lands in ``step_<n>.tmp`` and is
+    renamed into place only once complete, so a crash mid-save can never
+    produce a directory :func:`latest_checkpoint` would select.
+    ``train_state`` is an arbitrary JSON-serializable dict stored in
+    meta.json (data-iterator position, RNG seed, rerun records, telemetry
+    step — the full-state-resume payload). ``keep_last > 0`` prunes all
+    but the newest N committed steps after this one commits."""
     ckpt_dir = os.path.abspath(os.path.join(path, f"step_{step}"))
-    ckptr = ocp.StandardCheckpointer()
-    ckptr.save(os.path.join(ckpt_dir, "params"), params, force=True)
+    tmp_dir = ckpt_dir + _TMP_SUFFIX
+    # multi-controller pods share the filesystem: only the commit runner
+    # (process 0) cleans stale staging dirs and writes meta — a lagging
+    # peer must never rmtree a dir its neighbors already stream into
+    primary = jax.process_index() == 0
+    if primary:
+        if os.path.isdir(tmp_dir):
+            # stale staging dir from a crashed earlier attempt at this step
+            shutil.rmtree(tmp_dir)
+        os.makedirs(tmp_dir, exist_ok=True)
+    if jax.process_count() > 1:
+        # barrier: no peer may start streaming shards into tmp_dir until
+        # the primary's stale-dir cleanup above has finished
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(f"hgtpu_ckpt_stage_{step}")
+    ckptrs = [ocp.StandardCheckpointer()]
+    ckptrs[0].save(os.path.join(tmp_dir, "params"), params, force=True)
     if opt_state is not None:
-        ckptr.save(os.path.join(ckpt_dir, "opt_state"), opt_state, force=True)
-    meta = {"step": step}
+        # separate checkpointer: StandardCheckpointer serializes saves, a
+        # second handle lets both trees stream concurrently
+        ckptrs.append(ocp.StandardCheckpointer())
+        ckptrs[-1].save(os.path.join(tmp_dir, "opt_state"), opt_state,
+                        force=True)
+    meta: Dict[str, Any] = {"step": step}
     if hpc is not None:
         meta["hybrid_parallel_config"] = _plan_fingerprint(hpc)
-    with open(os.path.join(ckpt_dir, "meta.json"), "w") as f:
-        json.dump(meta, f, indent=2)
+    if train_state is not None:
+        meta["train_state"] = train_state
+    if primary:
+        with open(os.path.join(tmp_dir, "meta.json"), "w") as f:
+            json.dump(meta, f, indent=2)
+    _count("saved")
+    pending = _PendingSave(ckptrs, tmp_dir, ckpt_dir,
+                           os.path.abspath(path), keep_last)
     if async_save:
-        # orbax commits in the background; training overlaps the write.
-        # Call wait_for_checkpoints() before exiting/reading the ckpt.
-        _PENDING.append(ckptr)
+        # orbax streams shards in the background; training overlaps the
+        # write and wait_for_checkpoints() commits it at the next barrier
+        # (before any read of the ckpt, and at exit)
+        _PENDING.append(pending)
     else:
-        ckptr.wait_until_finished()
+        _finish(pending)
     return ckpt_dir
 
 
-_PENDING = []
+def _finish(p: _PendingSave) -> None:
+    # await EVERY checkpointer even when an earlier one fails: an
+    # abandoned background write would keep streaming into a staging dir
+    # a restarted attempt is about to clean
+    first_err: Optional[BaseException] = None
+    for c in p.ckptrs:
+        try:
+            c.wait_until_finished()
+        except BaseException as e:  # noqa: BLE001 — re-raised below
+            if first_err is None:
+                first_err = e
+    if first_err is not None:
+        raise first_err
+    # multi-controller pods: every process streams its shards through
+    # orbax, but exactly one performs the marker/rename commit and the
+    # retention GC (shared filesystem)
+    if jax.process_index() == 0:
+        _commit(p.tmp_dir, p.final_dir)
+        if p.keep_last > 0:
+            gc_checkpoints(p.root, keep_last=p.keep_last)
 
 
 def wait_for_checkpoints() -> None:
     """Block until every async save has committed (reference async_save
-    drains at exit)."""
+    drains at exit). The queue drains completely even when one save
+    fails: every checkpointer is awaited (a per-entry except keeps the
+    loop going, so no abandoned background write keeps the process alive
+    or races a later save) and the first error re-raises after the
+    drain. Each entry is popped before finishing so its own final dir is
+    not counted as in-flight by its retention GC."""
+    first_err: Optional[BaseException] = None
     while _PENDING:
-        _PENDING.pop().wait_until_finished()
+        p = _PENDING.pop(0)
+        try:
+            _finish(p)
+        except BaseException as e:  # noqa: BLE001 — re-raised after drain
+            if first_err is None:
+                first_err = e
+    if first_err is not None:
+        raise first_err
+
+
+def _in_flight_dirs() -> set:
+    return {p.tmp_dir for p in _PENDING} | {p.final_dir for p in _PENDING}
+
+
+def _recover_orphaned_old(path: str) -> None:
+    """Roll back a crash mid-overwrite: if ``step_<n>.old`` (the previous
+    committed payload renamed aside by :func:`_commit`) exists without a
+    ``step_<n>``, the crash hit between the two renames — restore the old
+    payload so the step stays selectable."""
+    for entry in os.listdir(path):
+        if not entry.endswith(_OLD_SUFFIX):
+            continue
+        base = entry[:-len(_OLD_SUFFIX)]
+        if _step_of(base) is None:
+            continue
+        full = os.path.join(path, entry)
+        final = os.path.join(path, base)
+        if not os.path.exists(final) and is_committed(full):
+            try:
+                os.replace(full, final)
+                _count("old_recovered")
+            except OSError:
+                pass  # a concurrent reader raced the same rollback
+
+
+def gc_checkpoints(path: str, *, keep_last: int = 0) -> List[str]:
+    """Remove partial step dirs (crashed saves) and, with ``keep_last > 0``,
+    all but the newest N committed steps. In-flight async saves are never
+    touched. Returns the removed paths."""
+    if not os.path.isdir(path):
+        return []
+    _recover_orphaned_old(path)
+    busy = _in_flight_dirs()
+    removed: List[str] = []
+    committed: List[tuple] = []
+    for entry in sorted(os.listdir(path)):
+        full = os.path.join(path, entry)
+        if not os.path.isdir(full) or full in busy:
+            continue
+        step = _step_of(entry)
+        if step is not None and is_committed(full):
+            committed.append((step, full))
+            continue
+        # our own staging/partial/old dirs only — a stray step_x or
+        # step_5.partial we did not create is skipped, never deleted.
+        # A surviving .old here is superseded (its final dir exists, or
+        # _recover_orphaned_old would have rolled it back).
+        stale_ours = step is not None or any(
+            entry.endswith(suf) and _step_of(entry[:-len(suf)]) is not None
+            for suf in (_TMP_SUFFIX, _OLD_SUFFIX))
+        if stale_ours:
+            shutil.rmtree(full, ignore_errors=True)
+            removed.append(full)
+            _count("gc_removed", kind="partial")
+    if keep_last > 0 and len(committed) > keep_last:
+        committed.sort()
+        for _, full in committed[:-keep_last]:
+            shutil.rmtree(full, ignore_errors=True)
+            removed.append(full)
+            _count("gc_removed", kind="retention")
+    return removed
 
 
 def latest_checkpoint(path: str) -> Optional[str]:
+    """Newest COMMITTED step dir, or None. Stray ``step_*`` entries with a
+    non-integer suffix (orbax temp dirs, ``step_5.partial``) are skipped
+    instead of crashing resume, and uncommitted partial dirs from a
+    mid-save crash are never selected."""
     if not os.path.isdir(path):
         return None
-    steps = [d for d in os.listdir(path) if d.startswith("step_")]
-    if not steps:
-        return None
-    latest = max(steps, key=lambda d: int(d.split("_")[1]))
-    return os.path.join(path, latest)
+    _recover_orphaned_old(path)
+    best_step, best = -1, None
+    for entry in os.listdir(path):
+        step = _step_of(entry)
+        if step is None:
+            continue
+        full = os.path.join(path, entry)
+        if not os.path.isdir(full) or not is_committed(full):
+            _count("partial_skipped")
+            continue
+        if step > best_step:
+            best_step, best = step, full
+    return best
+
+
+def read_checkpoint_meta(ckpt_dir: str) -> Dict[str, Any]:
+    """The step dir's meta.json (step, plan fingerprint, train_state) —
+    {} when absent. Reads retry transient I/O errors (flaky object-store
+    mounts) through the shared backoff policy."""
+    mp = os.path.join(ckpt_dir, "meta.json")
+    if not os.path.exists(mp):
+        return {}
+
+    def _read():
+        with open(mp) as f:
+            return json.load(f)
+
+    return retry_call(_read, attempts=_io_retries(), base=0.2, cap=5.0,
+                      retryable=lambda e: isinstance(e, OSError),
+                      op="checkpoint.read_meta")
 
 
 def load_checkpoint(
@@ -105,9 +350,14 @@ def load_checkpoint(
     """Restore into the target sharding/shape tree. ``strict_plan`` asserts
     the stored plan matches (the reference asserts equality on resume,
     hybrid_parallel_config.py:132-144); by default a mismatch is allowed —
-    orbax reshards into the new plan's shardings."""
+    orbax reshards into the new plan's shardings. Restores retry transient
+    I/O errors with jittered backoff (preemptible fleets resume through
+    flaky object-store reads)."""
     ckpt_dir = os.path.abspath(ckpt_dir)  # orbax rejects relative paths
-    meta = json.load(open(os.path.join(ckpt_dir, "meta.json")))
+    meta = read_checkpoint_meta(ckpt_dir)
+    if "step" not in meta:
+        raise FileNotFoundError(
+            f"{ckpt_dir} has no meta.json — not a committed checkpoint")
     if strict_plan and hpc is not None:
         stored = meta.get("hybrid_parallel_config")
         current = _plan_fingerprint(hpc)
@@ -116,12 +366,19 @@ def load_checkpoint(
                 f"checkpoint plan mismatch:\nstored  {stored}\n"
                 f"current {current}")
     ckptr = ocp.StandardCheckpointer()
-    params = ckptr.restore(os.path.join(ckpt_dir, "params"), params_target)
+
+    def _restore(sub, target):
+        return retry_call(
+            lambda: ckptr.restore(os.path.join(ckpt_dir, sub), target),
+            attempts=_io_retries(), base=0.2, cap=5.0,
+            retryable=lambda e: isinstance(e, OSError),
+            op="checkpoint.restore")
+
+    params = _restore("params", params_target)
     opt_state = None
     if opt_target is not None and os.path.isdir(
             os.path.join(ckpt_dir, "opt_state")):
-        opt_state = ckptr.restore(os.path.join(ckpt_dir, "opt_state"),
-                                  opt_target)
+        opt_state = _restore("opt_state", opt_target)
     return params, opt_state, meta["step"]
 
 

@@ -114,6 +114,20 @@ def synthetic_batches(
         i += 1
 
 
+def skip_batches(it: Iterator, n: int) -> None:
+    """Fast-forward ``n`` global batches — the full-state-resume replay of
+    the data stream. Replaying (rather than seeking) keeps every stateful
+    stage downstream of the raw reader — MLM masking RNG, packed-doc
+    segmentation, zigzag permutation — in exactly the state the original
+    run left it in. Rerun-machine wrappers are committed per batch so the
+    replayed prefix does not pile up in the rewind cache."""
+    advance = getattr(it, "advance", None)
+    for _ in range(int(n)):
+        next(it)
+        if advance is not None:
+            advance()
+
+
 _SPLIT_INDEX = {"train": 0, "valid": 1, "test": 2}
 
 

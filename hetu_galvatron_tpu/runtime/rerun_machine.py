@@ -62,6 +62,9 @@ class RerunDataIterator:
         self._cache: List[Any] = []
         self._replaying = False
         self._replay_idx = 0
+        # committed (advance()d) batches — the data-iterator position the
+        # checkpoint carries for full-state resume
+        self.batches_consumed = 0
 
     def __iter__(self):
         return self
@@ -83,9 +86,71 @@ class RerunDataIterator:
 
     def advance(self) -> None:
         """Commit the step: drop cached batches, resume the live stream."""
+        self.batches_consumed += len(self._cache)
         self._cache.clear()
         self._replaying = False
         self._replay_idx = 0
+
+
+class InjectedCrash(RuntimeError):
+    """Raised by a crash drill (FaultDrill kind="crash"): simulates a
+    hard host failure so the supervisor's restart path can be exercised
+    end to end."""
+
+
+class FaultDrill:
+    """Deterministic at-step-k fault injection (the configurable half of
+    the drill harness; the rate-based :class:`RerunErrorInjector` covers
+    stochastic soak tests).
+
+    Driven from ``RerunArgs`` (``inject_kind`` / ``inject_at_iter``):
+    ``nan`` and ``spike`` corrupt the step's loss so the rerun machine's
+    detection path fires; ``crash`` raises :class:`InjectedCrash`;
+    ``preempt`` delivers a real SIGTERM to the process (the supervisor's
+    PreemptionGuard must catch it). Each drill fires once, on fresh runs
+    only — a resumed run trains clean, which is exactly the
+    transient-fault scenario the restart supervisor exists to absorb.
+    Every injection is counted (``faults/injected{kind=...}``)."""
+
+    def __init__(self, args: RerunArgs, registry=None):
+        self.kind = args.inject_kind
+        self.at_iter = args.inject_at_iter
+        self.spike_scale = args.inject_spike_scale
+        self._registry = registry
+        self._armed = self.kind != "none" and self.at_iter >= 0
+
+    def arm(self, start_iter: int) -> None:
+        """Disarm on resumed runs (``start_iter > 0``): the drill models a
+        one-shot transient fault, not one that reproduces every restart."""
+        if start_iter > 0:
+            self._armed = False
+
+    @property
+    def registry(self):
+        return self._registry if self._registry is not None else get_registry()
+
+    def apply(self, value: float, iteration: int) -> float:
+        """Corrupt (or crash/preempt on) iteration ``at_iter``; identity
+        everywhere else."""
+        if not self._armed or iteration != self.at_iter:
+            return value
+        self._armed = False
+        self.registry.counter("faults/injected", kind=self.kind).inc()
+        if self.kind == "nan":
+            return float("nan")
+        if self.kind == "spike":
+            return abs(value) * self.spike_scale + 1.0
+        if self.kind == "crash":
+            raise InjectedCrash(
+                f"fault drill: injected crash at iteration {iteration}")
+        if self.kind == "preempt":
+            import signal
+
+            # a REAL SIGTERM, not a flag poke: the drill exercises the
+            # whole preemption path (handler -> boundary stop ->
+            # checkpoint -> exit code)
+            signal.raise_signal(signal.SIGTERM)
+        return value
 
 
 class RerunErrorInjector:
@@ -256,6 +321,9 @@ class RerunStateMachine:
             return RerunDiagnostic.CORRECT
 
         self._count("suspect")
+        self.registry.counter(
+            "faults/detected",
+            kind="nan" if "non-finite" in reason else "spike").inc()
         diagnostic = RerunDiagnostic.PERSISTENT_ERROR
         rerun_value: Optional[float] = None
         if rerun_fn is not None:
@@ -286,6 +354,50 @@ class RerunStateMachine:
         """Non-None when the run should checkpoint and exit with the given
         code (reference exit codes 16/17)."""
         return self._last_exit_code
+
+    # -- full-state resume --------------------------------------------------
+
+    @staticmethod
+    def _enc(v: Optional[float]) -> Any:
+        """Strict-JSON-safe float: NaN/inf become strings (json.dump's
+        default emits bare ``NaN`` tokens no spec-compliant parser — jq,
+        other languages — accepts, and fault records contain NaN by
+        construction)."""
+        if v is not None and not math.isfinite(v):
+            return str(v)  # "nan" / "inf" / "-inf"
+        return v
+
+    @staticmethod
+    def _dec(v: Any) -> Optional[float]:
+        return float(v) if isinstance(v, str) else v
+
+    def state_dict(self) -> Dict[str, Any]:
+        """Strict-JSON-serializable snapshot carried in the checkpoint's
+        train_state: a resumed run keeps the fault history (and the spike
+        EMA, so detection thresholds do not reset to cold)."""
+        return {
+            "records": [
+                {"iteration": r.iteration, "value": self._enc(r.value),
+                 "rerun_value": self._enc(r.rerun_value),
+                 "diagnostic": r.diagnostic.value, "reason": r.reason}
+                for r in self.records
+            ],
+            "ema": self._enc(self._ema),
+            "injected_iters": dict(self.injector._injected_iters),
+        }
+
+    def load_state_dict(self, state: Dict[str, Any]) -> None:
+        self.records = [
+            RerunRecord(
+                iteration=r["iteration"], value=self._dec(r["value"]),
+                rerun_value=self._dec(r.get("rerun_value")),
+                diagnostic=RerunDiagnostic(r["diagnostic"]),
+                reason=r.get("reason", ""))
+            for r in state.get("records", [])
+        ]
+        self._ema = self._dec(state.get("ema"))
+        self.injector._injected_iters = {
+            int(k): v for k, v in state.get("injected_iters", {}).items()}
 
     def report(self) -> Dict[str, Any]:
         out = {

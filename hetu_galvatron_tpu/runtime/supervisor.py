@@ -1,0 +1,242 @@
+"""Preemption-aware training supervisor.
+
+Two halves of surviving a preemptible TPU fleet:
+
+* :class:`PreemptionGuard` — a SIGTERM/SIGINT handler that converts the
+  kill signal into a *checkpoint-and-exit request* the train loop reads
+  at the next step boundary (Cloud TPU preemption delivers SIGTERM with
+  a ~30 s grace window; an uncheckpointed step is a lost step). The
+  guard never acts mid-step: the loop finishes the in-flight update,
+  commits an atomic checkpoint, and exits with
+  :data:`EXIT_CODE_CHECKPOINT_AND_EXIT`.
+* :func:`run_with_restarts` — bounded auto-restart with jittered
+  exponential backoff around a training attempt, honoring the rerun
+  state machine's exit-code contract (``rerun_machine.py``): code 16
+  (resume-to-disambiguate) and preemption exits restart; code 17
+  (failed result validation — a persistent fault that will reproduce)
+  does not. Crashes (exceptions) restart too when ``restart_on_error``
+  is set, so a drill-injected or real host crash resumes from the last
+  committed checkpoint instead of losing the run.
+
+Every signal, restart, and give-up is counted in the observability
+registry (``supervisor/*``) so fleet dashboards see preemption churn.
+"""
+
+from __future__ import annotations
+
+import random
+import signal
+import threading
+from typing import Any, Callable, Iterable, Optional
+
+from hetu_galvatron_tpu.runtime.rerun_machine import (
+    EXIT_CODE_FAILED_ON_RESULT_VALIDATION,
+    EXIT_CODE_RESUME_TO_DISAMBIGUATE,
+)
+from hetu_galvatron_tpu.utils.retrying import backoff_delay
+
+# checkpoint-and-exit after a preemption signal: resumable by contract,
+# distinct from the rerun machine's 16/17 so the supervisor can tell
+# "the fleet preempted me" from "my step result was suspect"
+EXIT_CODE_CHECKPOINT_AND_EXIT = 18
+# operator interrupt (SIGINT/Ctrl-C): checkpoints like a preemption but
+# is NOT restartable — auto_restart must not resurrect a run the user
+# deliberately stopped (128+SIGINT shell convention)
+EXIT_CODE_INTERRUPTED = 130
+
+# exit codes run_with_restarts treats as "resume from the last committed
+# checkpoint"; 17 is deliberately absent — a persistent validation fault
+# reproduces on every restart, so restarting only burns the budget
+RESTARTABLE_EXIT_CODES = (
+    EXIT_CODE_RESUME_TO_DISAMBIGUATE,
+    EXIT_CODE_CHECKPOINT_AND_EXIT,
+)
+
+
+def _registry(registry=None):
+    if registry is not None:
+        return registry
+    from hetu_galvatron_tpu.observability.registry import get_registry
+
+    return get_registry()
+
+
+class PreemptionGuard:
+    """Converts SIGTERM/SIGINT into a step-boundary stop request.
+
+    Use as a context manager around the train loop; ``requested()`` turns
+    true once a signal arrives (a second signal of the same kind is
+    idempotent). Handlers are installed only on the main thread — on a
+    worker thread (some test harnesses) the guard degrades to an inert
+    flag that :meth:`request` can still set programmatically (simulated
+    preemption drills)."""
+
+    def __init__(self, signals: Iterable[int] = (signal.SIGTERM,
+                                                 signal.SIGINT),
+                 *, enabled: bool = True, registry=None):
+        self.signals = tuple(signals)
+        self.enabled = enabled
+        self._requested = threading.Event()
+        self._previous = {}
+        self._registry = registry
+        self.installed = False
+        self.signum: Optional[int] = None  # first signal that fired
+        self._counted = False
+
+    # -- signal plumbing ----------------------------------------------------
+
+    def _handler(self, signum, frame):  # noqa: ARG002 — signal signature
+        if self._requested.is_set():
+            # second signal of the same escalation: the run is presumably
+            # hung (stuck step, dead object-store mount) and will never
+            # reach the boundary check — restore the previous handler and
+            # re-deliver so the operator can still interrupt without
+            # SIGKILL
+            signal.signal(signum, self._previous.get(signum, signal.SIG_DFL))
+            signal.raise_signal(signum)
+            return
+        self.request(signum=signum)
+
+    def request(self, signum: Optional[int] = None) -> None:
+        """Mark preemption as requested (signal handler or drill).
+        Async-signal-safe: only sets a flag — no locks, no allocation
+        (a registry counter here could deadlock on the non-reentrant
+        registry lock the interrupted main thread may hold); the signal
+        is counted later, from the main thread, in :meth:`requested`."""
+        self._requested.set()
+        if self.signum is None:
+            self.signum = signum if signum is not None else -1
+
+    def requested(self) -> bool:
+        """Polled by the train loop at step boundaries (main thread) —
+        also the safe place to count the signal for observability."""
+        if self._requested.is_set() and not self._counted:
+            self._counted = True
+            try:
+                name = (signal.Signals(self.signum).name
+                        if self.signum not in (None, -1) else "drill")
+            except ValueError:
+                name = str(self.signum)
+            _registry(self._registry).counter(
+                "supervisor/preemption_signals", sig=name).inc()
+        return self._requested.is_set()
+
+    def exit_code(self) -> int:
+        """Which checkpoint-and-exit code the triggering signal maps to:
+        SIGINT = an operator's deliberate stop (non-restartable 130),
+        everything else = fleet preemption (restartable 18)."""
+        if self.signum == signal.SIGINT:
+            return EXIT_CODE_INTERRUPTED
+        return EXIT_CODE_CHECKPOINT_AND_EXIT
+
+    def __enter__(self) -> "PreemptionGuard":
+        self._requested.clear()
+        self.signum = None
+        self._counted = False
+        if not self.enabled:
+            return self
+        for s in self.signals:
+            try:
+                self._previous[s] = signal.signal(s, self._handler)
+                self.installed = True
+            except ValueError:
+                # not the main thread: signals cannot be trapped here;
+                # stay an inert flag rather than failing the run
+                self._previous.pop(s, None)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        for s, prev in self._previous.items():
+            try:
+                signal.signal(s, prev)
+            except ValueError:
+                pass
+        self._previous.clear()
+        self.installed = False
+
+
+def run_with_restarts(
+    attempt_fn: Callable[[], Optional[int]],
+    *,
+    max_restarts: int = 3,
+    base_delay: float = 1.0,
+    max_delay: float = 60.0,
+    restart_codes: Iterable[int] = RESTARTABLE_EXIT_CODES,
+    restart_on_error: bool = True,
+    progress_fn: Optional[Callable[[], Any]] = None,
+    sleep: Optional[Callable[[float], None]] = None,
+    rng: Optional[random.Random] = None,
+    registry=None,
+    log: Callable[[str], None] = lambda m: print(m, flush=True),
+) -> int:
+    """Run ``attempt_fn`` (returns an exit code; None/0 = success) with
+    bounded auto-restart.
+
+    Restartable exits (preemption, resume-to-disambiguate) and — when
+    ``restart_on_error`` — crashes re-invoke ``attempt_fn`` after a
+    jittered exponential backoff; the attempt is expected to resume from
+    the last committed checkpoint. Non-restartable codes (0, 17, anything
+    not listed) return immediately. When the restart budget is exhausted
+    the last code is returned (or the last exception re-raised), so the
+    process-level exit status still carries the fault classification.
+
+    ``progress_fn`` (e.g. ``lambda: latest_checkpoint(save_dir)``) makes
+    the budget bound crash LOOPS, not total faults: whenever its value
+    changes between attempts (the attempt checkpointed new progress) the
+    restart counter resets, so a healthy multi-day run on a preemptible
+    fleet survives arbitrarily many preemptions while a run that loops
+    without advancing still stops after ``max_restarts``."""
+    if sleep is None:
+        from hetu_galvatron_tpu.utils.retrying import _default_sleep as sleep
+    restart_codes = tuple(restart_codes)
+    reg = _registry(registry)
+    restarts = 0
+    last_progress = progress_fn() if progress_fn is not None else None
+
+    def note_progress() -> None:
+        nonlocal restarts, last_progress
+        if progress_fn is None:
+            return
+        cur = progress_fn()
+        if cur != last_progress:
+            restarts = 0  # forward progress: this is not a crash loop
+            last_progress = cur
+
+    while True:
+        try:
+            code = attempt_fn()
+        except Exception as e:  # noqa: BLE001 — supervisor catches crashes
+            note_progress()
+            if not restart_on_error or restarts >= max_restarts:
+                reg.counter("supervisor/giveups", reason="crash").inc()
+                raise
+            delay = backoff_delay(restarts, base=base_delay, cap=max_delay,
+                                  rng=rng)
+            reg.counter("supervisor/restarts", reason="crash").inc()
+            log(f"supervisor: attempt crashed ({type(e).__name__}: {e}); "
+                f"restart {restarts + 1}/{max_restarts} in {delay:.1f}s")
+            restarts += 1
+            sleep(delay)
+            continue
+        code = code or 0
+        if code == 0:
+            return 0
+        if code not in restart_codes:
+            if code == EXIT_CODE_FAILED_ON_RESULT_VALIDATION:
+                log("supervisor: exit 17 (persistent validation fault) is "
+                    "not restartable; surfacing it")
+            reg.counter("supervisor/terminal_exits", code=code).inc()
+            return code
+        note_progress()
+        if restarts >= max_restarts:
+            reg.counter("supervisor/giveups", reason="budget").inc()
+            log(f"supervisor: restart budget ({max_restarts}) exhausted; "
+                f"surfacing exit code {code}")
+            return code
+        delay = backoff_delay(restarts, base=base_delay, cap=max_delay,
+                              rng=rng)
+        reg.counter("supervisor/restarts", code=code).inc()
+        log(f"supervisor: exit code {code}; restart "
+            f"{restarts + 1}/{max_restarts} in {delay:.1f}s")
+        restarts += 1
+        sleep(delay)

@@ -141,6 +141,7 @@ def train_loop(
     device_put: Callable[[Dict[str, Any]], Dict[str, jax.Array]] = None,
     hooks: Tuple[Callable, ...] = (),
     telemetry: Optional[Any] = None,
+    preemption: Optional[Any] = None,
 ) -> Tuple[Any, Any, list]:
     """Host-side iteration driver (reference train_dist.py:49-73): fetch
     batch, run jitted step, invoke profiler/logging hooks. Returns final
@@ -148,7 +149,11 @@ def train_loop(
 
     ``hooks`` are ``h(it, metrics)`` callables invoked after every step
     with the step's (possibly still in-flight) device metrics — hooks must
-    not force a device sync. ``telemetry`` is an optional
+    not force a device sync. ``preemption`` is an optional object with a
+    ``requested() -> bool`` method (``runtime.supervisor.PreemptionGuard``)
+    checked at every step boundary: once true the loop stops cleanly after
+    the in-flight step, returning what it has — the caller checkpoints and
+    exits. ``telemetry`` is an optional
     ``observability.TrainingTelemetry`` appended to the hooks; it is
     final-flushed when the loop exits (even on error) and left open for
     the caller to reuse/close. When ``args.observability.enabled`` and no
@@ -157,7 +162,11 @@ def train_loop(
     from hetu_galvatron_tpu.models.modules import compute_dtype_of
     from hetu_galvatron_tpu.observability.tracing import span
 
-    owns_telemetry = telemetry is None and args.observability.enabled
+    # rank-gated like the train_dist launcher: on a multi-host pod only
+    # process 0 may configure sinks (every process appending to one
+    # shared-storage JSONL would interleave)
+    owns_telemetry = (telemetry is None and args.observability.enabled
+                      and jax.process_index() == 0)
     if owns_telemetry:
         telemetry = make_telemetry(args)
 
@@ -192,6 +201,10 @@ def train_loop(
             device_losses.append(metrics["loss"])
             for h in all_hooks:
                 h(it, metrics)
+            if preemption is not None and preemption.requested():
+                # step boundary: the update above is complete and safe to
+                # checkpoint; never abandon a step mid-flight
+                break
     finally:
         # a loop-owned telemetry is closed here; a caller-supplied one is
         # only final-flushed (the caller may reuse it across loops and

@@ -52,6 +52,123 @@ def test_latest_checkpoint_picks_max(tmp_path):
     assert latest_checkpoint(str(tmp_path / "nope")) is None
 
 
+@pytest.mark.robustness
+def test_latest_checkpoint_skips_stray_entries(tmp_path):
+    """Non-integer step suffixes (orbax temp dirs, step_5.partial) and
+    uncommitted dirs must be skipped, not crash resume with ValueError."""
+    import os
+
+    params, _ = init_causal_lm(jax.random.key(0), TINY)
+    d = save_checkpoint(str(tmp_path), 3, params)
+    (tmp_path / "step_x").mkdir()
+    (tmp_path / "step_5.partial").mkdir()
+    (tmp_path / "step_7.orbax-checkpoint-tmp-123").mkdir()
+    (tmp_path / "step_9.tmp").mkdir()  # crashed mid-save staging dir
+    # an uncommitted final-named dir (no marker, no meta.json)
+    (tmp_path / "step_99").mkdir()
+    (tmp_path / "step_4").write_text("a file, not a dir")
+    assert latest_checkpoint(str(tmp_path)) == d
+    # stray entries we did not create survive GC; our staging dir and the
+    # uncommitted partial do not
+    from hetu_galvatron_tpu.runtime.checkpoint import gc_checkpoints
+
+    gc_checkpoints(str(tmp_path))
+    assert os.path.isdir(tmp_path / "step_x")
+    assert os.path.isdir(tmp_path / "step_5.partial")
+    assert not os.path.isdir(tmp_path / "step_9.tmp")
+    assert not os.path.isdir(tmp_path / "step_99")
+    assert latest_checkpoint(str(tmp_path)) == d
+
+
+@pytest.mark.robustness
+def test_keep_last_retention(tmp_path):
+    import os
+
+    params, _ = init_causal_lm(jax.random.key(0), TINY)
+    for s in (1, 2, 3):
+        save_checkpoint(str(tmp_path), s, params)
+    save_checkpoint(str(tmp_path), 4, params, keep_last=2)
+    kept = sorted(d for d in os.listdir(tmp_path) if d.startswith("step_"))
+    assert kept == ["step_3", "step_4"]
+    assert latest_checkpoint(str(tmp_path)).endswith("step_4")
+    # the async commit path enforces the same bound (its own just-committed
+    # dir must count toward keep_last, not read as in-flight)
+    from hetu_galvatron_tpu.runtime.checkpoint import wait_for_checkpoints
+
+    save_checkpoint(str(tmp_path), 5, params, async_save=True, keep_last=2)
+    wait_for_checkpoints()
+    kept = sorted(d for d in os.listdir(tmp_path) if d.startswith("step_"))
+    assert kept == ["step_4", "step_5"]
+
+
+@pytest.mark.robustness
+def test_async_save_commits_only_after_wait(tmp_path):
+    """An async save is invisible to latest_checkpoint until
+    wait_for_checkpoints commits it through the same marker/rename
+    protocol."""
+    import os
+
+    from hetu_galvatron_tpu.runtime.checkpoint import wait_for_checkpoints
+
+    params, _ = init_causal_lm(jax.random.key(0), TINY)
+    d1 = save_checkpoint(str(tmp_path), 1, params)
+    d2 = save_checkpoint(str(tmp_path), 2, params, async_save=True)
+    # not committed yet: the staging dir exists, the final name does not
+    assert os.path.isdir(d2 + ".tmp")
+    assert not os.path.isdir(d2)
+    assert latest_checkpoint(str(tmp_path)) == d1
+    wait_for_checkpoints()
+    assert latest_checkpoint(str(tmp_path)) == d2
+    assert os.path.exists(os.path.join(d2, "meta.json"))
+    # idempotent when drained
+    wait_for_checkpoints()
+
+
+@pytest.mark.robustness
+def test_wait_for_checkpoints_drains_despite_failure(tmp_path):
+    """A failing commit mid-drain must not abandon the remaining async
+    saves unawaited: everything drains, the first error re-raises."""
+    from hetu_galvatron_tpu.runtime import checkpoint as ck
+
+    class FakeCkptr:
+        def __init__(self, log, name, fail=False):
+            self.log, self.name, self.fail = log, name, fail
+
+        def wait_until_finished(self):
+            self.log.append(self.name)
+            if self.fail:
+                raise IOError(f"flaky wait: {self.name}")
+
+    log = []
+    for i, fail in enumerate([False, True, False]):
+        d = tmp_path / f"step_{i + 1}"
+        d.mkdir()
+        ck._PENDING.append(ck._PendingSave(
+            [FakeCkptr(log, f"c{i}", fail)], str(d) + ".tmp", str(d),
+            str(tmp_path)))
+    # give the non-failing entries real staging dirs so their commit works
+    (tmp_path / "step_1.tmp").mkdir()
+    (tmp_path / "step_3.tmp").mkdir()
+    with pytest.raises(IOError, match="flaky wait: c1"):
+        ck.wait_for_checkpoints()
+    assert log == ["c0", "c1", "c2"]  # every save awaited, none dropped
+    assert not ck._PENDING
+
+
+@pytest.mark.robustness
+def test_train_state_rides_meta(tmp_path):
+    from hetu_galvatron_tpu.runtime.checkpoint import read_checkpoint_meta
+
+    params, _ = init_causal_lm(jax.random.key(0), TINY)
+    ts = {"step": 4, "seed": 7, "batches_consumed": 4,
+          "rerun": {"records": [], "ema": 2.5}}
+    d = save_checkpoint(str(tmp_path), 4, params, train_state=ts)
+    meta = read_checkpoint_meta(d)
+    assert meta["step"] == 4
+    assert meta["train_state"] == ts
+    assert read_checkpoint_meta(str(tmp_path / "nowhere")) == {}
+
+
 def test_plan_mismatch_raises(tmp_path):
     params, _ = init_causal_lm(jax.random.key(0), TINY)
     args = CoreArgs(model=TINY.model_dump())

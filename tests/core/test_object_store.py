@@ -137,9 +137,70 @@ def test_warm_cache_needs_no_client(tmp_path):
     assert os.path.exists(local + ".bin")
 
 
-def test_transient_meta_error_is_loud(tmp_path):
+@pytest.mark.robustness
+def test_transient_fetch_retries_with_backoff(tmp_path, monkeypatch):
+    """Transient errors (throttling, 5xx) retry through the shared
+    backoff policy instead of surfacing immediately; absence (404-class)
+    stays non-retryable and fails fast."""
+    from hetu_galvatron_tpu.utils import retrying
+
+    sleeps = []
+    monkeypatch.setattr(retrying, "_default_sleep", sleeps.append)
+
+    class FlakyS3(FakeS3):
+        def __init__(self, root, failures):
+            super().__init__(root)
+            self.failures = failures
+
+        def download_file(self, bucket, key, path):
+            if self.failures > 0:
+                self.failures -= 1
+                raise IOError("SlowDown: rate exceeded")
+            return super().download_file(bucket, key, path)
+
+    _make_remote_corpus(tmp_path / "remote")
+    client = FlakyS3(tmp_path / "remote", failures=2)
+    local = localize_prefix("s3://bkt/corpora/c",
+                            cache_dir=str(tmp_path / "cache"), client=client)
+    assert os.path.exists(local + ".idx")
+    assert len(sleeps) == 2  # two throttles -> two jittered backoffs
+    assert all(s >= 0 for s in sleeps)
+
+    # absence fails fast: exactly one attempt, no sleeps
+    sleeps.clear()
+    counting = FakeS3(tmp_path / "remote")
+    with pytest.raises(FileNotFoundError, match="gone.idx"):
+        localize_prefix("s3://bkt/gone", cache_dir=str(tmp_path / "c2"),
+                        client=counting)
+    assert counting.calls == [("bkt", "gone.idx")]
+    assert not sleeps
+
+
+@pytest.mark.robustness
+def test_fetch_retry_budget_exhausts_loudly(tmp_path, monkeypatch):
+    from hetu_galvatron_tpu.utils import retrying
+
+    monkeypatch.setattr(retrying, "_default_sleep", lambda s: None)
+
+    class AlwaysThrottled(FakeS3):
+        def download_file(self, bucket, key, path):
+            self.calls.append((bucket, key))
+            raise IOError("SlowDown: rate exceeded")
+
+    _make_remote_corpus(tmp_path / "remote")
+    client = AlwaysThrottled(tmp_path / "remote")
+    with pytest.raises(FileNotFoundError, match="SlowDown"):
+        localize_prefix("s3://bkt/corpora/c",
+                        cache_dir=str(tmp_path / "cache"), client=client)
+    assert len(client.calls) == 3  # the full (bounded) attempt budget
+
+
+def test_transient_meta_error_is_loud(tmp_path, monkeypatch):
     """A non-absence failure on the OPTIONAL meta sidecar must raise, not
     silently disable eod masking / vocab checks."""
+    from hetu_galvatron_tpu.utils import retrying
+
+    monkeypatch.setattr(retrying, "_default_sleep", lambda s: None)
 
     class ThrottledS3(FakeS3):
         def download_file(self, bucket, key, path):

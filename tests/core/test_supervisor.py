@@ -1,0 +1,264 @@
+"""Preemption-aware supervisor (runtime/supervisor.py): signal trapping,
+restart policy honoring the rerun machine's 16/17 exit-code contract, and
+the at-step-k fault drill harness."""
+
+import signal
+
+import pytest
+
+from hetu_galvatron_tpu.core.args_schema import RerunArgs
+from hetu_galvatron_tpu.observability.registry import MetricsRegistry
+from hetu_galvatron_tpu.runtime.rerun_machine import (
+    EXIT_CODE_FAILED_ON_RESULT_VALIDATION,
+    EXIT_CODE_RESUME_TO_DISAMBIGUATE,
+    FaultDrill,
+    InjectedCrash,
+)
+from hetu_galvatron_tpu.runtime.supervisor import (
+    EXIT_CODE_CHECKPOINT_AND_EXIT,
+    RESTARTABLE_EXIT_CODES,
+    PreemptionGuard,
+    run_with_restarts,
+)
+
+pytestmark = [pytest.mark.core, pytest.mark.robustness]
+
+
+# -- PreemptionGuard --------------------------------------------------------
+
+
+def test_guard_catches_real_sigterm_and_restores_handlers():
+    before = signal.getsignal(signal.SIGTERM)
+    with PreemptionGuard() as g:
+        assert not g.requested()
+        signal.raise_signal(signal.SIGTERM)  # a REAL signal, not a flag poke
+        assert g.requested()
+    assert signal.getsignal(signal.SIGTERM) is before
+
+
+def test_guard_second_signal_escalates_to_previous_handler():
+    """A hung step never reaches the boundary check, so the SECOND signal
+    of the same kind must escalate (restore the previous handler and
+    re-deliver) instead of being swallowed — a stuck run stays killable
+    without SIGKILL."""
+    hits = []
+    prev = signal.signal(signal.SIGTERM, lambda s, f: hits.append(s))
+    try:
+        with PreemptionGuard() as g:
+            signal.raise_signal(signal.SIGTERM)
+            assert g.requested() and not hits  # first: absorbed, flagged
+            signal.raise_signal(signal.SIGTERM)
+            assert hits == [signal.SIGTERM]  # second: escalated
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+
+
+def test_guard_counts_signals():
+    """The handler itself is async-signal-safe (flag only — a registry
+    counter there could deadlock on the registry lock the interrupted
+    thread holds); the signal is counted when the main loop polls
+    requested(), exactly once."""
+    reg = MetricsRegistry()
+    with PreemptionGuard(registry=reg) as g:
+        signal.raise_signal(signal.SIGTERM)
+        assert reg.counter("supervisor/preemption_signals",
+                           sig="SIGTERM").value == 0  # not in the handler
+        assert g.requested()
+        assert g.requested()  # idempotent count
+    assert reg.counter("supervisor/preemption_signals",
+                       sig="SIGTERM").value == 1
+
+
+def test_guard_maps_sigint_to_nonrestartable_exit():
+    """Ctrl-C is a deliberate stop: it checkpoints like a preemption but
+    must NOT be auto-restarted (the fleet's SIGTERM is)."""
+    from hetu_galvatron_tpu.runtime.supervisor import EXIT_CODE_INTERRUPTED
+
+    with PreemptionGuard() as g:
+        signal.raise_signal(signal.SIGINT)
+        assert g.requested()
+        assert g.exit_code() == EXIT_CODE_INTERRUPTED
+    assert EXIT_CODE_INTERRUPTED not in RESTARTABLE_EXIT_CODES
+    with PreemptionGuard() as g:
+        signal.raise_signal(signal.SIGTERM)
+        assert g.exit_code() == EXIT_CODE_CHECKPOINT_AND_EXIT
+    # a drill request (no signal) reads as preemption
+    g = PreemptionGuard(enabled=False)
+    with g:
+        g.request()
+        assert g.exit_code() == EXIT_CODE_CHECKPOINT_AND_EXIT
+
+
+def test_guard_disabled_installs_nothing():
+    before = signal.getsignal(signal.SIGTERM)
+    with PreemptionGuard(enabled=False) as g:
+        assert signal.getsignal(signal.SIGTERM) is before
+        g.request()  # drills can still set the flag programmatically
+        assert g.requested()
+
+
+def test_guard_rearms_per_entry():
+    g = PreemptionGuard(enabled=False)
+    with g:
+        g.request()
+        assert g.requested()
+    with g:
+        assert not g.requested()  # a fresh loop starts clean
+
+
+# -- run_with_restarts ------------------------------------------------------
+
+
+def _supervised(codes, **kw):
+    """Run a scripted sequence of exit codes, recording sleeps."""
+    sleeps = []
+    seq = list(codes)
+
+    def attempt():
+        c = seq.pop(0)
+        if isinstance(c, Exception):
+            raise c
+        return c
+
+    rc = run_with_restarts(attempt, sleep=sleeps.append,
+                           log=lambda m: None, registry=MetricsRegistry(),
+                           **kw)
+    return rc, sleeps, seq
+
+
+def test_restarts_on_resume_to_disambiguate_then_succeeds():
+    rc, sleeps, left = _supervised(
+        [EXIT_CODE_RESUME_TO_DISAMBIGUATE, EXIT_CODE_RESUME_TO_DISAMBIGUATE, 0],
+        max_restarts=3, base_delay=1.0)
+    assert rc == 0 and not left
+    assert len(sleeps) == 2
+    # jittered exponential: each delay within its attempt's envelope
+    assert 0 <= sleeps[0] <= 1.0 and 0 <= sleeps[1] <= 2.0
+
+
+def test_restarts_on_preemption_code():
+    rc, sleeps, _ = _supervised([EXIT_CODE_CHECKPOINT_AND_EXIT, 0],
+                                max_restarts=2)
+    assert rc == 0 and len(sleeps) == 1
+
+
+def test_failed_validation_is_terminal():
+    """Exit 17 = persistent fault: restarting would reproduce it, so the
+    supervisor surfaces it immediately (the reference's contract)."""
+    rc, sleeps, left = _supervised(
+        [EXIT_CODE_FAILED_ON_RESULT_VALIDATION, 0], max_restarts=3)
+    assert rc == EXIT_CODE_FAILED_ON_RESULT_VALIDATION
+    assert not sleeps and left == [0]  # never restarted
+
+
+def test_unknown_code_is_terminal():
+    rc, sleeps, _ = _supervised([5, 0], max_restarts=3)
+    assert rc == 5 and not sleeps
+
+
+def test_restart_budget_is_bounded():
+    rc, sleeps, _ = _supervised(
+        [EXIT_CODE_CHECKPOINT_AND_EXIT] * 5, max_restarts=2)
+    assert rc == EXIT_CODE_CHECKPOINT_AND_EXIT
+    assert len(sleeps) == 2  # exactly max_restarts backoffs, then give up
+
+
+def test_restart_budget_resets_on_progress():
+    """The budget bounds crash LOOPS, not total preemptions: attempts
+    that committed a new checkpoint reset the counter, so a preemptible
+    fleet can preempt a healthy run more than max_restarts times."""
+    seq = [EXIT_CODE_CHECKPOINT_AND_EXIT] * 6 + [0]
+    steps = iter(range(100))
+
+    rc = run_with_restarts(
+        lambda: seq.pop(0), max_restarts=2,
+        progress_fn=lambda: next(steps),  # every attempt advanced
+        sleep=lambda s: None, log=lambda m: None,
+        registry=MetricsRegistry())
+    assert rc == 0 and not seq  # survived 6 preemptions on a budget of 2
+
+    # without progress the same sequence exhausts the budget
+    rc2, sleeps, _ = _supervised(
+        [EXIT_CODE_CHECKPOINT_AND_EXIT] * 6 + [0], max_restarts=2,
+        progress_fn=lambda: "step_0")  # checkpoint never advances
+    assert rc2 == EXIT_CODE_CHECKPOINT_AND_EXIT and len(sleeps) == 2
+
+
+def test_crash_restarts_when_enabled():
+    rc, sleeps, _ = _supervised([InjectedCrash("boom"), 0],
+                                max_restarts=2, restart_on_error=True)
+    assert rc == 0 and len(sleeps) == 1
+
+
+def test_crash_reraises_when_disabled():
+    with pytest.raises(InjectedCrash):
+        _supervised([InjectedCrash("boom"), 0],
+                    max_restarts=2, restart_on_error=False)
+
+
+def test_crash_budget_exhaustion_reraises():
+    with pytest.raises(InjectedCrash, match="third"):
+        _supervised([InjectedCrash("a"), InjectedCrash("b"),
+                     InjectedCrash("third")],
+                    max_restarts=2, restart_on_error=True)
+
+
+def test_restarts_counted_in_registry():
+    reg = MetricsRegistry()
+    seq = [EXIT_CODE_CHECKPOINT_AND_EXIT, 0]
+    run_with_restarts(lambda: seq.pop(0), sleep=lambda s: None,
+                      log=lambda m: None, registry=reg)
+    assert reg.counter("supervisor/restarts",
+                       code=EXIT_CODE_CHECKPOINT_AND_EXIT).value == 1
+
+
+# -- FaultDrill -------------------------------------------------------------
+
+
+def _drill(**kw):
+    reg = MetricsRegistry()
+    return FaultDrill(RerunArgs(**kw), registry=reg), reg
+
+
+def test_drill_nan_fires_once_at_step_k():
+    d, reg = _drill(inject_kind="nan", inject_at_iter=2)
+    import math
+
+    assert d.apply(1.0, 0) == 1.0
+    assert d.apply(1.0, 1) == 1.0
+    assert math.isnan(d.apply(1.0, 2))
+    assert d.apply(1.0, 2) == 1.0  # one-shot: re-running step 2 is clean
+    assert reg.counter("faults/injected", kind="nan").value == 1
+
+
+def test_drill_spike_scales_loss():
+    d, _ = _drill(inject_kind="spike", inject_at_iter=0,
+                  inject_spike_scale=50.0)
+    assert d.apply(2.0, 0) == pytest.approx(101.0)
+
+
+def test_drill_crash_raises():
+    d, reg = _drill(inject_kind="crash", inject_at_iter=1)
+    d.apply(1.0, 0)
+    with pytest.raises(InjectedCrash, match="iteration 1"):
+        d.apply(1.0, 1)
+    assert reg.counter("faults/injected", kind="crash").value == 1
+
+
+def test_drill_preempt_delivers_real_sigterm():
+    d, _ = _drill(inject_kind="preempt", inject_at_iter=0)
+    with PreemptionGuard() as g:
+        assert d.apply(1.0, 0) == 1.0  # loss untouched; the signal fires
+        assert g.requested()
+
+
+def test_drill_disarms_on_resumed_runs():
+    d, reg = _drill(inject_kind="nan", inject_at_iter=3)
+    d.arm(start_iter=3)  # resumed past/at the drill point: train clean
+    assert d.apply(1.0, 3) == 1.0
+    assert reg.counter("faults/injected", kind="nan").value == 0
+
+
+def test_drill_none_is_identity():
+    d, _ = _drill()
+    assert d.apply(float("inf"), 0) == float("inf")

@@ -241,6 +241,25 @@ def test_mfu_gauge_math():
     assert mfu == pytest.approx(expect, rel=1e-9)
 
 
+@pytest.mark.robustness
+def test_telemetry_resume_from_continues_counters():
+    """A checkpoint-resumed run's cumulative step/token counters continue
+    from the stored totals instead of restarting at zero (full-state
+    resume carries the telemetry step)."""
+    reg = MetricsRegistry()
+    tel = TrainingTelemetry(reg, global_batch_size=4, seq_length=8,
+                            flush_interval=100)
+    tel.resume_from(10)
+    assert reg.counter("train/steps").value == 10
+    assert reg.counter("train/tokens").value == 10 * 4 * 8
+    tel(10, {"loss": 1.0})
+    assert reg.counter("train/steps").value == 11
+    # resume_from(0) on a fresh run is a no-op
+    reg2 = MetricsRegistry()
+    TrainingTelemetry(reg2, global_batch_size=4, seq_length=8).resume_from(0)
+    assert reg2.counter("train/steps").value == 0
+
+
 def test_peak_tflops_table():
     assert peak_device_tflops("TPU v5 lite") == 197.0
     assert peak_device_tflops("TPU v4") == 275.0

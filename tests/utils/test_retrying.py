@@ -1,0 +1,108 @@
+"""Shared backoff/retry policy (utils/retrying.py): jitter envelope,
+retryability routing, attempt budgets — the primitive every fault-tolerant
+I/O path (checkpoint reads, object-store fetches, restart supervisor)
+leans on."""
+
+import random
+
+import pytest
+
+from hetu_galvatron_tpu.utils.retrying import (
+    backoff_delay,
+    backoff_delays,
+    retry_call,
+)
+
+pytestmark = [pytest.mark.utils, pytest.mark.robustness]
+
+
+def test_backoff_envelope_is_capped_exponential():
+    assert backoff_delay(0, base=1.0, cap=30.0, jitter=False) == 1.0
+    assert backoff_delay(1, base=1.0, cap=30.0, jitter=False) == 2.0
+    assert backoff_delay(3, base=1.0, cap=30.0, jitter=False) == 8.0
+    assert backoff_delay(10, base=1.0, cap=30.0, jitter=False) == 30.0  # cap
+
+
+def test_backoff_jitter_stays_inside_envelope():
+    rng = random.Random(0)
+    for a in range(8):
+        for _ in range(20):
+            d = backoff_delay(a, base=0.5, cap=4.0, rng=rng)
+            assert 0.0 <= d <= min(4.0, 0.5 * 2 ** a)
+
+
+def test_backoff_jitter_decorrelates():
+    """Full jitter: two workers with different rngs must not sleep the
+    same schedule (the thundering-herd property the supervisor needs)."""
+    a = list(backoff_delays(6, base=1.0, cap=60.0, rng=random.Random(1)))
+    b = list(backoff_delays(6, base=1.0, cap=60.0, rng=random.Random(2)))
+    assert len(a) == len(b) == 5  # no sleep after the final attempt
+    assert a != b
+
+
+def test_retry_call_retries_then_succeeds():
+    calls, sleeps = [], []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise IOError("SlowDown")
+        return "ok"
+
+    out = retry_call(flaky, attempts=4, base=0.1, sleep=sleeps.append,
+                     rng=random.Random(0))
+    assert out == "ok"
+    assert len(calls) == 3
+    assert len(sleeps) == 2 and all(s >= 0 for s in sleeps)
+
+
+def test_retry_call_nonretryable_fails_fast():
+    calls = []
+
+    def gone():
+        calls.append(1)
+        raise FileNotFoundError("404")
+
+    with pytest.raises(FileNotFoundError):
+        retry_call(gone, attempts=5,
+                   retryable=lambda e: not isinstance(e, FileNotFoundError),
+                   sleep=lambda s: None)
+    assert len(calls) == 1  # a permanent error never burns the budget
+
+
+def test_retry_call_exhausts_budget_and_raises_last():
+    calls = []
+
+    def always():
+        calls.append(1)
+        raise IOError(f"attempt {len(calls)}")
+
+    with pytest.raises(IOError, match="attempt 3"):
+        retry_call(always, attempts=3, sleep=lambda s: None)
+    assert len(calls) == 3
+
+
+def test_retry_call_counts_in_registry(monkeypatch):
+    from hetu_galvatron_tpu.observability import registry as reg_mod
+
+    reg = reg_mod.MetricsRegistry()
+    monkeypatch.setattr(reg_mod, "get_registry", lambda: reg)
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 2:
+            raise IOError("x")
+        return 1
+
+    retry_call(flaky, attempts=2, op="test.op", sleep=lambda s: None)
+    assert reg.counter("retry/attempts", op="test.op").value == 1
+
+
+def test_on_retry_hook_sees_error_and_delay():
+    seen = []
+    retry_call(
+        lambda: (_ for _ in ()).throw(IOError("x")) if not seen else "ok",
+        attempts=2, sleep=lambda s: None,
+        on_retry=lambda e, a, d: seen.append((type(e).__name__, a)))
+    assert seen == [("OSError", 0)]  # IOError is an OSError alias
