@@ -1,0 +1,240 @@
+"""Serving launcher: request stream -> continuous-batching engine -> token
+streams.
+
+The offline ``cli/generate.py`` decodes ONE prompt per invocation; this
+frontend drives the serving engine (``serving/engine.py``) with many
+concurrent requests::
+
+    python -m hetu_galvatron_tpu.cli.serve <model.yaml> \
+        requests=<requests.jsonl> [tokenizer=byte|<hf-name-or-path>] \
+        [ckpt=<framework ckpt root>] [hf_path=<hf checkpoint dir>] \
+        [metrics=<metrics.jsonl>] [stream=1] \
+        [serving.* / model.* / parallel.* overrides]
+
+    # one-shot form (single request):
+    python -m hetu_galvatron_tpu.cli.serve <model.yaml> prompt="..." \
+        max_new_tokens=64
+
+Each line of ``requests.jsonl`` is one request::
+
+    {"prompt": "...", "max_new_tokens": 32, "temperature": 0.8,
+     "seed": 7, "arrival_offset_s": 0.5}
+
+``arrival_offset_s`` staggers submission relative to startup (a recorded
+trace replays with its original arrival pattern). With ``stream=1`` every
+token is printed as a JSONL event as its request's stream drains —
+requests print in submission order (the engine generates them
+concurrently; per-request TTFT in the metrics reflects actual production
+time); ``stream=0`` prints one completion record per request. Serving metrics (TTFT / inter-token
+latency percentiles, queue depth, KV occupancy, tokens/sec — see README
+"Serving") land in ``metrics`` and render with ``cli/summarize.py``.
+
+With more than one visible device the decode runs under the plan's GSPMD
+shardings exactly like ``cli/generate.py`` (pure-TP submesh unless explicit
+``parallel.*`` degrees are given); the KV pool's head axis follows the
+plan's attention tp axes. The offline ``generate`` CLI remains supported
+for single prompts.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def _read_requests(kv):
+    if kv.get("requests"):
+        out = []
+        with open(kv["requests"]) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    out.append(json.loads(line))
+        return out
+    req = {"prompt": kv["prompt"]}
+    for key in ("max_new_tokens", "temperature", "seed"):
+        if key in kv:
+            req[key] = float(kv[key]) if key == "temperature" else int(kv[key])
+    return [req]
+
+
+def main(argv=None) -> int:
+    argv = list(argv if argv is not None else sys.argv[1:])
+    kv_keys = ("prompt", "requests", "max_new_tokens", "temperature", "seed",
+               "tokenizer", "ckpt", "hf_path", "metrics", "stream")
+    kv = {}
+    passthrough = []
+    for a in argv:
+        k = a.split("=", 1)[0]
+        if "=" in a and k in kv_keys:
+            kv[k] = a.split("=", 1)[1]
+        else:
+            passthrough.append(a)
+    if "prompt" not in kv and "requests" not in kv:
+        print("usage: serve <model.yaml> requests=<jsonl> | prompt=\"...\" "
+              "[key=value ...]", file=sys.stderr)
+        return 2
+
+    import jax
+
+    from hetu_galvatron_tpu.core.arguments import args_from_cli
+    from hetu_galvatron_tpu.cli.preprocess_data import make_tokenizer
+    from hetu_galvatron_tpu.models.builder import init_causal_lm
+    from hetu_galvatron_tpu.utils.hf_config_adapter import resolve_model_config
+
+    args = args_from_cli(passthrough, mode="train_dist")
+    args = resolve_model_config(args)
+    cfg = args.model
+
+    tok = make_tokenizer(kv.get("tokenizer"))
+    if tok.vocab_size > cfg.vocab_size:
+        raise ValueError(
+            f"tokenizer vocab {tok.vocab_size} exceeds model vocab "
+            f"{cfg.vocab_size}; pass a matching model config")
+
+    init_key = jax.random.key(int(kv.get("seed", 0)))
+    box = {}
+
+    def _shapes(k):
+        p, box["axes"] = init_causal_lm(k, cfg)
+        return p
+
+    params_target = jax.eval_shape(_shapes, init_key)
+    axes = box["axes"]
+    if kv.get("ckpt"):
+        import os
+
+        from hetu_galvatron_tpu.runtime.checkpoint import (
+            latest_checkpoint,
+            load_checkpoint,
+        )
+
+        ckdir = kv["ckpt"]
+        if not os.path.basename(ckdir).startswith("step_"):
+            found = latest_checkpoint(ckdir)
+            if found is None:
+                raise FileNotFoundError(
+                    f"no step_* checkpoint found under {ckdir}")
+            ckdir = found
+        params, _, step = load_checkpoint(ckdir, params_target)
+        print(f"loaded {ckdir} (step {step})", file=sys.stderr)
+    elif kv.get("hf_path"):
+        from hetu_galvatron_tpu.cli.checkpoint_convert import (
+            _load_hf_state_dict,
+        )
+        from hetu_galvatron_tpu.runtime.checkpoint import hf_to_params
+
+        params = hf_to_params(_load_hf_state_dict(kv["hf_path"]), cfg)
+        print(f"loaded HF weights from {kv['hf_path']}", file=sys.stderr)
+    else:
+        print("warning: no ckpt/hf_path given; serving RANDOM weights "
+              "(smoke mode)", file=sys.stderr)
+        params = init_causal_lm(init_key, cfg)[0]
+
+    # metrics registry: a dedicated JSONL stream for this serving run
+    from hetu_galvatron_tpu.observability.registry import MetricsRegistry
+    from hetu_galvatron_tpu.observability.sinks import JsonlSink
+
+    metrics_path = kv.get("metrics") or args.serving.metrics_path or \
+        "serve_metrics.jsonl"
+    registry = MetricsRegistry([JsonlSink(metrics_path)])
+
+    # plan-aware mesh (same pure-TP submesh heuristic as cli/generate.py)
+    mesh = hpc = None
+    world = len(jax.devices())
+    degree_keys = ("parallel.global_tp_deg", "parallel.pp_deg",
+                   "parallel.global_cp_deg", "parallel.vocab_tp")
+    user_parallel = any(a.split("=", 1)[0] in degree_keys
+                        for a in passthrough)
+    tp = 1
+    while (tp * 2 <= world and cfg.num_attention_heads % (tp * 2) == 0
+           and cfg.kv_heads % (tp * 2) == 0):
+        tp *= 2
+    if world > 1 and (user_parallel or tp > 1):
+        from hetu_galvatron_tpu.runtime.hybrid_config import (
+            get_hybrid_parallel_config,
+        )
+        from hetu_galvatron_tpu.runtime.mesh import build_mesh
+
+        if not user_parallel:
+            args.parallel.global_tp_deg = tp
+            if cfg.padded_vocab_size % tp == 0:
+                args.parallel.vocab_tp = tp
+            args.parallel.global_train_batch_size = tp
+            sub_world = tp
+        else:
+            sub_world = world
+        print(f"serving on {sub_world} devices "
+              f"(tp={args.parallel.global_tp_deg})", file=sys.stderr)
+        hpc = get_hybrid_parallel_config(args, sub_world)
+        mesh = build_mesh(sub_world, 1, devices=jax.devices()[:sub_world])
+
+    from hetu_galvatron_tpu.serving.engine import ServingEngine
+
+    serving = args.serving
+    if serving.eos_id is None:
+        serving = serving.model_copy(
+            update={"eos_id": getattr(tok, "eod_id", None)})
+    stream = kv.get("stream", "1") not in ("0", "false", "False")
+    engine = ServingEngine(params, cfg, serving, mesh=mesh, hpc=hpc,
+                           axes_tree=axes if mesh is not None else None,
+                           registry=registry)
+
+    reqs = _read_requests(kv)
+    # compile decode + every prefill bucket BEFORE traffic: TTFT must
+    # measure serving latency, not jit compilation
+    print("warmup: compiling decode + prefill buckets ...", file=sys.stderr)
+    engine.warmup()
+    engine.start()
+    t0 = time.monotonic()
+    handles = []
+    try:
+        for i, r in enumerate(reqs):
+            at = float(r.get("arrival_offset_s", 0.0))
+            wait = t0 + at - time.monotonic()
+            if wait > 0:
+                time.sleep(wait)
+            ids = tok.encode(r["prompt"])
+            if not ids:
+                print(json.dumps({"rid": i, "event": "rejected",
+                                  "reason": "empty prompt"}))
+                continue
+            h = engine.submit(
+                ids,
+                max_new_tokens=r.get("max_new_tokens"),
+                temperature=r.get("temperature"),
+                seed=int(r.get("seed", 0)))
+            handles.append((i, r, h))
+            if h.status == "rejected":
+                print(json.dumps({"rid": i, "event": "rejected",
+                                  "reason": "capacity"}))
+
+        for i, r, h in handles:
+            if h.status == "rejected":
+                continue
+            if stream:
+                for t in h.tokens():
+                    print(json.dumps({"rid": i, "event": "token",
+                                      "text": tok.decode([t])}), flush=True)
+            out = h.result()
+            eod = getattr(tok, "eod_id", None)
+            if eod is not None and eod in out:
+                out = out[: out.index(eod)]
+            print(json.dumps({
+                "rid": i, "event": "done", "status": h.status,
+                "reason": h.finish_reason, "n_tokens": len(h.output),
+                "ttft_ms": (None if h.ttft_s() is None
+                            else round(h.ttft_s() * 1000.0, 3)),
+                "text": tok.decode(out)}), flush=True)
+    finally:
+        engine.close()
+        registry.close()
+    print(f"metrics written to {metrics_path} "
+          f"(render: python -m hetu_galvatron_tpu.cli.summarize "
+          f"{metrics_path})", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -119,9 +119,63 @@ def summarize(path: str, out=None) -> Dict[str, Any]:
             f"{json.loads(lb).get('stat', '?')} {_fmt(r['value'])}"
             for lb, r in sorted(mems))
         w(f"device mem MB    {parts}")
-    plan = get("gauge", "plan/comm_total_mb")
-    if plan:
-        w(f"plan comm MB/step (predicted)  {_fmt(plan['value'])}")
+    # the plan's predicted comm volume is a one-shot event (constants of
+    # the plan; the legacy gauge form is still read for old files)
+    plan_ev = [r for r in records if r.get("kind") == "event"
+               and r.get("name") == "plan"]
+    if plan_ev and "predicted_comm_mb_per_step" in plan_ev[-1].get(
+            "data", {}):
+        w(f"plan comm MB/step (predicted)  "
+          f"{_fmt(float(plan_ev[-1]['data']['predicted_comm_mb_per_step']))}")
+    else:
+        plan = get("gauge", "plan/comm_total_mb")
+        if plan:
+            w(f"plan comm MB/step (predicted)  {_fmt(plan['value'])}")
+
+    # -- serving (engine telemetry, serving/engine.py) --
+    srv_tps = get("gauge", "serve/tokens_per_sec")
+    ttft = get("histogram", "serve/ttft_ms")
+    if srv_tps or (ttft and ttft.get("count")):
+        w()
+        w("-- serving --")
+        for key, label in (("serve/requests_submitted", "submitted"),
+                           ("serve/requests_completed", "completed"),
+                           ("serve/requests_rejected", "rejected"),
+                           ("serve/requests_cancelled", "cancelled"),
+                           ("serve/requests_timeout", "timed out")):
+            c = get("counter", key)
+            if c and c["value"]:
+                headline[key] = c["value"]
+                w(f"requests {label:<12} {c['value']:,.0f}")
+        for key, label in (("serve/prefill_tokens", "prefill tokens"),
+                           ("serve/decode_tokens", "decode tokens"),
+                           ("serve/steps", "engine steps"),
+                           ("serve/engine_errors", "engine errors")):
+            c = get("counter", key)
+            if c and (c["value"] or not key.endswith("errors")):
+                w(f"{label:<21} {c['value']:,.0f}")
+        if ttft and ttft.get("count"):
+            headline["ttft_p50_ms"] = ttft["p50"]
+            w(f"TTFT ms          p50 {_fmt(ttft['p50'])} | p90 "
+              f"{_fmt(ttft['p90'])} | p99 {_fmt(ttft['p99'])} "
+              f"(n={ttft['count']})")
+        itl = get("histogram", "serve/itl_ms")
+        if itl and itl.get("count"):
+            headline["itl_p50_ms"] = itl["p50"]
+            w(f"inter-token ms   p50 {_fmt(itl['p50'])} | p90 "
+              f"{_fmt(itl['p90'])} | p99 {_fmt(itl['p99'])} "
+              f"(n={itl['count']})")
+        if srv_tps:
+            headline["serve_tokens_per_sec"] = srv_tps["value"]
+            w(f"serve tokens/sec {_fmt(srv_tps['value'])}")
+        for key, label in (("serve/queue_depth", "queue depth (end)"),
+                           ("serve/active_requests", "active (end)"),
+                           ("serve/kv_occupancy", "KV occupancy (end)"),
+                           ("serve/kv_blocks_used", "KV blocks (end)"),
+                           ("serve/jit_programs", "jit programs")):
+            g = get("gauge", key)
+            if g is not None:
+                w(f"{label:<21} {_fmt(g['value'])}")
 
     spans = [(json.loads(lb).get("path", "?"), r)
              for (k, n, lb), r in latest.items()
@@ -136,7 +190,7 @@ def summarize(path: str, out=None) -> Dict[str, Any]:
 
     rest = [((k, n, lb), r) for (k, n, lb), r in sorted(latest.items())
             if k in ("counter", "gauge")
-            and not n.startswith(("train/", "device/", "plan/"))]
+            and not n.startswith(("train/", "device/", "plan/", "serve/"))]
     if rest:
         w()
         w("-- other counters/gauges --")

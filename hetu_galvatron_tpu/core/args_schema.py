@@ -287,6 +287,38 @@ class ObservabilityArgs(BaseModel):
     peak_tflops: float = 0.0
 
 
+class ServingArgs(BaseModel):
+    """Inference-serving engine knobs (``serving/``): continuous batching,
+    paged KV cache, admission control, streaming."""
+
+    # decode lanes: sequences decoded together at one jitted batch shape
+    max_batch_size: int = 8
+    # paged KV cache geometry; block 0 is reserved scratch. num_kv_blocks=0
+    # derives a pool that holds max_batch_size full-length sequences
+    kv_block_size: int = 16
+    num_kv_blocks: int = 0
+    # per-sequence cap (prompt + generation); 0 = model max positions
+    max_seq_len: int = 0
+    # default per-request generation budget (requests may override)
+    max_new_tokens: int = 64
+    # admission control: per-engine-step prefill budget, either as GFLOPs
+    # (converted via the cost model's forward FLOPs/token) or a direct
+    # token cap; 0 = that bound unlimited. The tighter one wins.
+    prefill_flops_budget_g: float = 0.0
+    max_prefill_tokens: int = 0
+    # sampling defaults (per-request temperature/eos override these);
+    # top_k is engine-static (shapes the jitted sampler)
+    temperature: float = 0.0
+    top_k: Optional[int] = None
+    eos_id: Optional[int] = None
+    # retire requests older than this many seconds (0 = no deadline)
+    request_timeout_s: float = 0.0
+    # registry flush cadence, in engine steps
+    flush_interval: int = 32
+    # JSONL metrics file for cli/serve.py; None derives ./serve_metrics.jsonl
+    metrics_path: Optional[str] = None
+
+
 class RerunArgs(BaseModel):
     """Fault-detection state machine knobs (reference rerun_state_machine.py)."""
 
@@ -446,6 +478,7 @@ class CoreArgs(BaseModel):
     profile: ProfileArgs = Field(default_factory=ProfileArgs)
     logging: LoggingArgs = Field(default_factory=LoggingArgs)
     observability: ObservabilityArgs = Field(default_factory=ObservabilityArgs)
+    serving: ServingArgs = Field(default_factory=ServingArgs)
     rerun: RerunArgs = Field(default_factory=RerunArgs)
     supervisor: SupervisorArgs = Field(default_factory=SupervisorArgs)
     search: SearchArgs = Field(default_factory=SearchArgs)

@@ -43,16 +43,24 @@ def _check_supported(cfg: ModelArgs, params: Params) -> None:
         raise NotImplementedError("generate(): dense layers only")
 
 
-def _cached_sdpa(q, ck, cv, pos):
+def _cached_sdpa(q, ck, cv, pos, shift=None):
     """q [B,1,Nq,D] against the full cache [B,T,Nkv,D]; positions > pos are
-    masked (static T => one compiled shape for the whole decode scan)."""
+    masked (static T => one compiled shape for the whole decode scan).
+    ``pos`` is a scalar (one shared position, the offline scan) or [B]
+    (per-row positions — the serving engine's paged decode delegates
+    here). ``shift`` [B] (left-padded ragged prompts) additionally masks
+    the leading pad positions < shift[b]."""
     B, _, nq, D = q.shape
     T, nkv = ck.shape[1], ck.shape[2]
     G = nq // nkv
     qg = q.reshape(B, nkv, G, D).astype(jnp.float32)
     s = jnp.einsum("bkgd,btkd->bkgt", qg, ck.astype(jnp.float32))
     s = s / jnp.sqrt(jnp.float32(D))
-    mask = jnp.arange(T)[None, None, None, :] <= pos
+    t = jnp.arange(T)[None, None, None, :]
+    pos = jnp.asarray(pos)
+    mask = t <= (pos[:, None, None, None] if pos.ndim else pos)
+    if shift is not None:
+        mask = mask & (t >= shift[:, None, None, None])
     s = jnp.where(mask, s, jnp.float32(jnp.finfo(jnp.float32).min))
     w = jax.nn.softmax(s, axis=-1)
     out = jnp.einsum("bkgt,btkd->bkgd", w, cv.astype(jnp.float32))
@@ -60,11 +68,22 @@ def _cached_sdpa(q, ck, cv, pos):
 
 
 def _embed_at(p: Params, tokens: jax.Array, pos, cfg: ModelArgs,
-              compute_dtype):
-    """Token embedding for one decode step at absolute position ``pos``."""
+              compute_dtype, shift=None):
+    """Token embedding for one decode step at absolute position ``pos``
+    (per-row LOGICAL position ``pos - shift[b]`` for left-padded rows).
+    Mirrors ``modules.apply_embedding`` — including the embedding LayerNorm
+    and the gemma sqrt(hidden) scaling — so decode steps see the same
+    hidden-state distribution prefill produced."""
     x = jnp.take(p["wte"], tokens[:, None], axis=0)  # [B,1,H]
     if "wpe" in p:
-        x = x + jax.lax.dynamic_slice_in_dim(p["wpe"], pos, 1)[None]
+        if shift is not None:
+            x = x + jnp.take(p["wpe"], pos - shift, axis=0)[:, None]
+        else:
+            x = x + jax.lax.dynamic_slice_in_dim(p["wpe"], pos, 1)[None]
+    if "ln" in p:
+        x = M.apply_norm(p["ln"], x, cfg)
+    if cfg.scale_embeddings:
+        x = x * jnp.sqrt(jnp.float32(cfg.hidden_size)).astype(x.dtype)
     return x.astype(compute_dtype)
 
 
@@ -77,26 +96,44 @@ def init_kv_cache(cfg: ModelArgs, batch: int, max_len: int,
 
 
 def prefill(params: Params, tokens: jax.Array, cfg: ModelArgs, max_len: int,
-            *, compute_dtype=jnp.bfloat16):
+            *, compute_dtype=jnp.bfloat16, prompt_lens=None):
     """Run the prompt through the stack, filling the cache; returns
-    (cache, logits_last [B, V])."""
+    (cache, logits_last [B, V]).
+
+    ``prompt_lens`` [B] supports ragged batched prompts, LEFT-padded to the
+    common width S0 (row b's real tokens occupy columns [S0 - len_b, S0)):
+    positions restart at 0 on the first real token and the pad prefix is
+    masked out of attention, so every row reproduces its unpadded
+    single-row prefill exactly."""
     B, S0 = tokens.shape
+    shift = position_ids = segment_ids = None
+    if prompt_lens is not None:
+        shift = jnp.asarray(S0, jnp.int32) - prompt_lens.astype(jnp.int32)
+        idx = jnp.arange(S0, dtype=jnp.int32)[None]
+        position_ids = jnp.maximum(idx - shift[:, None], 0)
+        segment_ids = (idx >= shift[:, None]).astype(jnp.int32)
     rope = None
     if cfg.position_embedding_type == "rope":
         rope = M.rope_cos_sin(S0, cfg.head_dim, cfg.rope_theta,
                               scaling=cfg.rope_scaling)
+        if position_ids is not None:
+            rope = (rope[0][position_ids], rope[1][position_ids])
     cache = init_kv_cache(cfg, B, max_len, compute_dtype)
     x = M.apply_embedding(params["embed"], tokens, cfg,
-                          compute_dtype=compute_dtype)
+                          compute_dtype=compute_dtype,
+                          position_ids=position_ids)
     for i, lp in enumerate(params["layers"]):
         cell = {}
 
-        def sdpa(q, k, v, *, causal=True, cell=cell):
+        def sdpa(q, k, v, *, causal=True, segment_ids=None, cell=cell):
             cell["k"], cell["v"] = k, v  # rope-applied, pre-attention
-            return M.xla_sdpa(q, k, v, causal=causal)
+            return M.xla_sdpa(q, k, v, causal=causal,
+                              segment_ids=segment_ids)
 
+        sdpa.supports_segments = True
         x = M.apply_decoder_layer(lp, x, cfg, rope=rope, sdpa_fn=sdpa,
-                                  compute_dtype=compute_dtype)
+                                  compute_dtype=compute_dtype,
+                                  segment_ids=segment_ids)
         cache[i] = {
             "k": jax.lax.dynamic_update_slice_in_dim(
                 cache[i]["k"], cell["k"].astype(cache[i]["k"].dtype), 0,
@@ -113,15 +150,21 @@ def prefill(params: Params, tokens: jax.Array, cfg: ModelArgs, max_len: int,
 
 
 def decode_step(params: Params, cache, tokens: jax.Array, pos, cfg: ModelArgs,
-                *, rope_full=None, compute_dtype=jnp.bfloat16):
+                *, rope_full=None, compute_dtype=jnp.bfloat16, shift=None):
     """One token per sequence at absolute position ``pos`` (a traced
-    scalar); returns (cache, logits [B, V])."""
-    x = _embed_at(params["embed"], tokens, pos, cfg, compute_dtype)
+    scalar); returns (cache, logits [B, V]). ``shift`` [B] carries the
+    left-pad offsets of a ragged prefill: rope/learned positions use the
+    logical ``pos - shift[b]`` and the pad prefix stays masked."""
+    x = _embed_at(params["embed"], tokens, pos, cfg, compute_dtype,
+                  shift=shift)
     step_rope = None
     if rope_full is not None:
         cos, sin = rope_full
-        step_rope = (jax.lax.dynamic_slice_in_dim(cos, pos, 1),
-                     jax.lax.dynamic_slice_in_dim(sin, pos, 1))
+        if shift is not None:
+            step_rope = (cos[pos - shift][:, None], sin[pos - shift][:, None])
+        else:
+            step_rope = (jax.lax.dynamic_slice_in_dim(cos, pos, 1),
+                         jax.lax.dynamic_slice_in_dim(sin, pos, 1))
     for i, lp in enumerate(params["layers"]):
         cell = {}
 
@@ -131,7 +174,7 @@ def decode_step(params: Params, cache, tokens: jax.Array, pos, cfg: ModelArgs,
             cv = jax.lax.dynamic_update_slice_in_dim(
                 cache[i]["v"], v.astype(cache[i]["v"].dtype), pos, axis=1)
             cell["k"], cell["v"] = ck, cv
-            return _cached_sdpa(q, ck, cv, pos)
+            return _cached_sdpa(q, ck, cv, pos, shift=shift)
 
         x = M.apply_decoder_layer(lp, x, cfg, rope=step_rope, sdpa_fn=sdpa,
                                   compute_dtype=compute_dtype)
@@ -152,11 +195,30 @@ def generate(
     temperature: float = 0.0,  # 0 => greedy
     top_k: Optional[int] = None,
     eos_id: Optional[int] = None,
+    pad_id: Optional[int] = None,
+    prompt_lens: Optional[jax.Array] = None,
     key: Optional[jax.Array] = None,
     compute_dtype=jnp.bfloat16,
 ) -> jax.Array:
-    """Returns [B, S0 + max_new_tokens]; after EOS a sequence keeps emitting
-    ``eos_id``. Fully jittable (static shapes; scan over positions)."""
+    """Returns [B, S0 + max_new_tokens]. Fully jittable (static shapes;
+    scan over positions).
+
+    Retirement contract: once a row has emitted ``eos_id`` it is retired —
+    every later position carries ``pad_id`` (``eos_id`` when pad_id is
+    None, the legacy layout), NOT live samples. With greedy decoding
+    (temperature 0) this makes a row's whole output independent of which
+    neighbors share the batch; with temperature > 0 the live tokens still
+    draw from ONE shared key over the [B, V] batch (a row's samples depend
+    on batch size/row index — the serving engine uses per-request keys
+    instead), but the retired tail is masked either way. The serving
+    engine's per-request streams are checked against exactly this contract
+    (rows trimmed at their first eos).
+
+    ``prompt_lens`` [B] enables ragged batched prompts, LEFT-padded to
+    width S0: each row decodes as if it were the only (unpadded) sequence
+    — pad prefix masked from attention, positions starting at 0 on the
+    first real token.
+    """
     _check_supported(cfg, params)
     B, S0 = tokens.shape
     total = S0 + max_new_tokens
@@ -168,21 +230,27 @@ def generate(
                                    scaling=cfg.rope_scaling)
     if key is None:
         key = jax.random.key(0)
+    shift = None
+    if prompt_lens is not None:
+        shift = jnp.asarray(S0, jnp.int32) - prompt_lens.astype(jnp.int32)
 
     cache, logits = prefill(params, tokens, cfg, total,
-                            compute_dtype=compute_dtype)
+                            compute_dtype=compute_dtype,
+                            prompt_lens=prompt_lens)
     pick = _sample_pick(cfg, tokens.dtype, temperature, top_k)
+    fill = eos_id if pad_id is None else pad_id
 
     def body(carry, _):
         cache, logits, pos, done, k = carry
         k, sub = jax.random.split(k)
         nxt = pick(logits, sub)
         if eos_id is not None:
-            nxt = jnp.where(done, jnp.asarray(eos_id, nxt.dtype), nxt)
+            nxt = jnp.where(done, jnp.asarray(fill, nxt.dtype), nxt)
             done = done | (nxt == eos_id)
         cache, logits = decode_step(params, cache, nxt, pos, cfg,
                                     rope_full=rope_full,
-                                    compute_dtype=compute_dtype)
+                                    compute_dtype=compute_dtype,
+                                    shift=shift)
         return (cache, logits, pos + 1, done, k), nxt
 
     done0 = jnp.zeros((B,), bool)

@@ -22,10 +22,11 @@ that turns raw step metrics into the numbers the ROADMAP cares about:
 
 :func:`plan_comm_volume` computes each layer's PREDICTED per-step
 collective volume from the strategy plan (mirroring the message-size
-arithmetic in ``core/cost_model/cost.py``), emitted as labelled gauges so
-a run's observed step time can be audited against what the search engine
-thought the plan would communicate ("Revisiting the Time Cost Model of
-AllReduce": analytical comm models drift; keep the receipts).
+arithmetic in ``core/cost_model/cost.py``), emitted once in the one-shot
+``plan`` event so a run's observed step time can be audited against what
+the search engine thought the plan would communicate ("Revisiting the
+Time Cost Model of AllReduce": analytical comm models drift; keep the
+receipts).
 """
 
 from __future__ import annotations
@@ -311,21 +312,20 @@ def plan_comm_volume(
 
 def emit_plan_telemetry(registry: MetricsRegistry, hpc, model,
                         mixed_precision: bool = True) -> None:
-    """Gauge the plan's predicted comm volume per layer + the run totals
-    (called once at startup from the train launcher)."""
+    """Emit the plan's predicted comm volume as ONE ``plan`` event at
+    startup. The per-layer numbers are constants of the plan, so they ride
+    the one-shot event's ``layers`` list instead of registered gauges —
+    gauges re-snapshot into the sink on EVERY registry flush, which
+    duplicated ~4*layers identical records per flush for the whole run."""
     vols = plan_comm_volume(hpc.layers, model, global_bsz=hpc.global_bsz,
                             chunks=max(hpc.chunks, 1),
                             mixed_precision=mixed_precision)
-    total = 0.0
-    for i, v in enumerate(vols):
-        for coll, mb in v.items():
-            if coll == "total_mb":
-                continue
-            if mb:
-                registry.gauge("plan/comm_mb", layer=i,
-                               collective=coll[:-3]).set(mb)
-        total += v["total_mb"]
-    registry.gauge("plan/comm_total_mb").set(total)
+    total = sum(v["total_mb"] for v in vols)
     registry.event("plan", {
         "global_bsz": hpc.global_bsz, "chunks": hpc.chunks,
-        "pp_deg": hpc.pp_deg, "predicted_comm_mb_per_step": total})
+        "pp_deg": hpc.pp_deg, "predicted_comm_mb_per_step": total,
+        "layers": [
+            {"layer": i,
+             **{coll: mb for coll, mb in v.items() if mb}}
+            for i, v in enumerate(vols)],
+    })

@@ -1,0 +1,42 @@
+"""Inference serving subsystem: paged KV cache, continuous batching, engine.
+
+The training stack stops at offline fixed-batch decode
+(``models/generate.py``); this package adds the online-serving workload the
+ROADMAP's "heavy traffic" north star implies:
+
+* ``kv_cache.py`` — a paged KV cache: fixed-size blocks in a preallocated
+  pool with a per-sequence block table (vLLM's PagedAttention layout,
+  expressed as gather/scatter over jax arrays so the whole decode step
+  stays one jitted program).
+* ``scheduler.py`` — a continuous-batching scheduler: FIFO admission under
+  a KV-block + prefill-FLOPs budget (``cost_model/cost.py`` accounting),
+  per-sequence EOS/length/timeout retirement, slot recycling at a fixed
+  jitted batch shape.
+* ``engine.py`` — the serving engine: jitted paged prefill/decode programs
+  (plan-aware GSPMD sharding when given a mesh + HybridParallelConfig),
+  per-request token streams, cancellation, timeouts, and serving
+  telemetry wired into ``observability/``.
+
+Front ends: ``cli/serve.py`` (file/stdin request streams) and
+``tools/serve_bench.py`` (closed-loop load generator).
+"""
+
+from hetu_galvatron_tpu.serving.engine import ServingEngine
+from hetu_galvatron_tpu.serving.kv_cache import (
+    BlockAllocator,
+    PagedKVCache,
+)
+from hetu_galvatron_tpu.serving.scheduler import (
+    Request,
+    RequestHandle,
+    Scheduler,
+)
+
+__all__ = [
+    "BlockAllocator",
+    "PagedKVCache",
+    "Request",
+    "RequestHandle",
+    "Scheduler",
+    "ServingEngine",
+]

@@ -1,0 +1,599 @@
+"""Serving engine: jitted paged prefill/decode + continuous batching loop.
+
+Two programs, compiled once each (prefill once per length bucket), drive all
+traffic:
+
+* **prefill** — one request's (right-padded, bucketed) prompt through the
+  stack with the same attention math as offline ``models/generate.prefill``,
+  k/v written straight into the request's pool blocks, first token sampled
+  from the last real position's logits.
+* **decode** — one token for every slot at a FIXED batch shape
+  ``[max_batch_size]``: per-slot positions, per-slot block tables, per-slot
+  sampling params. Retired slots alias the scratch block and their outputs
+  are discarded, so admission/retirement never changes the compiled shape —
+  steady state runs with zero recompiles (``compile_count()`` lets tests
+  pin this).
+
+Plan-aware SPMD: given a mesh + :class:`HybridParallelConfig`, params are
+sharded by the plan's PartitionSpecs (``parallel/spmd.py``) and the KV pool's
+kv-head axis rides each layer's attention tp axes (``kv_cache.pool_pspecs``)
+— the searched plan picks the decode-time sharding just as it picks the
+train-time one. Without a mesh the same programs jit on one device.
+
+Determinism contract: a request's token stream depends only on (params,
+prompt, its own sampling seed/temperature) — greedy rows are argmax rows and
+sampled rows fold the request seed with the emitted-token index — never on
+which neighbors share the batch. The continuous-batching drill pins stream
+equality against offline ``generate()``.
+
+Host/device cadence: every step syncs the sampled tokens to the host (they
+feed the streams and the retirement logic). Decode steps are latency-bound
+anyway; the sync is the product, not overhead.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from hetu_galvatron_tpu.core.args_schema import ModelArgs, ServingArgs
+from hetu_galvatron_tpu.models import modules as M
+from hetu_galvatron_tpu.observability.registry import (
+    MetricsRegistry,
+    get_registry,
+)
+from hetu_galvatron_tpu.serving.kv_cache import (
+    PagedKVCache,
+    gather_pages,
+    paged_sdpa,
+    scatter_prefill,
+    scatter_token,
+)
+from hetu_galvatron_tpu.serving.scheduler import (
+    Request,
+    RequestHandle,
+    Scheduler,
+    Slot,
+)
+
+Params = Dict[str, Any]
+
+
+def _check_supported(cfg: ModelArgs, params: Params) -> None:
+    if cfg.post_norm or cfg.model_type in ("bert", "t5"):
+        raise NotImplementedError(
+            "ServingEngine serves dense causal decoder families; bert/t5 "
+            "have no paged decode path")
+    if any("moe" in lp for lp in params["layers"]):
+        raise NotImplementedError("ServingEngine: dense layers only")
+
+
+def default_buckets(block_size: int, cap_tokens: int) -> List[int]:
+    """Every prefill bucket ``bucket_length`` can produce: the power-of-two
+    ladder plus the capped (possibly non-power-of-two) top bucket — warmup
+    must cover the cap too or the first long prompt recompiles
+    mid-serving."""
+    out = []
+    b = block_size
+    while b < cap_tokens:
+        out.append(b)
+        b *= 2
+    out.append(cap_tokens)
+    return out
+
+
+def _make_sampler(cfg: ModelArgs, top_k: Optional[int]):
+    """[S, V] logits -> [S] tokens. Greedy rows (temp <= 0) take the
+    argmax; sampling rows draw categorical from a per-request key
+    (fold_in(seed, emitted-token index)) so a request's stream is
+    batch-composition invariant. Vocab-padding columns are never produced
+    (mirrors ``models/generate._sample_pick``)."""
+    valid = jnp.arange(cfg.padded_vocab_size) < cfg.vocab_size
+    neg = jnp.float32(jnp.finfo(jnp.float32).min)
+
+    def sample(logits, temps, seeds, gen_idx):
+        logits = jnp.where(valid, logits.astype(jnp.float32), neg)
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+        def one(row, t, s, g):
+            key = jax.random.fold_in(jax.random.key(s), g)
+            ll = row / jnp.maximum(t, jnp.float32(1e-6))
+            if top_k:
+                kth = jax.lax.top_k(ll, top_k)[0][-1]
+                ll = jnp.where(ll < kth, neg, ll)
+            return jax.random.categorical(key, ll).astype(jnp.int32)
+
+        sampled = jax.vmap(one)(logits, temps.astype(jnp.float32),
+                                seeds, gen_idx)
+        return jnp.where(temps <= 0.0, greedy, sampled)
+
+    return sample
+
+
+class ServingEngine:
+    """Continuous-batching inference over a loaded checkpoint + plan.
+
+    ``params`` is the (host or sharded) params tree from
+    ``models/builder.init_causal_lm`` / checkpoint restore; with
+    ``mesh``/``hpc``/``axes_tree`` the engine places it under the plan's
+    GSPMD shardings itself. ``submit()`` returns a
+    :class:`~hetu_galvatron_tpu.serving.scheduler.RequestHandle` streaming
+    tokens; drive the loop with :meth:`step` / :meth:`run_until_idle`, or
+    :meth:`start` a background thread.
+    """
+
+    def __init__(
+        self,
+        params: Params,
+        cfg: ModelArgs,
+        serving: Optional[ServingArgs] = None,
+        *,
+        mesh=None,
+        hpc=None,
+        axes_tree: Optional[Params] = None,
+        registry: Optional[MetricsRegistry] = None,
+        compute_dtype=jnp.bfloat16,
+        kv_dtype=None,
+    ):
+        serving = serving if serving is not None else ServingArgs()
+        _check_supported(cfg, params)
+        if mesh is not None and (hpc is None or axes_tree is None):
+            raise ValueError("mesh serving needs hpc + axes_tree (the plan "
+                             "and the params' logical axes)")
+        self.cfg = cfg
+        self.serving = serving
+        self.mesh = mesh
+        self.registry = registry if registry is not None else get_registry()
+        self.compute_dtype = compute_dtype
+        self.S = int(serving.max_batch_size)
+
+        max_seq_len = serving.max_seq_len or cfg.max_position_embeddings
+        num_blocks = serving.num_kv_blocks
+        if not num_blocks:
+            # default pool: every lane can hold a full-length sequence
+            per_seq = -(-max_seq_len // serving.kv_block_size)
+            num_blocks = 1 + self.S * per_seq
+
+        layer_shards = None
+        self._pspecs = None
+        if mesh is not None:
+            from hetu_galvatron_tpu.parallel.spmd import (
+                layer_shardings,
+                param_specs,
+                shard_params,
+            )
+
+            if hpc.pp_deg != 1:
+                raise ValueError("ServingEngine is the pp=1 decode path")
+            per_layer_all, vocab_sh = layer_shardings(hpc, mesh)
+            layer_shards = per_layer_all[hpc.num_encoder_layers:]
+            self._pspecs = param_specs(axes_tree, layer_shards, vocab_sh)
+            params = shard_params(params, self._pspecs, mesh)
+        self.params = params
+
+        self.kv = PagedKVCache(
+            cfg, num_blocks=num_blocks, block_size=serving.kv_block_size,
+            max_seq_len=max_seq_len,
+            dtype=kv_dtype if kv_dtype is not None else compute_dtype,
+            mesh=mesh, layer_shardings=layer_shards)
+        from hetu_galvatron_tpu.core.cost_model.cost import (
+            model_flops_per_token,
+        )
+
+        self.scheduler = Scheduler(
+            self.kv, max_slots=self.S,
+            max_position_embeddings=cfg.max_position_embeddings,
+            prefill_flops_budget=serving.prefill_flops_budget_g * 1e9,
+            # cost-model FLOPs are fwd+bwd (bwd counted 2x); prefill is
+            # forward-only
+            flops_per_token=model_flops_per_token(cfg) / 3.0,
+            max_prefill_tokens=serving.max_prefill_tokens)
+
+        # rope/position tables cover every storable position
+        self._table_len = self.kv.max_blocks_per_seq * self.kv.block_size
+        self._rope = None
+        if cfg.position_embedding_type == "rope":
+            self._rope = M.rope_cos_sin(self._table_len, cfg.head_dim,
+                                        cfg.rope_theta,
+                                        scaling=cfg.rope_scaling)
+        self._sample = _make_sampler(cfg, serving.top_k)
+        self._decode_fn = self._build_decode()
+        self._prefill_fns: Dict[int, Callable] = {}
+
+        self._lock = threading.RLock()
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._steps = 0
+        self._emitted_window: List[tuple] = []  # (t, cumulative tokens)
+        self._emitted_total = 0
+        self._closed = False
+        self.error: Optional[BaseException] = None  # fatal thread error
+
+    # -- jitted programs ----------------------------------------------------
+
+    def _shd(self, spec):
+        from jax.sharding import NamedSharding
+
+        return NamedSharding(self.mesh, spec)
+
+    def _pool_shardings(self):
+        return [{"k": self._shd(s), "v": self._shd(s)}
+                for s in self.kv.pspecs]
+
+    def _jit(self, fn, n_extra: int):
+        """jit with pools donated (arg 1); under a mesh, params/pools keep
+        their plan shardings and every batch array is replicated. Both
+        programs return (pools, tokens)."""
+        if self.mesh is None:
+            return jax.jit(fn, donate_argnums=(1,))
+        from jax.sharding import PartitionSpec as P
+
+        rep = self._shd(P())
+        nshd = jax.tree.map(self._shd, self._pspecs,
+                            is_leaf=lambda x: isinstance(x, P))
+        pools = self._pool_shardings()
+        return jax.jit(
+            fn,
+            in_shardings=(nshd, pools) + (rep,) * n_extra,
+            out_shardings=(pools, rep),
+            donate_argnums=(1,),
+        )
+
+    def _layer_stack(self, params, pools, x, rope, sdpa_for):
+        """Shared decoder-stack walk for prefill and decode: layer i runs
+        with an sdpa closure that updates/reads pools[i]."""
+        cfg = self.cfg
+        new_pools = list(pools)
+        for i, lp in enumerate(params["layers"]):
+            cell: Dict[str, jax.Array] = {}
+            sdpa = sdpa_for(i, new_pools, cell)
+            x = M.apply_decoder_layer(lp, x, cfg, rope=rope, sdpa_fn=sdpa,
+                                      compute_dtype=self.compute_dtype)
+            new_pools[i] = {"k": cell["k"], "v": cell["v"]}
+        x = M.apply_norm(params["prenorm"], x, cfg)
+        logits = M.apply_lm_head(params["head"], x, cfg,
+                                 wte=params["embed"]["wte"],
+                                 compute_dtype=self.compute_dtype)
+        return new_pools, logits
+
+    def _build_prefill(self, bucket: int):
+        """(params, pools, tokens [1, bucket], table [bucket//bs],
+        true_len, temp, seed) -> (pools, first_token). Causal attention
+        over the right-padded prompt — pad rows never influence rows
+        < true_len — with k/v scattered into the slot's blocks."""
+        cfg = self.cfg
+        maxpos = cfg.max_position_embeddings
+
+        def fn(params, pools, tokens, table, true_len, temp, seed):
+            rope = None
+            if self._rope is not None:
+                rope = (self._rope[0][:bucket], self._rope[1][:bucket])
+            pos_ids = None
+            if "wpe" in params["embed"]:
+                pos_ids = jnp.minimum(jnp.arange(bucket), maxpos - 1)[None]
+            x = M.apply_embedding(params["embed"], tokens, cfg,
+                                  compute_dtype=self.compute_dtype,
+                                  position_ids=pos_ids)
+
+            def sdpa_for(i, new_pools, cell):
+                def sdpa(q, k, v, *, causal=True):
+                    cell["k"] = scatter_prefill(new_pools[i]["k"], k[0],
+                                                table)
+                    cell["v"] = scatter_prefill(new_pools[i]["v"], v[0],
+                                                table)
+                    return M.xla_sdpa(q, k, v, causal=causal)
+
+                return sdpa
+
+            new_pools, logits = self._layer_stack(params, pools, x, rope,
+                                                  sdpa_for)
+            last = jax.lax.dynamic_slice_in_dim(
+                logits[0], true_len - 1, 1, axis=0)  # [1, V]
+            tok = self._sample(
+                last, jnp.asarray([temp], jnp.float32),
+                jnp.asarray([seed], jnp.int32),
+                jnp.zeros((1,), jnp.int32))
+            return new_pools, tok[0]
+
+        return self._jit(fn, n_extra=5)
+
+    def _build_decode(self):
+        """(params, pools, tokens [S], pos [S], tables [S, MB], temps [S],
+        seeds [S], gen_idx [S]) -> (pools, next_tokens [S]). One fixed
+        shape for any mix of live/retired lanes."""
+        from hetu_galvatron_tpu.models.generate import _embed_at
+
+        cfg = self.cfg
+        S = self.S
+        bs = self.kv.block_size
+
+        def fn(params, pools, tokens, pos, tables, temps, seeds, gen_idx):
+            # per-lane positions: the offline decode-step embedding with a
+            # zero shift vector (scheduler admission guarantees pos stays
+            # inside max_position_embeddings; parked lanes sit at 0)
+            x = _embed_at(params["embed"], tokens, pos, cfg,
+                          self.compute_dtype, shift=jnp.zeros_like(pos))
+            rope = None
+            if self._rope is not None:
+                rope = (self._rope[0][pos][:, None],
+                        self._rope[1][pos][:, None])
+            blks = tables[jnp.arange(S), pos // bs]
+            offs = pos % bs
+
+            def sdpa_for(i, new_pools, cell):
+                def sdpa(q, k, v, *, causal=True):
+                    pk = scatter_token(new_pools[i]["k"], k[:, 0], blks, offs)
+                    pv = scatter_token(new_pools[i]["v"], v[:, 0], blks, offs)
+                    cell["k"], cell["v"] = pk, pv
+                    ck = gather_pages(pk, tables)
+                    cv = gather_pages(pv, tables)
+                    return paged_sdpa(q, ck, cv, pos)
+
+                return sdpa
+
+            new_pools, logits = self._layer_stack(params, pools, x, rope,
+                                                  sdpa_for)
+            toks = self._sample(logits[:, 0], temps, seeds, gen_idx)
+            return new_pools, toks
+
+        return self._jit(fn, n_extra=6)
+
+    def compile_count(self) -> int:
+        """Total compiled-program count across decode + prefill buckets
+        (tests pin this flat across steady state)."""
+        fns = [self._decode_fn] + list(self._prefill_fns.values())
+        return sum(f._cache_size() for f in fns)
+
+    def warmup(self, buckets: Optional[List[int]] = None) -> None:
+        """Pre-compile the decode program and the given prefill buckets
+        (defaults to every power-of-two bucket up to the pool's
+        per-sequence capacity). Dummy runs write only the scratch block,
+        so a warm engine is still empty."""
+        if buckets is None:
+            buckets = default_buckets(self.kv.block_size, self._table_len)
+        for b in buckets:
+            fn = self._prefill_for(b)
+            table = np.zeros((b // self.kv.block_size,), np.int32)
+            new_pools, tok = fn(self.params, self.kv.pools,
+                                jnp.zeros((1, b), jnp.int32),
+                                jnp.asarray(table), 1, 0.0, 0)
+            self.kv.pools = new_pools
+            jax.block_until_ready(tok)
+        toks = self._run_decode(self.scheduler.decode_state())
+        del toks
+
+    # -- the serving loop ---------------------------------------------------
+
+    def submit(
+        self,
+        tokens: List[int],
+        *,
+        max_new_tokens: Optional[int] = None,
+        temperature: Optional[float] = None,
+        eos_id: Optional[int] = "default",
+        seed: int = 0,
+        timeout_s: Optional[float] = None,
+    ) -> RequestHandle:
+        s = self.serving
+        req = Request(
+            tokens=[int(t) for t in tokens],
+            max_new_tokens=int(max_new_tokens if max_new_tokens is not None
+                               else s.max_new_tokens),
+            temperature=float(temperature if temperature is not None
+                              else s.temperature),
+            eos_id=s.eos_id if eos_id == "default" else eos_id,
+            seed=int(seed),
+            timeout_s=float(timeout_s if timeout_s is not None
+                            else s.request_timeout_s),
+        )
+        with self._lock:
+            self.registry.counter("serve/requests_submitted").inc()
+            if self.error is not None:
+                # dead engine thread: resolve immediately rather than
+                # queueing work nothing will ever step
+                handle = RequestHandle(req)
+                handle._finish("error", f"engine error: {self.error}")
+                self.registry.counter("serve/requests_rejected").inc()
+                return handle
+            handle = self.scheduler.submit(req)
+            if handle.status == "rejected":
+                self.registry.counter("serve/requests_rejected").inc()
+            return handle
+
+    def step(self) -> bool:
+        """One engine iteration: sweep retirements, admit + prefill, one
+        decode step. Returns whether any work happened."""
+        with self._lock:
+            did = self._sweep() > 0
+            admitted = self.scheduler.admit()
+            for slot, bucket in admitted:
+                self._prefill_slot(slot, bucket)
+                did = True
+            if self.scheduler.slots:
+                self._decode_active()
+                did = True
+            if did:
+                # idle iterations advance nothing: a parked background
+                # engine must not flush duplicate snapshots forever
+                self._steps += 1
+                self._telemetry_step()
+        return did
+
+    def run_until_idle(self, max_steps: int = 1_000_000) -> None:
+        for _ in range(max_steps):
+            if not self.scheduler.has_work():
+                break
+            self.step()
+        self.flush()
+
+    def start(self) -> None:
+        """Background serving thread (idle-spins gently when no work). A
+        step that raises aborts every in-flight and queued request with
+        status "error" — handles must never block forever on a dead
+        engine thread."""
+        if self._thread is not None:
+            return
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.is_set():
+                try:
+                    did = self.step()
+                except Exception as e:  # noqa: BLE001 — must resolve handles
+                    self._abort(e)
+                    return
+                if not did:
+                    time.sleep(0.001)
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="serving-engine")
+        self._thread.start()
+
+    def _abort(self, exc: BaseException) -> None:
+        """Resolve every outstanding handle after a fatal engine error."""
+        self.error = exc
+        with self._lock:
+            self.registry.counter("serve/engine_errors").inc()
+            for slot in list(self.scheduler.slots.values()):
+                self.scheduler.retire(slot, "error", f"engine error: {exc}")
+            for h in self.scheduler.waiting:
+                h._finish("error", f"engine error: {exc}")
+            self.scheduler.waiting = []
+
+    def stop(self) -> None:
+        if self._thread is None:
+            return
+        self._stop.set()
+        self._thread.join()
+        self._thread = None
+        self.flush()
+
+    # -- internals ----------------------------------------------------------
+
+    def _sweep(self) -> int:
+        """Retire cancelled/expired work — active slots AND still-queued
+        requests (both count toward the cancel/timeout metrics, so
+        submitted == completed + rejected + cancelled + timeout)."""
+        now = time.monotonic()
+        sc, st = self.scheduler.sweep(now)
+        wc, wt = self.scheduler.sweep_waiting(now)
+        if sc + wc:
+            self.registry.counter("serve/requests_cancelled").inc(sc + wc)
+        if st + wt:
+            self.registry.counter("serve/requests_timeout").inc(st + wt)
+        return sc + st + wc + wt
+
+    def _prefill_for(self, bucket: int) -> Callable:
+        fn = self._prefill_fns.get(bucket)
+        if fn is None:
+            fn = self._build_prefill(bucket)
+            self._prefill_fns[bucket] = fn
+        return fn
+
+    def _prefill_slot(self, slot: Slot, bucket: int) -> None:
+        req = slot.request
+        prompt_len = len(req.tokens)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :prompt_len] = req.tokens
+        table = np.asarray(slot.blocks[: bucket // self.kv.block_size],
+                           np.int32)
+        fn = self._prefill_for(bucket)
+        new_pools, tok = fn(self.params, self.kv.pools, jnp.asarray(padded),
+                            jnp.asarray(table), prompt_len,
+                            float(req.temperature), int(req.seed))
+        self.kv.pools = new_pools
+        tok = int(np.asarray(tok))
+        self.registry.counter("serve/prefill_tokens").inc(prompt_len)
+        self._emit(slot, tok, first=True)
+
+    def _run_decode(self, state) -> np.ndarray:
+        new_pools, toks = self._decode_fn(
+            self.params, self.kv.pools,
+            jnp.asarray(state["tokens"], jnp.int32),
+            jnp.asarray(state["pos"], jnp.int32),
+            jnp.asarray(state["tables"], jnp.int32),
+            jnp.asarray(state["temps"], jnp.float32),
+            jnp.asarray(state["seeds"], jnp.int32),
+            jnp.asarray(state["gen_idx"], jnp.int32))
+        self.kv.pools = new_pools
+        return np.asarray(toks)
+
+    def _decode_active(self) -> None:
+        state = self.scheduler.decode_state()
+        toks = self._run_decode(state)
+        for slot in list(self.scheduler.slots.values()):
+            slot.pos += 1
+            self._emit(slot, int(toks[slot.index]))
+        self.registry.counter("serve/decode_tokens").inc(
+            sum(state["active"]))
+
+    def _emit(self, slot: Slot, tok: int, first: bool = False) -> None:
+        """Record one generated token: stream it, time it, retire on
+        EOS / length budget."""
+        req = slot.request
+        now = time.monotonic()
+        slot.generated += 1
+        slot.last_token = tok
+        if first:
+            self.registry.histogram("serve/ttft_ms").observe(
+                (now - slot.handle.submitted_t) * 1000.0)
+        else:
+            self.registry.histogram("serve/itl_ms").observe(
+                (now - slot.last_token_t) * 1000.0)
+        slot.last_token_t = now
+        slot.handle._emit(tok)
+        self._emitted_total += 1
+        if req.eos_id is not None and tok == req.eos_id:
+            self.scheduler.retire(slot, "done", "eos")
+            self.registry.counter("serve/requests_completed").inc()
+        elif slot.generated >= req.max_new_tokens:
+            self.scheduler.retire(slot, "done", "length")
+            self.registry.counter("serve/requests_completed").inc()
+
+    # -- telemetry ----------------------------------------------------------
+
+    def _telemetry_step(self) -> None:
+        reg = self.registry
+        reg.counter("serve/steps").inc()
+        now = time.monotonic()
+        self._emitted_window.append((now, self._emitted_total))
+        if len(self._emitted_window) > 64:
+            self._emitted_window = self._emitted_window[-64:]
+        if self._steps % max(self.serving.flush_interval, 1) == 0:
+            self.flush()
+
+    def tokens_per_sec(self) -> float:
+        w = self._emitted_window
+        if len(w) < 2 or w[-1][0] <= w[0][0]:
+            return 0.0
+        return (w[-1][1] - w[0][1]) / (w[-1][0] - w[0][0])
+
+    def flush(self) -> None:
+        reg = self.registry
+        reg.gauge("serve/queue_depth").set(self.scheduler.queue_depth)
+        reg.gauge("serve/active_requests").set(len(self.scheduler.slots))
+        reg.gauge("serve/kv_occupancy").set(self.kv.occupancy)
+        reg.gauge("serve/kv_blocks_used").set(self.kv.allocator.used)
+        reg.gauge("serve/tokens_per_sec").set(self.tokens_per_sec())
+        reg.gauge("serve/jit_programs").set(self.compile_count())
+        reg.flush(step=self._steps)
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self.stop()
+        self.flush()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -1,0 +1,275 @@
+"""Paged KV cache: fixed-size blocks in a preallocated pool + block tables.
+
+The offline decode path (``models/generate.py``) allocates a dense
+``[batch, max_len]`` cache per call — fine for one fixed batch, hopeless for
+serving, where sequences of wildly different lengths come and go: a dense
+cache sized for the longest request wastes HBM proportional to the spread,
+and admitting a new request would reshape (recompile) the program.
+
+This module keeps ONE preallocated pool per layer, carved into fixed-size
+blocks (the PagedAttention layout), with a per-sequence *block table* mapping
+logical positions to pool blocks:
+
+    pool[layer]["k"] : [num_blocks, block_size, kv_heads, head_dim]
+    table[seq]       : [max_blocks_per_seq] int32 block ids
+
+Alloc/free is host-side free-list bookkeeping (:class:`BlockAllocator`);
+reads/writes are jax gather/scatter (:func:`scatter_prefill`,
+:func:`scatter_token`, :func:`gather_pages`) so the whole decode step jits
+once and never reshapes. Block 0 is a reserved scratch block: retired slots
+point their writes at it, keeping the batch shape fixed without conditional
+control flow.
+
+GQA-aware: blocks store ``cfg.kv_heads`` heads (not query heads), so a
+GQA model's pool is ``num_attention_heads / kv_heads`` times smaller.
+
+Sharding: the kv-head axis of every block carries the SAME mesh axes
+``runtime/mesh.py`` assigns to that layer's attention weights (the layer's
+tp axes — or replication under Ulysses, whose "tp" axes carry sequence, not
+heads), so plan-sharded params and the cache agree without resharding at
+the attention boundary. See :func:`pool_pspecs`.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from hetu_galvatron_tpu.core.args_schema import ModelArgs
+
+# block 0 is never allocated: retired slots write into it so the decode
+# batch keeps a fixed shape with no per-slot control flow
+SCRATCH_BLOCK = 0
+
+Pools = List[Dict[str, jax.Array]]
+
+
+class BlockAllocator:
+    """Host-side free-list allocator over the pool's block ids.
+
+    Blocks are position-independent (the table indirection absorbs any
+    ordering), so there is no fragmentation in the contiguous-memory sense;
+    :meth:`defrag_plan` exists to compact live blocks to the low indices
+    (pool-shrink / snapshot use cases), not to satisfy allocations.
+    """
+
+    def __init__(self, num_blocks: int):
+        if num_blocks < 2:
+            raise ValueError(f"need >= 2 blocks (one is scratch), got "
+                             f"{num_blocks}")
+        self.num_blocks = num_blocks
+        # LIFO free list: recycled blocks are reused first (warm pages)
+        self._free = list(range(num_blocks - 1, SCRATCH_BLOCK, -1))
+
+    @property
+    def available(self) -> int:
+        return len(self._free)
+
+    @property
+    def used(self) -> int:
+        return (self.num_blocks - 1) - len(self._free)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """n blocks, or None when the pool cannot satisfy the request
+        (caller keeps the sequence queued — never a partial grant)."""
+        if n < 0:
+            raise ValueError(f"alloc({n})")
+        if n > len(self._free):
+            return None
+        out = [self._free.pop() for _ in range(n)]
+        return out
+
+    def free(self, blocks: Sequence[int]) -> None:
+        for b in blocks:
+            if not (SCRATCH_BLOCK < b < self.num_blocks):
+                raise ValueError(f"free() of invalid block id {b}")
+            if b in self._free:
+                raise ValueError(f"double free of block {b}")
+            self._free.append(b)
+
+    def defrag_plan(self, tables: Sequence[Sequence[int]]
+                    ) -> Tuple[List[int], List[List[int]]]:
+        """Compaction plan: live blocks (every id referenced by ``tables``)
+        move to ids 1..n_live, preserving first-reference order. Returns
+        ``(perm, new_tables)`` where ``perm[new_id] = old_id`` is the pool
+        gather order (length num_blocks; scratch stays at 0) and
+        ``new_tables`` mirror ``tables`` under the renaming. The caller
+        applies ``perm`` to the pool arrays (:meth:`PagedKVCache.defrag`)
+        and adopts the new tables; the free list is rebuilt as the tail."""
+        remap: Dict[int, int] = {SCRATCH_BLOCK: SCRATCH_BLOCK}
+        for table in tables:
+            for b in table:
+                if b not in remap:
+                    remap[b] = len(remap)
+        n_live = len(remap) - 1
+        if n_live != self.used:
+            raise ValueError(
+                f"tables reference {n_live} blocks but allocator has "
+                f"{self.used} outstanding — tables and allocator disagree")
+        perm = [SCRATCH_BLOCK] * self.num_blocks
+        for old, new in remap.items():
+            perm[new] = old
+        # unreferenced (free) blocks fill the tail in id order
+        tail = [b for b in range(1, self.num_blocks) if b not in remap]
+        for i, old in enumerate(tail):
+            perm[n_live + 1 + i] = old
+        new_tables = [[remap[b] for b in t] for t in tables]
+        self._free = list(range(self.num_blocks - 1, n_live, -1))
+        return perm, new_tables
+
+
+# ---------------------------------------------------------------------------
+# pure pool ops (jit-friendly)
+# ---------------------------------------------------------------------------
+
+
+def scatter_prefill(pool: jax.Array, kv: jax.Array,
+                    table: jax.Array) -> jax.Array:
+    """Write a prefilled [S, K, D] k-or-v run into its blocks. ``S`` must be
+    a multiple of block_size (prefill buckets are); ``table`` holds the
+    S/block_size destination block ids."""
+    bs = pool.shape[1]
+    nb = kv.shape[0] // bs
+    return pool.at[table].set(
+        kv.reshape(nb, bs, *kv.shape[1:]).astype(pool.dtype))
+
+
+def scatter_token(pool: jax.Array, kv: jax.Array, blocks: jax.Array,
+                  offsets: jax.Array) -> jax.Array:
+    """Write one decode-step token per slot: kv [S, K, D] lands at
+    (blocks[s], offsets[s]). Retired slots alias the scratch block —
+    colliding scratch writes are unordered but never read."""
+    return pool.at[blocks, offsets].set(kv.astype(pool.dtype))
+
+
+def gather_pages(pool: jax.Array, tables: jax.Array) -> jax.Array:
+    """Assemble each slot's logical cache: tables [S, MB] -> [S, MB*bs, K, D]
+    (positions past the slot's length are garbage; the attention mask in
+    :func:`paged_sdpa` hides them)."""
+    S, MB = tables.shape
+    bs = pool.shape[1]
+    pages = pool[tables]  # [S, MB, bs, K, D]
+    return pages.reshape(S, MB * bs, *pool.shape[2:])
+
+
+def paged_sdpa(q: jax.Array, ck: jax.Array, cv: jax.Array,
+               pos: jax.Array) -> jax.Array:
+    """Per-slot cached attention: q [S,1,Nq,D] against assembled pages
+    [S,T,K,D]; key positions > pos[s] are masked. Delegates to the ONE
+    dense-cache attention implementation
+    (``models/generate._cached_sdpa``, which accepts per-row positions),
+    so a paged decode reproduces the offline decode bit-for-bit on the
+    live positions — by construction, not by parallel maintenance."""
+    from hetu_galvatron_tpu.models.generate import _cached_sdpa
+
+    return _cached_sdpa(q, ck, cv, pos)
+
+
+# module-level so repeated defrag() calls hit the jit cache instead of
+# recompiling the gather every time
+_permute_pools = jax.jit(
+    lambda pools, idx: jax.tree.map(lambda a: a[idx], pools))
+
+
+def pool_pspecs(layer_shardings: Optional[Sequence[Any]],
+                num_layers: int, kv_heads: int) -> List[P]:
+    """Per-layer PartitionSpec for [num_blocks, block_size, kv_heads,
+    head_dim] pool arrays: kv heads ride the layer's tp axes exactly like
+    the attention weights (``runtime/mesh.py`` qkv logical axis), replicated
+    under Ulysses (whose tp axes carry sequence) or when tp does not divide
+    the kv-head count (kv heads replicate, reference GQA grouping)."""
+    if layer_shardings is None:
+        return [P(None, None, None, None)] * num_layers
+    specs = []
+    for sh in layer_shardings:
+        axes = () if sh.ulysses else sh.tp_axes
+        tp = 1
+        for a in axes:
+            tp *= 2  # binary mesh axes
+        if not axes or kv_heads % tp:
+            specs.append(P(None, None, None, None))
+        else:
+            specs.append(P(None, None, axes, None))
+    return specs
+
+
+class PagedKVCache:
+    """The pool + allocator pair one engine owns.
+
+    ``pools`` is a per-layer list of ``{"k", "v"}`` arrays that flows
+    through the jitted prefill/decode programs (donated and replaced each
+    call); the allocator and block tables stay host-side.
+    """
+
+    def __init__(
+        self,
+        cfg: ModelArgs,
+        *,
+        num_blocks: int,
+        block_size: int,
+        max_seq_len: int,
+        dtype=jnp.bfloat16,
+        mesh: Optional[Mesh] = None,
+        layer_shardings: Optional[Sequence[Any]] = None,
+    ):
+        if block_size < 1:
+            raise ValueError(f"block_size {block_size}")
+        self.cfg = cfg
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        self.max_seq_len = int(max_seq_len)
+        self.max_blocks_per_seq = max(
+            math.ceil(self.max_seq_len / self.block_size), 1)
+        self.dtype = dtype
+        self.mesh = mesh
+        self.allocator = BlockAllocator(self.num_blocks)
+        L = cfg.num_hidden_layers
+        shape = (self.num_blocks, self.block_size, cfg.kv_heads, cfg.head_dim)
+        self.pspecs = pool_pspecs(layer_shardings, L, cfg.kv_heads)
+        self.pools: Pools = []
+        for i in range(L):
+            k = jnp.zeros(shape, dtype)
+            v = jnp.zeros(shape, dtype)
+            if mesh is not None:
+                shd = NamedSharding(mesh, self.pspecs[i])
+                k = jax.device_put(k, shd)
+                v = jax.device_put(v, shd)
+            self.pools.append({"k": k, "v": v})
+
+    # -- sizing -------------------------------------------------------------
+
+    def blocks_for(self, total_tokens: int) -> int:
+        """Blocks a sequence of ``total_tokens`` (prompt + generation
+        budget) needs."""
+        return max(math.ceil(total_tokens / self.block_size), 1)
+
+    def fits(self, total_tokens: int) -> bool:
+        """Whether a sequence of this total length can EVER be served
+        (table capacity), regardless of current occupancy."""
+        return (total_tokens <= self.max_seq_len
+                and self.blocks_for(total_tokens) <= self.max_blocks_per_seq)
+
+    @property
+    def occupancy(self) -> float:
+        """Fraction of allocatable blocks currently held."""
+        cap = self.num_blocks - 1
+        return self.allocator.used / cap if cap else 0.0
+
+    def bytes_per_block(self) -> int:
+        elt = jnp.dtype(self.dtype).itemsize
+        return (2 * self.cfg.num_hidden_layers * self.block_size
+                * self.cfg.kv_heads * self.cfg.head_dim * elt)
+
+    # -- maintenance --------------------------------------------------------
+
+    def defrag(self, tables: Sequence[Sequence[int]]) -> List[List[int]]:
+        """Compact live blocks to the low pool indices: permutes the pool
+        arrays (one jitted gather) and returns the renamed tables. Contents
+        seen through the tables are unchanged."""
+        perm, new_tables = self.allocator.defrag_plan(tables)
+        self.pools = _permute_pools(self.pools, jnp.asarray(perm, jnp.int32))
+        return new_tables

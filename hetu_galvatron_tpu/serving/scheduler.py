@@ -1,0 +1,357 @@
+"""Continuous-batching scheduler: admission, slots, retirement, recycling.
+
+The serving engine runs two jitted programs — bucketed prefill and a
+fixed-shape decode step — and this module decides what feeds them:
+
+* **Admission** is token-budget based. A request is admitted when (1) a
+  decode slot is free, (2) the paged KV pool can hold its whole budget
+  (prompt + ``max_new_tokens`` — allocated up front so a running sequence
+  can never OOM the pool mid-decode), and (3) the step's prefill budget
+  has room. The budget is expressed in FLOPs via the cost model's
+  per-token accounting (``core/cost_model/cost.py model_flops_per_token``,
+  forward-only), so "how much prefill can ride one engine step without
+  starving decode" is the same arithmetic the search engine trusts.
+  Requests that can NEVER be served (longer than the pool / the model's
+  positions) are rejected immediately, not queued forever.
+* **Slots** are fixed: ``max_batch_size`` sequences decode together at one
+  jitted shape. Retired slots park on the scratch block and recycle on the
+  next admission — no recompiles in steady state.
+* **Retirement** is per-sequence: EOS, length budget, cancellation, or
+  timeout. Freed blocks return to the allocator LIFO.
+
+Prompt lengths are bucketed to ``block_size * 2^k`` so the set of prefill
+programs is logarithmic in the max prompt length.
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from hetu_galvatron_tpu.serving.kv_cache import PagedKVCache, SCRATCH_BLOCK
+
+_req_counter = itertools.count()
+
+# terminal states a handle can land in
+FINISHED = ("done", "cancelled", "timeout", "rejected", "error")
+
+
+@dataclass
+class Request:
+    """One generation request. ``seed`` drives the per-request sampling
+    stream (folded with the emitted-token index), so a request's tokens do
+    not depend on which neighbors share its batch."""
+
+    tokens: List[int]
+    max_new_tokens: int = 64
+    temperature: float = 0.0
+    eos_id: Optional[int] = None
+    seed: int = 0
+    timeout_s: float = 0.0  # 0 = no deadline
+    rid: int = field(default_factory=lambda: next(_req_counter))
+
+
+class RequestHandle:
+    """Caller-facing stream for one request.
+
+    ``tokens()`` yields generated ids as they are produced (blocking
+    iterator, ends at retirement); ``result()`` waits for completion and
+    returns the full list; ``cancel()`` asks the engine to retire the
+    request at the next step boundary.
+    """
+
+    _SENTINEL = object()
+
+    def __init__(self, request: Request):
+        self.request = request
+        self.status = "queued"
+        self.finish_reason: Optional[str] = None
+        self.output: List[int] = []
+        self.submitted_t = time.monotonic()
+        self.first_token_t: Optional[float] = None
+        self.finished_t: Optional[float] = None
+        self._q: "queue.Queue[Any]" = queue.Queue()
+        self._done = threading.Event()
+        self._cancel = False
+
+    # -- engine side --------------------------------------------------------
+
+    def _emit(self, token: int) -> None:
+        now = time.monotonic()
+        if self.first_token_t is None:
+            self.first_token_t = now
+        self.output.append(int(token))
+        self._q.put(int(token))
+
+    def _finish(self, status: str, reason: str) -> None:
+        self.status = status
+        self.finish_reason = reason
+        self.finished_t = time.monotonic()
+        self._q.put(self._SENTINEL)
+        self._done.set()
+
+    # -- caller side --------------------------------------------------------
+
+    @property
+    def cancelled(self) -> bool:
+        return self._cancel
+
+    def cancel(self) -> None:
+        self._cancel = True
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def tokens(self):
+        """Blocking per-token stream; terminates when the request retires.
+        Safe to call again after the stream drained (returns immediately
+        instead of blocking on the already-consumed sentinel)."""
+        while True:
+            try:
+                item = self._q.get(timeout=0.1)
+            except queue.Empty:
+                if self._done.is_set():
+                    return
+                continue
+            if item is self._SENTINEL:
+                return
+            yield item
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        if not self._done.wait(timeout):
+            raise TimeoutError(f"request {self.request.rid} still running")
+        return list(self.output)
+
+    def ttft_s(self) -> Optional[float]:
+        if self.first_token_t is None:
+            return None
+        return self.first_token_t - self.submitted_t
+
+
+@dataclass
+class Slot:
+    """One decode lane: the sequence occupying it plus its paged-cache
+    view. ``pos`` is the context length (tokens already in the cache);
+    ``last_token`` is the next decode step's input."""
+
+    index: int
+    handle: RequestHandle
+    blocks: List[int]
+    pos: int
+    last_token: int
+    generated: int = 0
+    last_token_t: float = 0.0
+
+    @property
+    def request(self) -> Request:
+        return self.handle.request
+
+
+def bucket_length(prompt_len: int, block_size: int,
+                  cap_tokens: int) -> int:
+    """Smallest ``block_size * 2^k`` >= prompt_len (capped at the pool's
+    per-sequence table capacity ``cap_tokens``): prefill programs exist per
+    bucket, not per length, so steady-state traffic stops compiling once
+    the buckets are warm."""
+    b = block_size
+    while b < prompt_len and b < cap_tokens:
+        b *= 2
+    return min(b, cap_tokens)
+
+
+class Scheduler:
+    """Queue + slots + allocator choreography (host-side, no jax)."""
+
+    def __init__(
+        self,
+        kv: PagedKVCache,
+        *,
+        max_slots: int,
+        max_position_embeddings: int,
+        prefill_flops_budget: float = 0.0,
+        flops_per_token: float = 0.0,
+        max_prefill_tokens: int = 0,
+    ):
+        self.kv = kv
+        self.max_slots = int(max_slots)
+        self.max_positions = int(max_position_embeddings)
+        # per-step prefill token budget: the tighter of the explicit token
+        # cap and the FLOPs budget / cost-model per-token FLOPs
+        caps = []
+        if max_prefill_tokens > 0:
+            caps.append(max_prefill_tokens)
+        if prefill_flops_budget > 0 and flops_per_token > 0:
+            caps.append(max(int(prefill_flops_budget // flops_per_token), 1))
+        self.prefill_token_cap = min(caps) if caps else 0  # 0 = unlimited
+        self.waiting: List[RequestHandle] = []
+        self.slots: Dict[int, Slot] = {}
+        self._free_slots = list(range(self.max_slots - 1, -1, -1))
+        self.rejected = 0
+        self.completed = 0
+
+    # -- intake -------------------------------------------------------------
+
+    def _blocks_needed(self, prompt_len: int, max_new: int) -> int:
+        """Blocks a request holds while running: its total-length need or
+        its prefill bucket, whichever is larger (the bucket may overshoot
+        ceil(total/bs) for prompts just past a power-of-two boundary)."""
+        bucket = bucket_length(
+            prompt_len, self.kv.block_size,
+            self.kv.max_blocks_per_seq * self.kv.block_size)
+        return max(self.kv.blocks_for(prompt_len + max_new),
+                   bucket // self.kv.block_size)
+
+    def submit(self, request: Request) -> RequestHandle:
+        handle = RequestHandle(request)
+        total = len(request.tokens) + request.max_new_tokens
+        if (not request.tokens or request.max_new_tokens < 1
+                or not self.kv.fits(total)
+                or total > self.max_positions
+                # can NEVER be satisfied even by an empty pool -> reject
+                # now instead of queueing forever
+                or (self._blocks_needed(len(request.tokens),
+                                        request.max_new_tokens)
+                    > self.kv.num_blocks - 1)):
+            self.rejected += 1
+            handle._finish("rejected", "capacity")
+            return handle
+        handle.status = "queued"
+        self.waiting.append(handle)
+        return handle
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self.waiting)
+
+    @property
+    def active(self) -> List[Slot]:
+        return [self.slots[i] for i in sorted(self.slots)]
+
+    def has_work(self) -> bool:
+        return bool(self.waiting or self.slots)
+
+    # -- admission ----------------------------------------------------------
+
+    def admit(self) -> List[Tuple[Slot, int]]:
+        """Admit waiting requests into free slots under the block + prefill
+        budget. Returns ``(slot, bucket_len)`` pairs the engine must
+        prefill this step. At least one request is admitted per call when a
+        slot and blocks are available, even if its bucket exceeds the
+        prefill cap — a cap below the smallest bucket must not deadlock."""
+        self._drop_cancelled_waiting()
+        admitted: List[Tuple[Slot, int]] = []
+        budget_used = 0
+        while self.waiting and self._free_slots:
+            handle = self.waiting[0]
+            req = handle.request
+            prompt_len = len(req.tokens)
+            bucket = bucket_length(
+                prompt_len, self.kv.block_size,
+                self.kv.max_blocks_per_seq * self.kv.block_size)
+            if self.prefill_token_cap and admitted and (
+                    budget_used + bucket > self.prefill_token_cap):
+                break
+            n_blocks = self._blocks_needed(prompt_len, req.max_new_tokens)
+            blocks = self.kv.allocator.alloc(n_blocks)
+            if blocks is None:
+                break  # pool full; FIFO order preserved
+            self.waiting.pop(0)
+            idx = self._free_slots.pop()
+            slot = Slot(index=idx, handle=handle, blocks=blocks,
+                        pos=prompt_len, last_token=req.tokens[-1],
+                        last_token_t=time.monotonic())
+            handle.status = "running"
+            self.slots[idx] = slot
+            admitted.append((slot, bucket))
+            budget_used += bucket
+        return admitted
+
+    def _drop_cancelled_waiting(self) -> None:
+        self.sweep_waiting()
+
+    def sweep_waiting(self, now: Optional[float] = None
+                      ) -> Tuple[int, int]:
+        """Resolve cancelled and deadline-expired requests still in the
+        queue (a request whose timeout lapsed while queued must not be
+        admitted, prefilled, and only then retired — that wastes device
+        work and pollutes the TTFT histogram). Returns
+        ``(n_cancelled, n_timeout)``."""
+        now = time.monotonic() if now is None else now
+        n_cancel = n_timeout = 0
+        still = []
+        for h in self.waiting:
+            if h.cancelled:
+                h._finish("cancelled", "cancelled")
+                n_cancel += 1
+            elif (h.request.timeout_s > 0
+                  and now - h.submitted_t > h.request.timeout_s):
+                h._finish("timeout", "timeout")
+                n_timeout += 1
+            else:
+                still.append(h)
+        self.waiting = still
+        return n_cancel, n_timeout
+
+    # -- retirement ---------------------------------------------------------
+
+    def retire(self, slot: Slot, status: str, reason: str) -> None:
+        """Free the slot's blocks, recycle the lane, resolve the handle."""
+        self.kv.allocator.free(slot.blocks)
+        del self.slots[slot.index]
+        self._free_slots.append(slot.index)
+        if status == "done":
+            self.completed += 1
+        slot.handle._finish(status, reason)
+
+    def sweep(self, now: Optional[float] = None) -> Tuple[int, int]:
+        """Retire cancelled / deadline-expired active sequences; returns
+        ``(n_cancelled, n_timeout)`` — the single home of the retirement
+        predicate (the engine's metric split reads these counts rather
+        than re-deriving them)."""
+        now = time.monotonic() if now is None else now
+        n_cancel = n_timeout = 0
+        for slot in list(self.slots.values()):
+            h = slot.handle
+            if h.cancelled:
+                self.retire(slot, "cancelled", "cancelled")
+                n_cancel += 1
+            elif (h.request.timeout_s > 0
+                  and now - h.submitted_t > h.request.timeout_s):
+                self.retire(slot, "timeout", "timeout")
+                n_timeout += 1
+        return n_cancel, n_timeout
+
+    # -- decode batch view --------------------------------------------------
+
+    def padded_table(self, blocks: Sequence[int]) -> List[int]:
+        t = list(blocks)[: self.kv.max_blocks_per_seq]
+        return t + [SCRATCH_BLOCK] * (self.kv.max_blocks_per_seq - len(t))
+
+    def decode_state(self) -> Dict[str, List]:
+        """Fixed-shape per-lane arrays for the decode program. Inactive
+        lanes feed token 0 at position 0 against the scratch block; their
+        outputs are discarded host-side."""
+        S, MB = self.max_slots, self.kv.max_blocks_per_seq
+        state = {
+            "tokens": [0] * S,
+            "pos": [0] * S,
+            "tables": [[SCRATCH_BLOCK] * MB for _ in range(S)],
+            "temps": [0.0] * S,
+            "seeds": [0] * S,
+            "gen_idx": [0] * S,
+            "active": [False] * S,
+        }
+        for i, slot in self.slots.items():
+            req = slot.request
+            state["tokens"][i] = slot.last_token
+            state["pos"][i] = slot.pos
+            state["tables"][i] = self.padded_table(slot.blocks)
+            state["temps"][i] = float(req.temperature)
+            state["seeds"][i] = int(req.seed)
+            state["gen_idx"][i] = slot.generated
+            state["active"][i] = True
+        return state

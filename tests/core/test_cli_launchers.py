@@ -260,3 +260,38 @@ def test_generate_cli_smoke_and_ckpt(tmp_path, capsys):
                                "temperature=0.5", "top_k=5"])
     assert rc == 0
     assert capsys.readouterr().out.startswith("abc")
+
+
+def test_serve_cli_smoke(tmp_path, capsys):
+    """Serving CLI: JSONL request stream -> per-request token streams on
+    the auto-TP submesh, metrics JSONL written and summarizable."""
+    from hetu_galvatron_tpu.cli.serve import main as serve_main
+    from hetu_galvatron_tpu.cli.summarize import summarize
+
+    reqs = [
+        {"prompt": "hello world", "max_new_tokens": 3},
+        {"prompt": "abc", "max_new_tokens": 4, "temperature": 0.8,
+         "seed": 3},
+    ]
+    rp = tmp_path / "reqs.jsonl"
+    rp.write_text("".join(json.dumps(r) + "\n" for r in reqs))
+    mp = tmp_path / "metrics.jsonl"
+    rc = serve_main([
+        os.path.join(ZOO, "gpt2-small.yaml"),
+        "model.hidden_size=32", "model.num_hidden_layers=2",
+        "model.num_attention_heads=4", "model.vocab_size=257",
+        "model.max_position_embeddings=64",
+        "model.make_vocab_size_divisible_by=1", "model.seq_length=64",
+        "serving.max_batch_size=2", "serving.kv_block_size=8",
+        "serving.max_seq_len=32",
+        f"requests={rp}", f"metrics={mp}"])
+    assert rc == 0
+    events = [json.loads(line) for line in
+              capsys.readouterr().out.strip().splitlines()]
+    done = {e["rid"]: e for e in events if e["event"] == "done"}
+    assert done[0]["n_tokens"] == 3 and done[1]["n_tokens"] == 4
+    assert all(e["status"] == "done" for e in done.values())
+    assert sum(1 for e in events if e["event"] == "token") == 7
+    headline = summarize(str(mp), out=__import__("io").StringIO())
+    assert headline["serve/requests_completed"] == 2
+    assert headline["ttft_p50_ms"] > 0

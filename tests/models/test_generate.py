@@ -39,7 +39,9 @@ def _naive_greedy(params, tokens, cfg, n, dtype=jnp.float32):
     dict(position_embedding_type="learned", normalization="layernorm",
          hidden_act="gelu", add_bias_linear=True, add_qkv_bias=True,
          tie_word_embeddings=True),  # gpt2-style
-], ids=["llama", "gqa", "gpt2"])
+    dict(scale_embeddings=True, norm_zero_centered=True,
+         num_key_value_heads=1, head_dim_override=24),  # gemma numerics
+], ids=["llama", "gqa", "gpt2", "gemma"])
 def test_cached_greedy_matches_naive(kw):
     cfg = _cfg(**kw)
     params, _ = init_causal_lm(jax.random.key(0), cfg)
@@ -88,6 +90,98 @@ def test_generate_never_samples_vocab_padding():
                                   key=jax.random.key(5),
                                   compute_dtype=jnp.float32))
         assert (out < 100).all(), out.max()
+
+
+@pytest.mark.parametrize("max_len", [12, 40])
+@pytest.mark.parametrize("kv_heads", [None, 2, 1], ids=["mha", "gqa2", "mqa"])
+def test_prefill_decode_logit_parity_vs_full_forward(max_len, kv_heads):
+    """The KV-cache decode chain reproduces the full-sequence forward's
+    next-token logits at every position, for varying cache max_len and
+    GQA head counts."""
+    from hetu_galvatron_tpu.models.generate import decode_step, prefill
+
+    cfg = _cfg(num_key_value_heads=kv_heads)
+    params, _ = init_causal_lm(jax.random.key(7), cfg)
+    rng = np.random.RandomState(7)
+    S0, n_steps = 4, 6
+    assert S0 + n_steps <= max_len
+    seq = jnp.asarray(rng.randint(0, 128, (2, S0 + n_steps)), jnp.int32)
+
+    cache, logits = prefill(params, seq[:, :S0], cfg, max_len,
+                            compute_dtype=jnp.float32)
+    rope = None
+    if cfg.position_embedding_type == "rope":
+        from hetu_galvatron_tpu.models import modules as M
+
+        rope = M.rope_cos_sin(max_len, cfg.head_dim, cfg.rope_theta,
+                              scaling=cfg.rope_scaling)
+    for t in range(n_steps):
+        full = forward_causal_lm(params, seq[:, :S0 + t], cfg,
+                                 compute_dtype=jnp.float32)
+        np.testing.assert_allclose(np.asarray(logits),
+                                   np.asarray(full[:, -1]),
+                                   rtol=2e-5, atol=2e-5)
+        cache, logits = decode_step(params, cache, seq[:, S0 + t],
+                                    jnp.int32(S0 + t), cfg, rope_full=rope,
+                                    compute_dtype=jnp.float32)
+
+
+@pytest.mark.parametrize("kw", [
+    dict(),  # rope + rmsnorm
+    dict(position_embedding_type="learned", normalization="layernorm",
+         hidden_act="gelu", add_bias_linear=True, add_qkv_bias=True),
+    dict(num_attention_heads=4, num_key_value_heads=2),  # GQA
+], ids=["rope", "learned", "gqa"])
+def test_generate_ragged_left_padded_batch(kw):
+    """Batched ragged prompts (LEFT-padded, ``prompt_lens``): every row
+    decodes exactly as it would alone — pad prefix masked from attention,
+    positions starting at the first real token."""
+    cfg = _cfg(**kw)
+    params, _ = init_causal_lm(jax.random.key(8), cfg)
+    rng = np.random.RandomState(8)
+    lens = [2, 9, 5]
+    S0 = max(lens)
+    padded = np.zeros((len(lens), S0), np.int32)
+    rows = []
+    for i, n in enumerate(lens):
+        rows.append(rng.randint(0, 128, (n,)))
+        padded[i, S0 - n:] = rows[-1]
+    out = np.asarray(generate(
+        params, jnp.asarray(padded), cfg, 8,
+        prompt_lens=jnp.asarray(lens, jnp.int32),
+        compute_dtype=jnp.float32))
+    for i, row in enumerate(rows):
+        want = np.asarray(generate(params, jnp.asarray(row[None], jnp.int32),
+                                   cfg, 8, compute_dtype=jnp.float32))
+        np.testing.assert_array_equal(out[i, S0:], want[0, len(row):])
+
+
+def test_generate_pad_id_masks_retired_rows():
+    """After a row's EOS the output carries pad_id (not live samples, not
+    eos repetition), so batched output is deterministic regardless of
+    neighbors — the contract the serving engine's retirement trims
+    against."""
+    cfg = _cfg()
+    params, _ = init_causal_lm(jax.random.key(1), cfg)
+    prompt = jnp.asarray(
+        np.random.RandomState(1).randint(0, 128, (1, 5)), jnp.int32)
+    # find an eos that actually fires mid-stream on the free-running chain
+    free = np.asarray(generate(params, prompt, cfg, 8,
+                               compute_dtype=jnp.float32))[0, 5:]
+    eos = int(free[2])
+    out = np.asarray(generate(params, prompt, cfg, 8, eos_id=eos, pad_id=0,
+                              compute_dtype=jnp.float32))[0, 5:]
+    stop = np.where(out == eos)[0][0]
+    assert (out[stop + 1:] == 0).all(), out
+    # the tokens up to (and incl.) eos match the free-running chain
+    np.testing.assert_array_equal(out[:stop + 1], free[:stop + 1])
+    # a retired row's padding must not disturb a live neighbor: decode the
+    # pair (one stops early, one runs free) and check the live row
+    pair = jnp.concatenate([prompt, prompt], axis=0)
+    both = np.asarray(generate(params, pair, cfg, 8, eos_id=eos, pad_id=0,
+                               compute_dtype=jnp.float32))
+    np.testing.assert_array_equal(both[0, 5:], out)
+    np.testing.assert_array_equal(both[1, 5:], out)
 
 
 def test_generate_rejects_unsupported():

@@ -302,6 +302,54 @@ def test_plan_comm_volume_formulas():
         vols[1]["dp_allreduce_mb"] + vols[1]["tp_collective_mb"])
 
 
+def test_emit_plan_telemetry_is_one_shot_event_not_gauges(tmp_path):
+    """The plan's per-layer comm constants ride the ONE-SHOT ``plan``
+    event; no plan/* gauges may be registered — gauges re-snapshot into
+    the sink on every flush, duplicating constant data ~4*layers records
+    per flush for the whole run (ROADMAP open item)."""
+    import json
+    from types import SimpleNamespace
+
+    from hetu_galvatron_tpu.observability.telemetry import (
+        emit_plan_telemetry,
+    )
+    from hetu_galvatron_tpu.utils.strategy import LayerStrategy
+
+    cfg = ModelArgs(hidden_size=64, num_hidden_layers=2,
+                    num_attention_heads=4, seq_length=32, vocab_size=128,
+                    make_vocab_size_divisible_by=1)
+    hpc = SimpleNamespace(
+        layers=[LayerStrategy(tp_size=2, dp_size=2)] * 2,
+        global_bsz=8, chunks=2, pp_deg=1)
+    path = tmp_path / "m.jsonl"
+    reg = MetricsRegistry([JsonlSink(str(path))])
+    emit_plan_telemetry(reg, hpc, cfg)
+    assert not any(m.name.startswith("plan/") for m in reg.metrics())
+    # the event carries the totals AND the per-layer breakdown
+    reg.flush(step=0)
+    reg.flush(step=1)
+    reg.close()
+    records = [json.loads(line) for line in open(path)]
+    plans = [r for r in records if r.get("name") == "plan"]
+    assert len(plans) == 1  # one-shot: repeated flushes add nothing
+    data = plans[0]["data"]
+    assert data["predicted_comm_mb_per_step"] > 0
+    assert len(data["layers"]) == 2
+    assert data["layers"][0]["layer"] == 0
+    assert data["layers"][0]["tp_collective_mb"] > 0
+    vols = plan_comm_volume(hpc.layers, cfg, global_bsz=8, chunks=2)
+    assert data["predicted_comm_mb_per_step"] == pytest.approx(
+        sum(v["total_mb"] for v in vols))
+    # summarize still renders the predicted total from the event
+    import io
+
+    from hetu_galvatron_tpu.cli.summarize import summarize
+
+    buf = io.StringIO()
+    summarize(str(path), out=buf)
+    assert "plan comm MB/step (predicted)" in buf.getvalue()
+
+
 # ---------------------------------------------------------------------------
 # no-sync + overhead contracts
 # ---------------------------------------------------------------------------

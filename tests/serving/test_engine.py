@@ -1,0 +1,332 @@
+"""Serving engine drills: continuous-batching parity vs offline generate,
+zero steady-state recompiles, EOS retirement, cancellation/timeouts,
+telemetry stream + summarize rendering."""
+
+import io
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hetu_galvatron_tpu.core.args_schema import (
+    CoreArgs,
+    ModelArgs,
+    ServingArgs,
+)
+from hetu_galvatron_tpu.models.builder import init_causal_lm
+from hetu_galvatron_tpu.models.generate import generate
+from hetu_galvatron_tpu.observability.registry import MetricsRegistry
+from hetu_galvatron_tpu.observability.sinks import JsonlSink
+from hetu_galvatron_tpu.serving.engine import ServingEngine
+
+pytestmark = pytest.mark.serving
+
+
+def _cfg(**kw):
+    base = dict(
+        hidden_size=64, num_hidden_layers=2, num_attention_heads=4,
+        vocab_size=128, max_position_embeddings=128, seq_length=32,
+        hidden_act="swiglu", normalization="rmsnorm",
+        position_embedding_type="rope", tie_word_embeddings=False,
+        add_bias_linear=False, add_qkv_bias=False,
+        make_vocab_size_divisible_by=1, ffn_hidden_size=128)
+    base.update(kw)
+    return ModelArgs(**base)
+
+
+def _offline(params, cfg, prompt, n_new, eos_id=None, cache={}):
+    """Offline reference stream: generate() on the single unpadded row,
+    trimmed at the first EOS (inclusive) — the retirement contract the
+    pad_id masking pins. jitted per (len, n_new) shape."""
+    key = (id(params), len(prompt), n_new, eos_id)
+    fn = cache.get(key)
+    if fn is None:
+        fn = jax.jit(lambda p, t: generate(
+            p, t, cfg, n_new, eos_id=eos_id, pad_id=0,
+            compute_dtype=jnp.float32))
+        cache[key] = fn
+    out = np.asarray(fn(params, jnp.asarray([prompt], jnp.int32)))
+    row = out[0, len(prompt):].tolist()
+    if eos_id is not None and eos_id in row:
+        row = row[: row.index(eos_id) + 1]
+    return row
+
+
+# ---------------------------------------------------------------------------
+# single device
+# ---------------------------------------------------------------------------
+
+
+def test_single_device_parity_ragged_and_zero_recompiles():
+    cfg = _cfg()
+    params, _ = init_causal_lm(jax.random.key(0), cfg)
+    sv = ServingArgs(max_batch_size=4, kv_block_size=8, max_seq_len=48,
+                     max_new_tokens=8)
+    eng = ServingEngine(params, cfg, sv, compute_dtype=jnp.float32)
+    eng.warmup(buckets=[8, 16])
+    warm = eng.compile_count()
+    rng = np.random.RandomState(0)
+    reqs = [(rng.randint(0, 128, (n,)).tolist(), m)
+            for n, m in [(3, 4), (9, 8), (13, 6), (1, 8), (16, 5), (7, 8)]]
+    handles = [eng.submit(p, max_new_tokens=m) for p, m in reqs]
+    eng.run_until_idle()
+    assert eng.compile_count() == warm  # steady state: no recompiles
+    for (p, m), h in zip(reqs, handles):
+        assert h.status == "done"
+        assert h.result(0) == _offline(params, cfg, p, m)
+
+
+def test_eos_retirement_matches_offline_and_recycles():
+    """Force EOS mid-stream: pick eos_id from an offline run's interior,
+    then check the engine retires exactly there and the freed slot serves
+    a follow-up request."""
+    cfg = _cfg()
+    params, _ = init_causal_lm(jax.random.key(1), cfg)
+    prompt = np.random.RandomState(1).randint(0, 128, (5,)).tolist()
+    free_run = _offline(params, cfg, prompt, 8)
+    eos = free_run[2]  # third generated token becomes the stop token
+    want = _offline(params, cfg, prompt, 8, eos_id=eos)
+    assert want[-1] == eos and len(want) < 8
+
+    sv = ServingArgs(max_batch_size=2, kv_block_size=8, max_seq_len=32,
+                     max_new_tokens=8, eos_id=eos)
+    eng = ServingEngine(params, cfg, sv, compute_dtype=jnp.float32)
+    h1 = eng.submit(prompt)
+    eng.run_until_idle()
+    assert h1.status == "done" and h1.finish_reason == "eos"
+    assert h1.result(0) == want
+    assert eng.kv.allocator.used == 0  # blocks freed on retirement
+    # recycled lane serves the next request
+    h2 = eng.submit(prompt, eos_id=None)
+    eng.run_until_idle()
+    assert h2.status == "done" and h2.finish_reason == "length"
+
+
+def test_sampling_is_batch_composition_invariant():
+    """A sampled request's stream depends on its own (seed, temperature),
+    not on which neighbors share the batch — the per-request fold_in
+    contract continuous batching depends on."""
+    cfg = _cfg()
+    params, _ = init_causal_lm(jax.random.key(2), cfg)
+    rng = np.random.RandomState(2)
+    probe = rng.randint(0, 128, (6,)).tolist()
+    sv = ServingArgs(max_batch_size=4, kv_block_size=8, max_seq_len=32,
+                     max_new_tokens=6)
+    runs = []
+    for neighbors in ([], [rng.randint(0, 128, (4,)).tolist(),
+                           rng.randint(0, 128, (11,)).tolist()]):
+        eng = ServingEngine(params, cfg, sv, compute_dtype=jnp.float32)
+        hs = [eng.submit(n, temperature=0.9, seed=100 + i)
+              for i, n in enumerate(neighbors)]
+        h = eng.submit(probe, temperature=0.7, seed=7)
+        eng.run_until_idle()
+        assert h.status == "done"
+        runs.append(h.result(0))
+        del hs
+    assert runs[0] == runs[1]
+    assert len(set(runs[0])) > 1  # actually sampling, not degenerate
+
+
+def test_cancellation_timeout_and_rejection():
+    cfg = _cfg()
+    params, _ = init_causal_lm(jax.random.key(3), cfg)
+    reg = MetricsRegistry()
+    sv = ServingArgs(max_batch_size=2, kv_block_size=8, max_seq_len=32,
+                     max_new_tokens=8)
+    eng = ServingEngine(params, cfg, sv, registry=reg,
+                        compute_dtype=jnp.float32)
+    prompt = [1, 2, 3]
+    # rejection: can never fit
+    h_rej = eng.submit([5] * 40, max_new_tokens=8)
+    assert h_rej.status == "rejected"
+    # cancellation mid-decode
+    h_c = eng.submit(prompt, max_new_tokens=8)
+    eng.step()  # prefill + first decode
+    h_c.cancel()
+    eng.step()
+    assert h_c.status == "cancelled"
+    assert 0 < len(h_c.output) < 8
+    # timeout: immediate deadline trips at the next sweep
+    h_t = eng.submit(prompt, max_new_tokens=8, timeout_s=1e-9)
+    eng.step()
+    time.sleep(0.005)
+    eng.step()
+    assert h_t.status == "timeout"
+    assert eng.kv.allocator.used == 0
+    assert reg.counter("serve/requests_rejected").value == 1
+    assert reg.counter("serve/requests_cancelled").value == 1
+    assert reg.counter("serve/requests_timeout").value == 1
+    # cancelled/expired while still QUEUED must count too (and never be
+    # admitted): saturate both lanes, queue two more, resolve them
+    blockers = [eng.submit(prompt, max_new_tokens=8) for _ in range(2)]
+    eng.step()
+    h_qc = eng.submit(prompt, max_new_tokens=8)
+    h_qt = eng.submit(prompt, max_new_tokens=8, timeout_s=1e-9)
+    h_qc.cancel()
+    time.sleep(0.005)
+    eng.step()
+    assert h_qc.status == "cancelled" and h_qc.output == []
+    assert h_qt.status == "timeout" and h_qt.output == []
+    assert reg.counter("serve/requests_cancelled").value == 2
+    assert reg.counter("serve/requests_timeout").value == 2
+    eng.run_until_idle()
+    assert all(b.status == "done" for b in blockers)
+
+
+def test_background_thread_streams_tokens():
+    cfg = _cfg()
+    params, _ = init_causal_lm(jax.random.key(4), cfg)
+    sv = ServingArgs(max_batch_size=2, kv_block_size=8, max_seq_len=32,
+                     max_new_tokens=5)
+    with ServingEngine(params, cfg, sv, compute_dtype=jnp.float32) as eng:
+        eng.start()
+        prompt = [3, 1, 4, 1, 5]
+        h = eng.submit(prompt)
+        got = list(h.tokens())  # blocking caller-side stream
+        assert h.status == "done" and got == h.result(0)
+        assert got == _offline(params, cfg, prompt, 5)
+        eng.stop()
+
+
+def test_default_warmup_buckets_cover_the_cap():
+    """bucket_length caps at the (possibly non-power-of-two) per-sequence
+    capacity; warmup's default ladder must include that cap or the first
+    long prompt recompiles mid-serving."""
+    from hetu_galvatron_tpu.serving.engine import default_buckets
+
+    assert default_buckets(8, 32) == [8, 16, 32]
+    assert default_buckets(16, 112) == [16, 32, 64, 112]
+    assert default_buckets(16, 16) == [16]
+
+
+def test_engine_thread_error_resolves_handles():
+    """A fatal error inside the background loop must abort every pending
+    handle (status 'error'), never leave callers blocked forever."""
+    cfg = _cfg()
+    params, _ = init_causal_lm(jax.random.key(5), cfg)
+    sv = ServingArgs(max_batch_size=2, kv_block_size=8, max_seq_len=32,
+                     max_new_tokens=4)
+    eng = ServingEngine(params, cfg, sv, compute_dtype=jnp.float32)
+
+    def boom(slot, bucket):
+        raise RuntimeError("injected prefill failure")
+
+    eng._prefill_slot = boom
+    eng.start()
+    h = eng.submit([1, 2, 3])
+    out = h.result(timeout=30)  # resolves instead of hanging
+    assert h.status == "error" and "injected" in h.finish_reason
+    assert out == []
+    assert isinstance(eng.error, RuntimeError)
+    # a submit AFTER the abort resolves immediately too (nothing will
+    # ever step the queue again)
+    h_late = eng.submit([4, 5])
+    assert h_late.status == "error" and h_late.done()
+    eng.close()
+
+
+def test_rejects_unsupported_families():
+    cfg = _cfg(model_type="bert", position_embedding_type="learned",
+               normalization="layernorm", hidden_act="gelu",
+               norm_position="post", add_bias_linear=True)
+    params, _ = init_causal_lm(jax.random.key(0), _cfg())
+    with pytest.raises(NotImplementedError):
+        ServingEngine(params, cfg)
+
+
+# ---------------------------------------------------------------------------
+# the continuous-batching drill (8-device CPU mesh, plan-aware SPMD)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.distributed
+def test_continuous_batching_drill_mesh8(tmp_path):
+    """>= 32 concurrent requests with staggered arrival and ragged
+    prompt/output lengths on the 8-device mesh under a tp2 plan: every
+    stream matches offline generate() exactly, steady-state decode
+    triggers zero recompiles, and the serving metrics land in the JSONL
+    sink and render through cli/summarize.py."""
+    cfg = _cfg()
+    args = CoreArgs(model=cfg.model_dump())
+    args.parallel.global_tp_deg = 2
+    args.parallel.vocab_tp = 2
+    args.parallel.global_train_batch_size = 8
+    from hetu_galvatron_tpu.runtime.hybrid_config import (
+        get_hybrid_parallel_config,
+    )
+    from hetu_galvatron_tpu.runtime.mesh import build_mesh
+
+    hpc = get_hybrid_parallel_config(args, 8)
+    mesh = build_mesh(8, 1, devices=jax.devices("cpu")[:8])
+    params, axes = init_causal_lm(jax.random.key(0), cfg)
+
+    metrics_path = str(tmp_path / "serve_metrics.jsonl")
+    reg = MetricsRegistry([JsonlSink(metrics_path)])
+    sv = ServingArgs(max_batch_size=8, kv_block_size=8, max_seq_len=48,
+                     max_new_tokens=8, flush_interval=8)
+    eng = ServingEngine(params, cfg, sv, mesh=mesh, hpc=hpc,
+                        axes_tree=axes, registry=reg,
+                        compute_dtype=jnp.float32)
+    # kv pool sharding follows the plan's attention tp axes
+    assert any(s != (None,) * 4 and list(s) != [None] * 4
+               for s in eng.kv.pspecs), eng.kv.pspecs
+
+    rng = np.random.RandomState(0)
+    lens = [3, 7, 12, 20, 1, 9, 15, 5] * 4  # 32 requests, ragged prompts
+    news = [4, 8, 6, 8, 8, 5, 7, 8] * 4  # ragged output budgets
+    reqs = [(rng.randint(0, 128, (n,)).tolist(), m)
+            for n, m in zip(lens, news)]
+
+    eng.warmup(buckets=[8, 16, 32])
+    warm_compiles = eng.compile_count()
+
+    # staggered arrival: requests land in four waves with decode steps
+    # (and some idle steps) in between — continuous batching must fill
+    # freed lanes from the queue while older sequences keep decoding
+    handles = []
+    for wave in range(4):
+        for p, m in reqs[wave * 8:(wave + 1) * 8]:
+            handles.append(eng.submit(p, max_new_tokens=m))
+        for _ in range(3):
+            eng.step()
+    eng.run_until_idle(max_steps=2000)
+    eng.close()
+    reg.close()
+
+    # zero recompiles after warmup (the jit cache-miss pin)
+    assert eng.compile_count() == warm_compiles
+
+    # every stream matches the offline decode exactly
+    assert all(h.status == "done" for h in handles)
+    for (p, m), h in zip(reqs, handles):
+        assert h.result(0) == _offline(params, cfg, p, m), (len(p), m)
+
+    # telemetry: TTFT / inter-token / queue / KV occupancy in the sink
+    records = [json.loads(line) for line in open(metrics_path)]
+    names = {(r.get("kind"), r.get("name")) for r in records}
+    for expect in [("histogram", "serve/ttft_ms"),
+                   ("histogram", "serve/itl_ms"),
+                   ("gauge", "serve/queue_depth"),
+                   ("gauge", "serve/kv_occupancy"),
+                   ("gauge", "serve/tokens_per_sec"),
+                   ("counter", "serve/requests_completed")]:
+        assert expect in names, expect
+    done = [r for r in records
+            if r.get("name") == "serve/requests_completed"]
+    assert done[-1]["value"] == 32
+    ttft = [r for r in records if r.get("name") == "serve/ttft_ms"]
+    assert ttft[-1]["count"] == 32
+
+    # ... and cli/summarize.py renders them
+    from hetu_galvatron_tpu.cli.summarize import summarize
+
+    buf = io.StringIO()
+    headline = summarize(metrics_path, out=buf)
+    text = buf.getvalue()
+    assert "-- serving --" in text
+    assert "TTFT ms" in text and "inter-token ms" in text
+    assert headline["serve/requests_completed"] == 32
+    assert headline["ttft_p50_ms"] > 0

@@ -1,0 +1,166 @@
+"""Paged KV cache: allocator lifecycle, pool scatter/gather, paged
+attention parity with the dense-cache decode math."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hetu_galvatron_tpu.core.args_schema import ModelArgs
+from hetu_galvatron_tpu.serving.kv_cache import (
+    SCRATCH_BLOCK,
+    BlockAllocator,
+    PagedKVCache,
+    gather_pages,
+    paged_sdpa,
+    pool_pspecs,
+    scatter_prefill,
+    scatter_token,
+)
+
+pytestmark = pytest.mark.serving
+
+
+def _cfg(**kw):
+    base = dict(hidden_size=32, num_hidden_layers=2, num_attention_heads=4,
+                vocab_size=64, max_position_embeddings=64, seq_length=32,
+                make_vocab_size_divisible_by=1)
+    base.update(kw)
+    return ModelArgs(**base)
+
+
+# ---------------------------------------------------------------------------
+# allocator
+# ---------------------------------------------------------------------------
+
+
+def test_allocator_alloc_free_cycle():
+    a = BlockAllocator(8)
+    assert a.available == 7  # block 0 is scratch
+    x = a.alloc(3)
+    y = a.alloc(4)
+    assert a.available == 0 and a.used == 7
+    assert SCRATCH_BLOCK not in x + y
+    assert len(set(x + y)) == 7  # no block handed out twice
+    assert a.alloc(1) is None  # pool exhausted -> no partial grant
+    a.free(x)
+    assert a.available == 3
+    z = a.alloc(2)
+    assert set(z) <= set(x)  # LIFO recycling
+
+
+def test_allocator_rejects_bad_frees():
+    a = BlockAllocator(4)
+    x = a.alloc(1)
+    with pytest.raises(ValueError):
+        a.free([SCRATCH_BLOCK])
+    with pytest.raises(ValueError):
+        a.free([99])
+    a.free(x)
+    with pytest.raises(ValueError):
+        a.free(x)  # double free
+
+
+def test_defrag_compacts_live_blocks():
+    cfg = _cfg()
+    kv = PagedKVCache(cfg, num_blocks=9, block_size=4, max_seq_len=16,
+                      dtype=jnp.float32)
+    t1 = kv.allocator.alloc(2)
+    t2 = kv.allocator.alloc(2)
+    t3 = kv.allocator.alloc(2)
+    kv.allocator.free(t2)  # leave a hole
+    # write a recognizable value through each live block
+    for j, b in enumerate(t1 + t3):
+        for L in range(cfg.num_hidden_layers):
+            kv.pools[L]["k"] = kv.pools[L]["k"].at[b].set(float(j + 1))
+    before = [np.asarray(gather_pages(kv.pools[0]["k"],
+                                      jnp.asarray([t], jnp.int32)[None]))
+              for t in t1 + t3]
+    new_tables = kv.defrag([t1, t3])
+    # live ids now occupy 1..4, free list is the tail
+    assert sorted(b for t in new_tables for b in t) == [1, 2, 3, 4]
+    assert kv.allocator.available == 4
+    after = [np.asarray(gather_pages(kv.pools[0]["k"],
+                                     jnp.asarray([b], jnp.int32)[None]))
+             for t in new_tables for b in t]
+    for b4, a4 in zip(before, after):
+        np.testing.assert_array_equal(b4, a4)
+
+
+def test_defrag_rejects_inconsistent_tables():
+    cfg = _cfg()
+    kv = PagedKVCache(cfg, num_blocks=6, block_size=4, max_seq_len=8,
+                      dtype=jnp.float32)
+    t = kv.allocator.alloc(2)
+    with pytest.raises(ValueError):
+        kv.defrag([t[:1]])  # one outstanding block unaccounted for
+
+
+# ---------------------------------------------------------------------------
+# pool ops
+# ---------------------------------------------------------------------------
+
+
+def test_scatter_gather_roundtrip():
+    P, bs, K, D = 6, 4, 2, 8
+    pool = jnp.zeros((P, bs, K, D), jnp.float32)
+    kv = jnp.arange(8 * K * D, dtype=jnp.float32).reshape(8, K, D)
+    table = jnp.asarray([3, 1], jnp.int32)  # deliberately out of order
+    pool = scatter_prefill(pool, kv, table)
+    got = gather_pages(pool, jnp.asarray([[3, 1]], jnp.int32))
+    np.testing.assert_array_equal(np.asarray(got)[0], np.asarray(kv))
+    # single-token scatter at position 9 (block 1 of the table, offset 1)
+    tok = jnp.full((1, K, D), -7.0)
+    pool = scatter_token(pool, tok, jnp.asarray([1], jnp.int32),
+                         jnp.asarray([1], jnp.int32))
+    got = gather_pages(pool, jnp.asarray([[3, 1]], jnp.int32))
+    np.testing.assert_array_equal(np.asarray(got)[0, 5], np.asarray(tok)[0])
+
+
+def test_paged_sdpa_matches_dense_cached_sdpa():
+    """paged_sdpa == models/generate._cached_sdpa row-for-row at the
+    row's own position (GQA geometry)."""
+    from hetu_galvatron_tpu.models.generate import _cached_sdpa
+
+    rng = np.random.RandomState(0)
+    S, T, nq, nkv, D = 3, 16, 4, 2, 8
+    q = jnp.asarray(rng.randn(S, 1, nq, D), jnp.float32)
+    ck = jnp.asarray(rng.randn(S, T, nkv, D), jnp.float32)
+    cv = jnp.asarray(rng.randn(S, T, nkv, D), jnp.float32)
+    pos = jnp.asarray([2, 9, 15], jnp.int32)
+    got = np.asarray(paged_sdpa(q, ck, cv, pos))
+    for b in range(S):
+        want = _cached_sdpa(q[b:b + 1], ck[b:b + 1], cv[b:b + 1],
+                            int(pos[b]))
+        np.testing.assert_allclose(got[b], np.asarray(want)[0], rtol=1e-6)
+
+
+def test_pool_sizing_and_occupancy():
+    cfg = _cfg(num_key_value_heads=2)  # GQA: pool stores kv heads only
+    kv = PagedKVCache(cfg, num_blocks=5, block_size=4, max_seq_len=10,
+                      dtype=jnp.float32)
+    assert kv.pools[0]["k"].shape == (5, 4, 2, cfg.head_dim)
+    assert kv.max_blocks_per_seq == 3  # ceil(10/4)
+    assert kv.blocks_for(9) == 3 and kv.blocks_for(4) == 1
+    assert kv.fits(10) and not kv.fits(13)
+    assert kv.occupancy == 0.0
+    kv.allocator.alloc(2)
+    assert kv.occupancy == pytest.approx(0.5)
+
+
+def test_pool_pspecs_follow_tp_axes():
+    from jax.sharding import PartitionSpec as P
+
+    class Sh:
+        def __init__(self, tp_axes, ulysses=False):
+            self.tp_axes = tp_axes
+            self.ulysses = ulysses
+
+    specs = pool_pspecs([Sh(("d1",)), Sh(("d0", "d1")),
+                         Sh(("d1",), ulysses=True)], 3, kv_heads=2)
+    assert specs[0] == P(None, None, ("d1",), None)
+    # tp=4 does not divide kv_heads=2 -> replicate
+    assert specs[1] == P(None, None, None, None)
+    # ulysses tp axes carry sequence, not heads -> replicate
+    assert specs[2] == P(None, None, None, None)
+    assert pool_pspecs(None, 2, 2) == [P(None, None, None, None)] * 2

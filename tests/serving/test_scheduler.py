@@ -1,0 +1,178 @@
+"""Continuous-batching scheduler: admission control, retirement, slot
+recycling, fixed-shape decode state."""
+
+import jax.numpy as jnp
+import pytest
+
+from hetu_galvatron_tpu.core.args_schema import ModelArgs
+from hetu_galvatron_tpu.serving.kv_cache import SCRATCH_BLOCK, PagedKVCache
+from hetu_galvatron_tpu.serving.scheduler import (
+    Request,
+    Scheduler,
+    bucket_length,
+)
+
+pytestmark = pytest.mark.serving
+
+
+def _sched(num_blocks=17, block_size=4, max_seq_len=16, max_slots=2,
+           **kw):
+    cfg = ModelArgs(hidden_size=32, num_hidden_layers=2,
+                    num_attention_heads=4, vocab_size=64,
+                    max_position_embeddings=64,
+                    make_vocab_size_divisible_by=1)
+    kv = PagedKVCache(cfg, num_blocks=num_blocks, block_size=block_size,
+                      max_seq_len=max_seq_len, dtype=jnp.float32)
+    return Scheduler(kv, max_slots=max_slots,
+                     max_position_embeddings=64, **kw), kv
+
+
+def test_bucket_lengths():
+    assert bucket_length(1, 4, 32) == 4
+    assert bucket_length(4, 4, 32) == 4
+    assert bucket_length(5, 4, 32) == 8
+    assert bucket_length(9, 4, 32) == 16
+    assert bucket_length(30, 4, 32) == 32
+    # cap wins even when the pow2 ladder would overshoot
+    assert bucket_length(10, 4, 12) == 12
+
+
+def test_admission_and_recycling():
+    s, kv = _sched()
+    h1 = s.submit(Request(tokens=[1, 2, 3], max_new_tokens=4))
+    h2 = s.submit(Request(tokens=[1] * 6, max_new_tokens=4))
+    h3 = s.submit(Request(tokens=[2, 2], max_new_tokens=2))
+    assert s.queue_depth == 3
+    admitted = s.admit()
+    assert len(admitted) == 2  # two slots
+    assert s.queue_depth == 1
+    slots = {sl.index for sl, _ in admitted}
+    assert slots == {0, 1}
+    assert h1.status == "running" and h2.status == "running"
+    # retire one -> slot + blocks recycle, next request admitted
+    sl0 = admitted[0][0]
+    blocks0 = list(sl0.blocks)
+    s.retire(sl0, "done", "eos")
+    assert h1.status == "done" and h1.finish_reason == "eos"
+    admitted2 = s.admit()
+    assert len(admitted2) == 1
+    assert admitted2[0][0].index == sl0.index  # recycled lane
+    assert set(admitted2[0][0].blocks) <= set(blocks0)  # recycled blocks
+    assert h3.status == "running"
+
+
+def test_rejects_oversized_requests_immediately():
+    s, kv = _sched(max_seq_len=16)
+    # 20 total tokens can never fit the 16-token per-sequence capacity
+    h = s.submit(Request(tokens=[1] * 10, max_new_tokens=10))
+    assert h.status == "rejected" and h.done()
+    assert s.queue_depth == 0 and s.rejected == 1
+    # empty prompts and empty generation budgets are rejected too
+    assert s.submit(Request(tokens=[], max_new_tokens=2)).status == "rejected"
+    assert s.submit(Request(tokens=[1], max_new_tokens=0)).status == "rejected"
+    # a request whose block need exceeds the WHOLE pool can never run:
+    # reject at submit instead of queueing forever
+    s2, _ = _sched(num_blocks=3, max_seq_len=16)  # 2 allocatable blocks
+    h2 = s2.submit(Request(tokens=[1] * 8, max_new_tokens=4))  # needs 3
+    assert h2.status == "rejected"
+
+
+def test_pool_exhaustion_preserves_fifo():
+    # 5 allocatable blocks; each request needs 3 (8 prompt + 4 new @ bs 4)
+    s, kv = _sched(num_blocks=6, max_slots=4)
+    h1 = s.submit(Request(tokens=[1] * 8, max_new_tokens=4))
+    h2 = s.submit(Request(tokens=[2] * 8, max_new_tokens=4))
+    admitted = s.admit()
+    assert len(admitted) == 1  # second doesn't fit the pool
+    assert h2.status == "queued"
+    s.retire(admitted[0][0], "done", "eos")
+    assert len(s.admit()) == 1
+    assert h2.status == "running"
+    del h1
+
+
+def test_prefill_token_budget_caps_admissions_but_never_deadlocks():
+    s, kv = _sched(num_blocks=33, max_slots=4, max_prefill_tokens=8)
+    for _ in range(3):
+        s.submit(Request(tokens=[1] * 8, max_new_tokens=2))  # bucket 8 each
+    admitted = s.admit()
+    assert len(admitted) == 1  # 8-token budget = one bucket per step
+    # a budget smaller than the smallest bucket still admits one
+    s2, _ = _sched(num_blocks=33, max_slots=4, max_prefill_tokens=2)
+    s2.submit(Request(tokens=[1] * 8, max_new_tokens=2))
+    assert len(s2.admit()) == 1
+
+
+def test_flops_budget_derives_token_cap():
+    cfg = ModelArgs(hidden_size=32, num_hidden_layers=2,
+                    num_attention_heads=4, vocab_size=64,
+                    max_position_embeddings=64,
+                    make_vocab_size_divisible_by=1)
+    kv = PagedKVCache(cfg, num_blocks=17, block_size=4, max_seq_len=16,
+                      dtype=jnp.float32)
+    s = Scheduler(kv, max_slots=2, max_position_embeddings=64,
+                  prefill_flops_budget=1000.0, flops_per_token=100.0)
+    assert s.prefill_token_cap == 10
+    # explicit token cap tightens further
+    s = Scheduler(kv, max_slots=2, max_position_embeddings=64,
+                  prefill_flops_budget=1000.0, flops_per_token=100.0,
+                  max_prefill_tokens=4)
+    assert s.prefill_token_cap == 4
+
+
+def test_sweep_timeout_and_cancel():
+    import time
+
+    s, kv = _sched()
+    h1 = s.submit(Request(tokens=[1, 2], max_new_tokens=4, timeout_s=0.5))
+    h2 = s.submit(Request(tokens=[3, 4], max_new_tokens=4))
+    s.admit()
+    assert h1.status == "running"  # unexpired deadline admits normally
+    h1.request.timeout_s = 1e-9  # now let it lapse mid-run
+    h2.cancel()
+    time.sleep(0.01)
+    assert s.sweep() == (1, 1)
+    assert h1.status == "timeout"
+    assert h2.status == "cancelled"
+    assert kv.allocator.used == 0  # blocks returned
+    # cancelled while still queued resolves at the next admit
+    h3 = s.submit(Request(tokens=[5], max_new_tokens=2))
+    h3.cancel()
+    s.admit()
+    assert h3.status == "cancelled"
+    # a deadline that expires while QUEUED is dropped before admission
+    # (no prefill work for a request nobody is waiting on)
+    h4 = s.submit(Request(tokens=[6], max_new_tokens=2, timeout_s=1e-9))
+    time.sleep(0.005)
+    assert s.sweep_waiting() == (0, 1)
+    assert h4.status == "timeout" and s.queue_depth == 0
+
+
+def test_decode_state_is_fixed_shape():
+    s, kv = _sched(max_slots=3)
+    s.submit(Request(tokens=[7, 8, 9], max_new_tokens=4, temperature=0.5,
+                     seed=11))
+    s.admit()
+    st = s.decode_state()
+    assert len(st["tokens"]) == 3 and len(st["tables"]) == 3
+    assert all(len(t) == kv.max_blocks_per_seq for t in st["tables"])
+    assert st["active"] == [True, False, False]
+    assert st["tokens"][0] == 9 and st["pos"][0] == 3
+    assert st["temps"][0] == 0.5 and st["seeds"][0] == 11
+    # inactive lanes park on the scratch block at pos 0
+    assert st["tables"][1] == [SCRATCH_BLOCK] * kv.max_blocks_per_seq
+    assert st["pos"][1] == 0
+
+
+def test_handle_stream_and_result():
+    s, _ = _sched()
+    h = s.submit(Request(tokens=[1], max_new_tokens=3))
+    s.admit()
+    slot = s.active[0]
+    for t in (5, 6):
+        h._emit(t)
+    s.retire(slot, "done", "length")
+    assert list(h.tokens()) == [5, 6]
+    assert list(h.tokens()) == []  # re-iteration terminates, never hangs
+    assert h.result(timeout=1) == [5, 6]
+    assert h.ttft_s() is not None and h.ttft_s() >= 0

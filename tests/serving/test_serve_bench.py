@@ -1,0 +1,38 @@
+"""tools/serve_bench.py smoke: the closed-loop load generator must run on
+CPU (--smoke), complete its request budget, and report a parseable JSON
+with zero steady-state recompiles."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.serving
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+BENCH = os.path.join(REPO, "tools", "serve_bench.py")
+
+
+def test_serve_bench_smoke(tmp_path):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)  # single device is enough (and faster)
+    out_json = tmp_path / "report.json"
+    metrics = tmp_path / "m.jsonl"
+    proc = subprocess.run(
+        [sys.executable, BENCH, "--smoke", "--requests", "10",
+         "--concurrency", "4", "--json", str(out_json),
+         "--metrics", str(metrics)],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    report = json.loads(out_json.read_text())
+    assert report["completed"] == 10
+    assert report["tokens_out"] > 0 and report["tokens_per_sec"] > 0
+    assert report["ttft_ms"]["p50"] > 0
+    assert report["steady_state_recompiles"] == 0
+    # the engine's own telemetry stream landed too
+    names = {json.loads(line).get("name") for line in open(metrics)}
+    assert "serve/ttft_ms" in names and "serve/tokens_per_sec" in names

@@ -1,0 +1,202 @@
+#!/usr/bin/env python
+"""Closed-loop serving load generator: TTFT / inter-token / throughput.
+
+Drives the in-process serving engine (``hetu_galvatron_tpu/serving/``) with
+a fixed-concurrency closed loop — every completed request is immediately
+replaced until the request budget is spent — the standard way to find a
+serving stack's latency/throughput operating point (open-loop arrival
+replays live in ``cli/serve.py`` via ``arrival_offset_s``).
+
+CPU-runnable smoke mode (like ``bench.py``'s probe path)::
+
+    JAX_PLATFORMS=cpu python tools/serve_bench.py --smoke
+
+Real shapes::
+
+    python tools/serve_bench.py --hidden 1024 --layers 8 --heads 16 \
+        --kv-heads 4 --vocab 32000 --requests 256 --concurrency 32 \
+        --max-batch 16 --max-new 64
+
+Weights are random (the bench measures the serving machinery, not the
+model); pass ``--json out.json`` for a machine-readable report and
+``--metrics m.jsonl`` to keep the engine's own telemetry stream.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def build_args(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny model + small load (CI / laptop)")
+    ap.add_argument("--hidden", type=int, default=512)
+    ap.add_argument("--layers", type=int, default=4)
+    ap.add_argument("--heads", type=int, default=8)
+    ap.add_argument("--kv-heads", type=int, default=0,
+                    help="0 = MHA (== --heads)")
+    ap.add_argument("--vocab", type=int, default=8192)
+    ap.add_argument("--max-positions", type=int, default=1024)
+    ap.add_argument("--requests", type=int, default=64)
+    ap.add_argument("--concurrency", type=int, default=16)
+    ap.add_argument("--max-batch", type=int, default=8)
+    ap.add_argument("--block-size", type=int, default=16)
+    ap.add_argument("--max-new", type=int, default=32)
+    ap.add_argument("--prompt-len", default="8:64",
+                    help="min:max prompt length (uniform)")
+    ap.add_argument("--temperature", type=float, default=0.0)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--json", default=None, help="write the report here")
+    ap.add_argument("--metrics", default=None,
+                    help="engine telemetry JSONL path")
+    return ap.parse_args(argv)
+
+
+def pct(xs, q):
+    return float(np.percentile(np.asarray(xs), q)) if xs else 0.0
+
+
+def main(argv=None) -> int:
+    ns = build_args(argv)
+    if ns.smoke:
+        ns.hidden, ns.layers, ns.heads, ns.vocab = 64, 2, 4, 256
+        ns.max_positions = 128
+        ns.requests = min(ns.requests, 24)
+        ns.concurrency = min(ns.concurrency, 6)
+        ns.max_batch = min(ns.max_batch, 4)
+        ns.max_new = min(ns.max_new, 8)
+        ns.prompt_len = "4:24"
+        ns.block_size = 8
+
+    import jax
+    import jax.numpy as jnp
+
+    from hetu_galvatron_tpu.core.args_schema import ModelArgs, ServingArgs
+    from hetu_galvatron_tpu.models.builder import init_causal_lm
+    from hetu_galvatron_tpu.observability.registry import MetricsRegistry
+    from hetu_galvatron_tpu.observability.sinks import JsonlSink
+    from hetu_galvatron_tpu.serving.engine import ServingEngine
+
+    lo, hi = (int(x) for x in ns.prompt_len.split(":"))
+    cfg = ModelArgs(
+        hidden_size=ns.hidden, num_hidden_layers=ns.layers,
+        num_attention_heads=ns.heads,
+        num_key_value_heads=ns.kv_heads or None,
+        vocab_size=ns.vocab, max_position_embeddings=ns.max_positions,
+        seq_length=min(ns.max_positions, hi + ns.max_new),
+        hidden_act="swiglu", normalization="rmsnorm",
+        position_embedding_type="rope", tie_word_embeddings=False,
+        add_bias_linear=False, add_qkv_bias=False,
+        make_vocab_size_divisible_by=1)
+    params, _ = init_causal_lm(jax.random.key(ns.seed), cfg)
+    serving = ServingArgs(
+        max_batch_size=ns.max_batch, kv_block_size=ns.block_size,
+        max_seq_len=min(ns.max_positions, hi + ns.max_new),
+        max_new_tokens=ns.max_new, temperature=ns.temperature)
+    registry = MetricsRegistry(
+        [JsonlSink(ns.metrics)] if ns.metrics else [])
+    # bf16 on accelerators, f32 on CPU (smoke numerics)
+    dtype = (jnp.float32 if jax.devices()[0].platform == "cpu"
+             else jnp.bfloat16)
+    engine = ServingEngine(params, cfg, serving, registry=registry,
+                           compute_dtype=dtype)
+
+    print(f"warmup: compiling decode + prefill buckets ...", file=sys.stderr)
+    t0 = time.monotonic()
+    engine.warmup()
+    warm_s = time.monotonic() - t0
+    compiles_warm = engine.compile_count()
+
+    counter = {"left": ns.requests}
+    lock = threading.Lock()
+    ttfts, itls, lats, toks_out = [], [], [], [0]
+    not_done = {}  # status -> count: rejected/timeout/cancelled/error
+
+    def worker(wid: int):
+        # per-worker stream: RandomState is not thread-safe and a shared
+        # one would make --seed runs depend on thread interleaving
+        rng = np.random.RandomState(ns.seed + wid)
+        while True:
+            with lock:
+                if counter["left"] <= 0:
+                    return
+                counter["left"] -= 1
+            n = rng.randint(lo, hi + 1)
+            prompt = rng.randint(0, cfg.vocab_size, (n,)).tolist()
+            t_sub = time.monotonic()
+            h = engine.submit(prompt, seed=wid)
+            prev = None
+            for _ in h.tokens():
+                now = time.monotonic()
+                if prev is not None:
+                    itls.append((now - prev) * 1000.0)
+                prev = now
+            if h.status != "done":
+                # a benchmark must not silently shrink its own load:
+                # non-completions are reported, not dropped
+                with lock:
+                    not_done[h.status] = not_done.get(h.status, 0) + 1
+                continue
+            ttfts.append(h.ttft_s() * 1000.0)
+            lats.append((h.finished_t - t_sub) * 1000.0)
+            with lock:
+                toks_out[0] += len(h.output)
+
+    engine.start()
+    t0 = time.monotonic()
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(ns.concurrency)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.monotonic() - t0
+    engine.close()
+    registry.close()
+
+    report = {
+        "model": {"hidden": ns.hidden, "layers": ns.layers,
+                  "heads": ns.heads, "vocab": ns.vocab},
+        "load": {"requests": ns.requests, "concurrency": ns.concurrency,
+                 "max_batch": ns.max_batch, "prompt_len": ns.prompt_len,
+                 "max_new": ns.max_new},
+        "warmup_s": round(warm_s, 3),
+        "wall_s": round(wall, 3),
+        "completed": len(lats),
+        "not_completed": not_done,  # rejected/timeout/cancelled/error
+        "tokens_out": toks_out[0],
+        "tokens_per_sec": round(toks_out[0] / wall, 2) if wall else 0.0,
+        "requests_per_sec": round(len(lats) / wall, 2) if wall else 0.0,
+        "ttft_ms": {"p50": round(pct(ttfts, 50), 3),
+                    "p90": round(pct(ttfts, 90), 3),
+                    "p99": round(pct(ttfts, 99), 3)},
+        "itl_ms": {"p50": round(pct(itls, 50), 3),
+                   "p99": round(pct(itls, 99), 3)},
+        "latency_ms": {"p50": round(pct(lats, 50), 3),
+                       "p99": round(pct(lats, 99), 3)},
+        "jit_programs_after_warmup": compiles_warm,
+        "jit_programs_final": engine.compile_count(),
+        "steady_state_recompiles":
+            engine.compile_count() - compiles_warm,
+    }
+    print(json.dumps(report, indent=2))
+    if ns.json:
+        with open(ns.json, "w") as f:
+            json.dump(report, f, indent=2)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
