@@ -397,9 +397,36 @@ def train(args) -> Dict[str, Any]:
         return sp, so
 
     if hpc.pp_deg > 1:
-        eng = PipelineEngine(cfg, hpc, args.train, devices=state.devices,
-                             compute_dtype=compute_dtype,
-                             dcn_slices=args.parallel.dcn_slices)
+        # schedule impl selection (pipeline.schedule_impl): "compiled" fuses
+        # the whole 1F1B step into one SPMD program with ppermute stage
+        # transfers; plans it cannot express fall back to the host-sequenced
+        # engine with a logged reason (the general path)
+        eng = None
+        if args.pipeline.schedule_impl == "compiled":
+            from hetu_galvatron_tpu.runtime.compiled_pipeline import (
+                CompiledPipelineEngine,
+            )
+
+            reason = CompiledPipelineEngine.unsupported_reason(
+                cfg, hpc, data=args.data)
+            if reason is not None:
+                state.log("pipeline.schedule_impl=compiled cannot express "
+                          f"this plan ({reason}); falling back to the host "
+                          "engine")
+            else:
+                # donation halves live model-state memory but is only safe
+                # when the rerun machine never re-runs pre-update buffers
+                eng = CompiledPipelineEngine(
+                    cfg, hpc, args.train, devices=state.devices,
+                    compute_dtype=compute_dtype,
+                    dcn_slices=args.parallel.dcn_slices,
+                    donate=not rerun.enabled)
+                state.log("pipeline schedule: compiled single-program 1F1B "
+                          f"(bubble_frac {eng.bubble_frac():.3f})")
+        if eng is None:
+            eng = PipelineEngine(cfg, hpc, args.train, devices=state.devices,
+                                 compute_dtype=compute_dtype,
+                                 dcn_slices=args.parallel.dcn_slices)
         sp = eng.split_params(params, axes)
         so = eng.init_opt(sp, axes)
         sp, so, start_iter = maybe_resume(sp, so)

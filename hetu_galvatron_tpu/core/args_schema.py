@@ -193,6 +193,28 @@ class ParallelArgs(BaseModel):
         return self
 
 
+class PipelineArgs(BaseModel):
+    """Pipeline-schedule execution knobs (pp_deg > 1 only).
+
+    ``schedule_impl`` selects how the 1F1B schedule executes:
+
+    * ``host`` — the general engine (runtime/pipeline.py): one jitted GSPMD
+      program per stage on its own submesh, the host sequences the schedule
+      and relies on JAX async dispatch for overlap. Supports every plan
+      shape (vpp interleaving, uneven pp_division, t5, MoE, ring/flash
+      kernels, packed documents).
+    * ``compiled`` — the single-program schedule
+      (runtime/compiled_pipeline.py): the ENTIRE 1F1B step (all stages, all
+      microbatches, grad accumulation, tied-embedding exchange, clip,
+      optimizer update) is one donated jit over a mesh with a real ``pp``
+      axis; inter-stage transfers are `lax.ppermute` collective-permutes
+      XLA overlaps with compute. Plans the compiled path cannot express
+      fall back to ``host`` with a logged reason.
+    """
+
+    schedule_impl: Literal["host", "compiled"] = "host"
+
+
 class TrainArgs(BaseModel):
     lr: float = 1e-4
     min_lr: float = 1e-5
@@ -421,6 +443,14 @@ class SearchArgs(BaseModel):
     sp_time_path: Optional[str] = None
     sequence_length: Optional[int] = None
     costmodel_coe: float = 1.0
+    # Host-dispatch overhead pricing (tools/pipeline_dispatch_bench.py):
+    # one already-compiled stage-jit call costs ~dispatch_us of host wall
+    # time, and the host-sequenced schedule pays 2 (fwd+bwd) * pp * chunks
+    # of them per step. The compiled schedule (pipeline.schedule_impl=
+    # compiled) pays none, so the search prices pp differently per impl —
+    # cranking dispatch_us pushes the host-impl search away from deep pp.
+    dispatch_us: float = 0.0
+    pipeline_schedule_impl: Literal["host", "compiled"] = "host"
 
 
 class ModelProfileArgs(BaseModel):
@@ -472,6 +502,7 @@ class CoreArgs(BaseModel):
     )
     model: ModelArgs = Field(default_factory=ModelArgs)
     parallel: ParallelArgs = Field(default_factory=ParallelArgs)
+    pipeline: PipelineArgs = Field(default_factory=PipelineArgs)
     train: TrainArgs = Field(default_factory=TrainArgs)
     ckpt: CheckpointArgs = Field(default_factory=CheckpointArgs)
     data: DataArgs = Field(default_factory=DataArgs)

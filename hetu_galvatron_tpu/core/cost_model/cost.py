@@ -86,6 +86,14 @@ class CostContext:
     allgather_latency: Dict[int, Dict[Any, float]] = field(default_factory=dict)
     all2all_latency: Dict[int, Dict[Any, float]] = field(default_factory=dict)
     allreduce_latency: Dict[int, Dict[Any, float]] = field(default_factory=dict)
+    # host-sequenced pipeline dispatch overhead (beyond the reference):
+    # the host engine pays ~dispatch_us of wall time per already-compiled
+    # stage-jit call — 2 (fwd + bwd) * pp * chunks calls per step — while
+    # the compiled single-program schedule (pipeline.schedule_impl=
+    # compiled) pays none. Measured by tools/pipeline_dispatch_bench.py;
+    # 0.0 (the default) keeps the reference-equivalent arithmetic exact.
+    dispatch_us: float = 0.0
+    schedule_impl: str = "host"
 
 
 def _zero_ratios(chunks: int, mixed_precision: bool, async_grad_reduce: bool):
@@ -521,4 +529,24 @@ def pipeline_time_cost(
         stage_reduce[i] -= float(np.sum(stage_compute[:i + 1]))
     reduce_tail = max(stage_reduce)
     result += reduce_tail if reduce_tail > 0 else 0.0
+
+    # host-sequenced dispatch overhead (tools/pipeline_dispatch_bench.py):
+    # every (stage, microbatch) leg costs one fwd + one bwd jitted-call
+    # dispatch on the host, which the single-program compiled schedule
+    # eliminates. This is what lets the search's pp choice price the two
+    # pipeline.schedule_impl flavours differently: deep pp under the host
+    # impl pays dispatch linearly in pp * chunks. The waiver only applies
+    # to plans the compiled engine can EXPRESS (it falls back to the host
+    # engine otherwise — CompiledPipelineEngine.unsupported_reason): 1F1B
+    # only, uniform stage partition, uniform per-layer strategy, no cp.
+    ctx0 = contexts[0]
+    if pp_size > 1 and ctx0.dispatch_us:
+        compiled_expressible = (
+            ctx0.schedule_impl == "compiled"
+            and ctx0.pipeline_type == "pipedream_flush"
+            and len(set(partition)) == 1
+            and all(s == strategy_list[0] for s in strategy_list)
+            and strategy_list[0].cp == 1)
+        if not compiled_expressible:
+            result += ctx0.dispatch_us * 1e-6 * 2 * pp_size * chunks
     return result

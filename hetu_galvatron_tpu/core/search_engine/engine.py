@@ -201,6 +201,8 @@ class SearchEngine:
             allgather_latency=hw.allgather_latency,
             all2all_latency=hw.all2all_latency,
             allreduce_latency=hw.allreduce_latency,
+            dispatch_us=self.args.dispatch_us,
+            schedule_impl=self.args.pipeline_schedule_impl,
         )
 
     # ---------------- outer loop ----------------

@@ -27,6 +27,7 @@ from hetu_galvatron_tpu.runtime.mesh import (
     LayerSharding,
     lower_strategy,
     lower_vocab_strategy,
+    spec_tree,
 )
 from hetu_galvatron_tpu.runtime.trainer import make_train_step
 
@@ -43,11 +44,8 @@ def layer_shardings(
     return per_layer, vocab
 
 
-def _spec_tree(axes: Any, sh: LayerSharding, opt: bool) -> Any:
-    fn = sh.opt_spec if opt else sh.param_spec
-    return jax.tree.map(
-        fn, axes, is_leaf=lambda x: isinstance(x, tuple)
-        and all(isinstance(s, str) for s in x))
+# shared logical-axes -> PartitionSpec lowering (runtime/mesh.py)
+_spec_tree = spec_tree
 
 
 def param_specs(

@@ -31,7 +31,7 @@ from __future__ import annotations
 
 import math
 from dataclasses import dataclass
-from typing import Optional, Sequence, Tuple
+from typing import Any, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -142,6 +142,51 @@ def build_mesh(
 def stage_axes(mesh: Mesh) -> Tuple[str, ...]:
     """The binary intra-stage axes, outermost first."""
     return tuple(n for n in mesh.axis_names if n != "pp")
+
+
+def spec_tree(axes: Any, sh: "LayerSharding", opt: bool = False) -> Any:
+    """Map a logical-axis pytree (tuples of axis-name strings at the leaves,
+    models/modules.py init_*) to PartitionSpecs under one layer's sharding.
+    Shared by the SPMD lowering, the host pipeline engine and the compiled
+    pipeline engine."""
+    fn = sh.opt_spec if opt else sh.param_spec
+    return jax.tree.map(
+        fn, axes, is_leaf=lambda x: isinstance(x, tuple)
+        and all(isinstance(s, str) for s in x))
+
+
+def stacked_spec(spec: P) -> P:
+    """Spec for a per-stage value stacked along a leading ``[pp, ...]`` axis
+    (the compiled pipeline's parameter/activation layout): the stage axis
+    rides the mesh's ``pp`` axis, the remaining dims keep their intra-stage
+    assignment."""
+    return P("pp", *spec)
+
+
+def make_pp_rotation(mesh: Mesh, spec: P, shift: int):
+    """Stage-transfer collective for the compiled pipeline schedule: rotate a
+    ``[pp, ...]``-stacked array (sharded :func:`stacked_spec`-style, one
+    stage per ``pp`` mesh row) by ``shift`` stages as a `lax.ppermute` over
+    the ``pp`` axis — the XLA collective-permute the latency-hiding
+    scheduler overlaps with compute, replacing the host engine's
+    ``jax.device_put`` submesh transfers. ``spec`` is the FULL stacked spec
+    (leading ``pp`` entry included); axes it does not mention are treated as
+    replicated (``check_rep=False`` — the rotation is an identity on them).
+
+    ``shift=+1`` sends stage s's slice to stage s+1 (forward activations);
+    ``shift=-1`` sends it to stage s-1 (backward cotangents). The wrap-around
+    edge carries don't-care data by construction of the 1F1B schedule (lane 0
+    embeds fresh tokens; the last lane seeds its cotangent from the loss)."""
+    from jax.experimental.shard_map import shard_map
+
+    pp = mesh.shape["pp"]
+    perm = [(i, (i + shift) % pp) for i in range(pp)]
+
+    def body(blk):
+        return jax.lax.ppermute(blk, "pp", perm)
+
+    return shard_map(body, mesh, in_specs=spec, out_specs=spec,
+                     check_rep=False)
 
 
 @dataclass(frozen=True)

@@ -57,18 +57,12 @@ from hetu_galvatron_tpu.runtime.mesh import (
     device_array,
     lower_strategy,
     lower_vocab_strategy,
+    spec_tree as _spec_tree,
 )
 from hetu_galvatron_tpu.observability.tracing import span
 from hetu_galvatron_tpu.runtime.optimizer import make_lr_schedule
 
 Params = Dict[str, Any]
-
-
-def _spec_tree(axes: Any, sh: LayerSharding, opt: bool = False) -> Any:
-    fn = sh.opt_spec if opt else sh.param_spec
-    return jax.tree.map(
-        fn, axes, is_leaf=lambda x: isinstance(x, tuple)
-        and all(isinstance(s, str) for s in x))
 
 
 def _pipeline_optimizer(train: TrainArgs) -> optax.GradientTransformation:
@@ -180,24 +174,56 @@ class PipelineEngine:
                 enc_layer_range=(enc_lo, enc_hi),
                 enc_shardings=enc_shardings, has_enc_norm=has_enc_norm))
             lo = hi
-        self._fwd_jits = [self._make_fwd(st) for st in self.stages]
-        self._bwd_jits = [self._make_bwd(st) for st in self.stages]
-        self._update_jits = [self._make_update(st) for st in self.stages]
+        # ALL stage/step jits are built lazily on first use (like the eval
+        # jits always were): an eval-only engine never constructs backward
+        # or update programs, an untied plan never constructs the tied-grad
+        # transpose, and plans that never train build nothing at all.
+        self._lazy_jits: Dict[str, Any] = {}
         self._eval_jits = None  # built on first eval_step (dropout off)
-        self._transpose_jit = jax.jit(jnp.transpose)
+
+    def _jit(self, name: str, build) -> Any:
+        """Construct-on-first-use cache for the engine's jitted helpers."""
+        if name not in self._lazy_jits:
+            self._lazy_jits[name] = build()
+        return self._lazy_jits[name]
+
+    @property
+    def _fwd_jits(self) -> List[Optional[Callable]]:
+        return self._jit("fwd", lambda: [self._make_fwd(st)
+                                         for st in self.stages])
+
+    @property
+    def _bwd_jits(self) -> List[Callable]:
+        return self._jit("bwd", lambda: [self._make_bwd(st)
+                                         for st in self.stages])
+
+    @property
+    def _update_jits(self) -> List[Callable]:
+        return self._jit("update", lambda: [self._make_update(st)
+                                            for st in self.stages])
+
+    @property
+    def _transpose_jit(self) -> Callable:
+        return self._jit("transpose", lambda: jax.jit(jnp.transpose))
+
+    @property
+    def _gnorm_jit(self) -> Callable:
         # expert_bias maintenance pseudo-grads stay out of the clip norm,
         # matching the SPMD path (clip_by_global_norm lives inside the
         # multi_transform adam branch, which never sees bias leaves)
-        self._gnorm_jit = jax.jit(
+        return self._jit("gnorm", lambda: jax.jit(
             lambda g: sum(
                 jnp.sum(jnp.square(x.astype(jnp.float32)))
                 for path, x in jax.tree_util.tree_leaves_with_path(g)
-                if not path or "expert_bias" not in str(path[-1])))
-        clip = train.clip_grad
-        self._clip_jit = jax.jit(
+                if not path or "expert_bias" not in str(path[-1]))))
+
+    @property
+    def _clip_jit(self) -> Callable:
+        clip = self.train.clip_grad
+        return self._jit("clip", lambda: jax.jit(
             lambda sq: (jnp.sqrt(sq),
                         jnp.minimum(1.0, clip / (jnp.sqrt(sq) + 1e-12))
-                        if clip and clip > 0 else jnp.ones((), jnp.float32)))
+                        if clip and clip > 0 else jnp.ones((), jnp.float32))))
 
     # ------------------------------------------------------------------
     # params / optimizer state

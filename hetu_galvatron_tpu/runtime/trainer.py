@@ -41,6 +41,21 @@ def make_loss_fn(
     return loss_fn
 
 
+def microbatch_weights(loss_mask: Optional[jax.Array], chunks: int
+                       ) -> jax.Array:
+    """Per-microbatch token-share weights from a ``[chunks, ...]``-stacked
+    loss mask: each microbatch's masked-mean loss is weighted by its share
+    of valid tokens so gradient accumulation matches the unchunked step
+    exactly even under non-uniform masks. ``None`` mask -> uniform
+    ``1/chunks``. Shared by the scanned SPMD step and both pipeline
+    engines (host and compiled)."""
+    if loss_mask is None:
+        return jnp.full((chunks,), 1.0 / chunks, jnp.float32)
+    counts = jnp.sum(loss_mask.astype(jnp.float32),
+                     axis=tuple(range(1, loss_mask.ndim)))
+    return counts / jnp.maximum(jnp.sum(counts), 1.0)
+
+
 def make_train_step(
     loss_fn: Callable[[Any, Dict[str, jax.Array]], jax.Array],
     tx: optax.GradientTransformation,
@@ -100,12 +115,7 @@ def make_train_step(
             # token-weighted accumulation: each microbatch's masked-mean loss
             # is weighted by its share of valid tokens so chunks>1 matches
             # chunks=1 exactly even under non-uniform loss masks
-            if "loss_mask" in batch:
-                counts = jnp.sum(mbs["loss_mask"].astype(jnp.float32),
-                                 axis=tuple(range(1, batch["loss_mask"].ndim + 1)))
-                weights = counts / jnp.maximum(jnp.sum(counts), 1.0)
-            else:
-                weights = jnp.full((chunks,), 1.0 / chunks, jnp.float32)
+            weights = microbatch_weights(mbs.get("loss_mask"), chunks)
 
             def microbatch(acc, xs):
                 mb, w = xs

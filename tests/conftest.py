@@ -79,6 +79,14 @@ _SLOW_TESTS = {
     "test_flash_gradients_noncausal",
     # CLI / e2e / profilers / checkpoint
     "test_search_then_train_the_searched_plan",
+    "test_train_dist_cli_pipeline_compiled",
+    "test_train_dist_cli_compiled_falls_back",
+    # compiled-pipeline secondary parity legs (the tier-1 acceptance drill
+    # test_compiled_matches_host_engine_three_steps + recompile pinning
+    # stay fast-tier)
+    "test_compiled_untied_and_uniform_dp",
+    "test_compiled_dropout_replays_host_masks",
+    "test_compiled_ramp_caches_one_program_per_chunk_count",
     "test_train_dist_rampup_cli",
     "test_train_dist_rampup_pipeline_cli",
     "test_train_dist_cli_pipeline",

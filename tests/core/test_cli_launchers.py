@@ -52,6 +52,39 @@ def test_train_dist_cli_pipeline(capsys):
     assert "training done" in capsys.readouterr().out
 
 
+def test_train_dist_cli_pipeline_compiled(capsys):
+    """pipeline.schedule_impl=compiled routes an eligible 1F1B plan through
+    the single-program engine."""
+    from hetu_galvatron_tpu.cli.train_dist import main
+
+    rc = main([os.path.join(ZOO, "llama2-7b.yaml")] + TINY_OVERRIDES +
+              ["parallel.pp_deg=2", "parallel.chunks=2",
+               "parallel.global_tp_deg=2",
+               "parallel.pipeline_type=pipedream_flush",
+               "pipeline.schedule_impl=compiled",
+               "model.num_key_value_heads=2", "model.ffn_hidden_size=64"])
+    res = capsys.readouterr()
+    assert rc == 0
+    assert "pipeline schedule: compiled" in res.out + res.err
+    assert "training done" in res.out
+
+
+def test_train_dist_cli_compiled_falls_back(capsys):
+    """A plan the compiled schedule cannot express (gpipe) logs its reason
+    and trains through the host engine."""
+    from hetu_galvatron_tpu.cli.train_dist import main
+
+    rc = main([os.path.join(ZOO, "llama2-7b.yaml")] + TINY_OVERRIDES +
+              ["parallel.pp_deg=2", "parallel.chunks=2",
+               "parallel.pipeline_type=gpipe",
+               "pipeline.schedule_impl=compiled",
+               "model.num_key_value_heads=2", "model.ffn_hidden_size=64"])
+    res = capsys.readouterr()
+    assert rc == 0
+    assert "falling back to the host engine" in res.out + res.err
+    assert "training done" in res.out
+
+
 def test_search_dist_cli(tmp_path, capsys):
     from hetu_galvatron_tpu.cli.search_dist import main
 

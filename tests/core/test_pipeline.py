@@ -141,6 +141,35 @@ def test_uneven_pp_division(cpu_devices):
                                    rtol=5e-4, atol=3e-4)
 
 
+def test_engine_builds_jits_lazily(cpu_devices):
+    """The engine's stage/step jits are construct-on-first-use: building an
+    engine creates none of them, eval-only use never builds backward/update
+    programs, and an untied plan never builds the tied-grad transpose."""
+    args = CoreArgs(model=CFG.model_dump(), train=TRAIN.model_dump())
+    args.parallel.pp_deg = 2
+    args.parallel.chunks = 2
+    args.parallel.global_train_batch_size = 8
+    hpc = get_hybrid_parallel_config(args, 8)
+    eng = PipelineEngine(CFG, hpc, args.train, devices=cpu_devices,
+                         compute_dtype=jnp.float32)
+    assert eng._lazy_jits == {}, "construction built jits eagerly"
+    params, axes = init_causal_lm(jax.random.key(0), CFG)
+    sp = eng.split_params(params, axes)
+    assert eng._lazy_jits == {}
+    eng.eval_step(sp, _batch(bsz=8))
+    # eval builds only the eval stage programs (and the fwd list they
+    # share nothing with): no backward, update, clip or transpose jits
+    assert "bwd" not in eng._lazy_jits
+    assert "update" not in eng._lazy_jits
+    assert "transpose" not in eng._lazy_jits
+    so = eng.init_opt(sp, axes)
+    eng.train_step(sp, so, _batch(bsz=8))
+    # CFG is untied: a full train step still never builds the tied-grad
+    # transpose program
+    assert "transpose" not in eng._lazy_jits
+    assert {"fwd", "bwd", "update", "gnorm", "clip"} <= set(eng._lazy_jits)
+
+
 @pytest.mark.slow
 @pytest.mark.parametrize("pipeline_type", ["gpipe", "pipedream_flush"])
 def test_interleaved_virtual_stages_match_single_device(pipeline_type,

@@ -1,0 +1,131 @@
+"""Host-dispatch overhead term in the pipeline cost model: the search
+engine's pp choice must price the two pipeline.schedule_impl flavours
+differently (the host-sequenced engine pays ~dispatch_us per stage-jit call,
+the compiled single-program schedule pays none)."""
+
+import os
+
+import pytest
+
+from hetu_galvatron_tpu.core.args_schema import SearchArgs
+from hetu_galvatron_tpu.core.cost_model.cost import (
+    CostContext,
+    pipeline_time_cost,
+)
+from hetu_galvatron_tpu.core.search_engine.engine import SearchEngine
+from hetu_galvatron_tpu.core.search_engine.strategies import SearchStrategy
+
+pytestmark = pytest.mark.search_engine
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "..", "fixtures")
+
+
+def _ctx(dispatch_us=0.0, schedule_impl="host"):
+    return CostContext(
+        parameter_size=48.0, seq_length=1024, hidden_size=4096, layer_num=4,
+        mixed_precision=True, pipeline_type="pipedream_flush",
+        forward_computation_time=1.0,
+        comm_coe_dict={"8_1": 0.2, "4_1": 0.05, "2_1": 0.05, "1_1": 0.0},
+        p2p_comm_coe_dict={2: 0.0001},
+        dispatch_us=dispatch_us, schedule_impl=schedule_impl,
+    )
+
+
+def _plan_cost(pp, dispatch_us=0.0, schedule_impl="host", chunks=4, gbsz=16):
+    s = SearchStrategy(pp=pp, tp=1, dp=8 // pp)
+    ctx = _ctx(dispatch_us, schedule_impl)
+    partition = [4 // pp] * pp
+    return pipeline_time_cost(
+        [4], [ctx], [s] * 4, partition, chunks, gbsz, pp, [0.0] * pp)
+
+
+def test_dispatch_term_is_linear_in_pp_and_chunks():
+    base = _plan_cost(pp=2, dispatch_us=0.0)
+    loaded = _plan_cost(pp=2, dispatch_us=500.0)
+    # 2 (fwd + bwd) dispatches per (stage, microbatch) leg
+    assert loaded - base == pytest.approx(500.0 * 1e-6 * 2 * 2 * 4)
+    # pp=1 has no pipeline engine, hence no dispatch term
+    assert _plan_cost(pp=1, dispatch_us=500.0) == _plan_cost(pp=1)
+
+
+def test_compiled_schedule_pays_no_dispatch():
+    assert _plan_cost(pp=2, dispatch_us=500.0, schedule_impl="compiled") == \
+        _plan_cost(pp=2, dispatch_us=0.0, schedule_impl="compiled") == \
+        _plan_cost(pp=2, dispatch_us=0.0, schedule_impl="host")
+
+
+def test_compiled_waiver_only_for_expressible_plans():
+    """Plans the compiled engine rejects at runtime (gpipe, uneven stage
+    partition, heterogeneous strategies) fall back to the host engine and
+    must keep paying dispatch even under schedule_impl=compiled."""
+    d = 500.0
+    term = d * 1e-6 * 2 * 2 * 4
+
+    def cost(partition, strategies, pipeline_type="pipedream_flush",
+             dispatch_us=0.0):
+        ctx = _ctx(dispatch_us, "compiled")
+        ctx.pipeline_type = pipeline_type
+        return pipeline_time_cost([4], [ctx], strategies, partition, 4, 16,
+                                  2, [0.0, 0.0])
+
+    uniform = [SearchStrategy(pp=2, tp=1, dp=4)] * 4
+    # gpipe cannot compile -> dispatch applies
+    assert cost([2, 2], uniform, "gpipe", d) == \
+        pytest.approx(cost([2, 2], uniform, "gpipe") + term)
+    # uneven stage partition -> dispatch applies
+    assert cost([1, 3], uniform, dispatch_us=d) == \
+        pytest.approx(cost([1, 3], uniform) + term)
+    # heterogeneous per-layer strategies -> dispatch applies
+    mixed = uniform[:2] + [SearchStrategy(pp=2, tp=1, dp=4,
+                                          checkpoint=True)] * 2
+    assert cost([2, 2], mixed, dispatch_us=d) == \
+        pytest.approx(cost([2, 2], mixed) + term)
+    # the expressible shape keeps the waiver
+    assert cost([2, 2], uniform, dispatch_us=d) == cost([2, 2], uniform)
+
+
+def test_pp_choice_flips_when_dispatch_is_cranked():
+    """With cheap intra-stage dp comm at dp=4 vs expensive at dp=8, pp=2
+    wins on pure compute/comm — until the host-dispatch overhead term makes
+    deep pp pay for its 2 * pp * chunks stage-jit calls."""
+    assert _plan_cost(pp=2) < _plan_cost(pp=1)  # pipeline wins undispatched
+    crank = 5000.0  # us per call — a slow-dispatch host
+    assert _plan_cost(pp=2, dispatch_us=crank) > \
+        _plan_cost(pp=1, dispatch_us=crank)  # choice flips to pp=1
+    # ...but the compiled schedule keeps the pipeline win at any dispatch
+    assert _plan_cost(pp=2, dispatch_us=crank,
+                      schedule_impl="compiled") < _plan_cost(pp=1)
+
+
+def test_search_engine_threads_dispatch_into_contexts(tmp_path):
+    """SearchArgs.dispatch_us / pipeline_schedule_impl flow into every
+    layertype's CostContext (the values pipeline_time_cost reads)."""
+    args = SearchArgs(
+        num_nodes=1, num_devices_per_node=8, memory_constraint=36,
+        settle_bsz=64, settle_chunks=8,
+        default_dp_type="zero2", pipeline_type="pipedream_flush",
+        fine_grained_mode=0, sequence_parallel=True,
+        async_grad_reduce=False, mixed_precision="bf16",
+        time_profile_mode="sequence", memory_profile_mode="sequence",
+        dispatch_us=375.0, pipeline_schedule_impl="compiled",
+        time_profiling_path=os.path.join(
+            FIXTURES, "computation_profiling_bf16_llama2-7b_all.json"),
+        memory_profiling_path=os.path.join(
+            FIXTURES, "memory_profiling_bf16_llama2-7b_all.json"),
+        allreduce_bandwidth_config_path=os.path.join(
+            FIXTURES, "allreduce_bandwidth_1nodes_8gpus_per_node.json"),
+        p2p_bandwidth_config_path=os.path.join(
+            FIXTURES, "p2p_bandwidth_1nodes_8gpus_per_node.json"),
+        overlap_coe_path=os.path.join(FIXTURES, "overlap_coefficient.json"),
+        sp_time_path=os.path.join(
+            FIXTURES, "sp_time_1nodes_8gpus_per_node.json"),
+        output_config_path=str(tmp_path),
+    )
+    eng = SearchEngine(args)
+    eng.set_model_info(
+        [{"hidden_size": 4096, "seq_len": 8192, "layer_num": 28}],
+        "llama2-7b")
+    eng.initialize()
+    for ctx in eng.contexts:
+        assert ctx.dispatch_us == 375.0
+        assert ctx.schedule_impl == "compiled"

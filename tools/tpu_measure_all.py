@@ -153,6 +153,12 @@ def main() -> int:
          2400, None),
         ("flash_check", [py, os.path.join(ROOT, "tools",
                                           "tpu_flash_check.py")], 2400, None),
+        # compiled-vs-host pipeline schedule A/B on the chip (the CPU-mesh
+        # numbers in PERF.md only bound dispatch; the on-chip ratio also
+        # sees real overlap + collective-permute transfers)
+        ("pipeline_ab", [py, os.path.join(ROOT, "tools",
+                                          "pipeline_dispatch_bench.py"),
+                         "--tpu"], 1800, None),
         ("bench", [py, os.path.join(ROOT, "bench.py")], 1100, None),
     ]
     for name, argv, deadline, env_extra in steps:
