@@ -77,6 +77,29 @@ def run_leg(spec: dict, journal: str) -> int:
 
     try:
         emit("start")
+        if spec.get("kind") == "tp_overlap":
+            # A/B leg: overlapped ring TP collectives vs GSPMD on the same
+            # tp x dp plans (tools/tp_overlap_bench.py). The CPU variant
+            # needs the 8-device virtual mesh, not the single-device pin.
+            if spec["platform"] == "cpu":
+                os.environ["JAX_PLATFORMS"] = "cpu"
+                flag = "--xla_force_host_platform_device_count=8"
+                if "xla_force_host_platform_device_count" not in \
+                        os.environ.get("XLA_FLAGS", ""):
+                    os.environ["XLA_FLAGS"] = (
+                        os.environ.get("XLA_FLAGS", "") + " " + flag).strip()
+            sys.path.insert(0, os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), "tools"))
+            import tp_overlap_bench
+
+            out = tp_overlap_bench.run(on_tpu=spec["platform"] == "tpu")
+            if "skipped" in out:
+                emit("error", error=out["skipped"])
+            else:
+                emit("ok", tp_overlap_vs_gspmd=out["overlap_vs_gspmd"],
+                     tp_overlap_recompiles=out["overlap_recompiles"],
+                     tp_overlap_legs=out["legs"], platform=out["platform"])
+            return 0
         if spec["platform"] == "cpu":
             # tunnel-safe: pin the platform BEFORE jax loads any backend...
             os.environ["JAX_PLATFORMS"] = "cpu"
@@ -557,12 +580,34 @@ def main() -> int:
             print(f"warning: fused-CE leg failed: {res.get('error')}",
                   file=sys.stderr)
 
+    # overlapped-TP A/B (tools/tp_overlap_bench.py): on-chip by default
+    # (where the ring hops can actually hide under compute); opt-in on CPU
+    # via BENCH_TP_OVERLAP=1 (the virtual-mesh ratio only bounds overhead)
+    tp_ab = None
+    if (not orch.wedged and os.environ.get(
+            "BENCH_TP_OVERLAP", "1" if on_tpu else "0") != "0"):
+        state["stage"] = "tp-overlap"
+        res = orch.run({"kind": "tp_overlap", "platform": platform,
+                        "seq": seq, "bsz": best["bsz"], "iters": iters,
+                        "flash": False, "fused_ce": False}, leg_budget)
+        if res["status"] == "ok":
+            tp_ab = {"tp_overlap_vs_gspmd": res["tp_overlap_vs_gspmd"],
+                     "tp_overlap_recompiles": res["tp_overlap_recompiles"]}
+            print(f"bench TP-overlap A/B: overlap_vs_gspmd "
+                  f"{res['tp_overlap_vs_gspmd']} (recompiles "
+                  f"{res['tp_overlap_recompiles']})", file=sys.stderr)
+        else:
+            print(f"warning: tp-overlap A/B leg failed: {res.get('error')}",
+                  file=sys.stderr)
+
     out = _assemble(best, tpu_error, flash_error, on_tpu)
     out["fused_ce"] = fused_ce
     if ab:
         out.update(ab)
     if ce_ab:
         out.update(ce_ab)
+    if tp_ab:
+        out.update(tp_ab)
     if orch.abandoned:
         out["abandoned_children"] = orch.abandoned
     _emit_result(out)

@@ -69,10 +69,61 @@ def _label_str(labels: str) -> str:
     return "{" + ",".join(f"{k}={v}" for k, v in sorted(d.items())) + "}"
 
 
+def _load_hardware_json(path: str) -> Optional[Dict[str, Any]]:
+    """A hardware-profiler bandwidth JSON (one dict of allreduce_size_*
+    keys) rather than a JSONL metrics stream — summarize renders its
+    bandwidth + fitted α-β table instead."""
+    try:
+        with open(path) as f:
+            obj = json.load(f)
+    except (json.JSONDecodeError, OSError):
+        return None
+    if isinstance(obj, dict) and "kind" not in obj and any(
+            k.startswith("allreduce_size_") for k in obj):
+        return obj
+    return None
+
+
+def summarize_hardware(cfg: Dict[str, Any], path: str, out=None
+                       ) -> Dict[str, Any]:
+    """Render a hardware bandwidth JSON: per (group size, consecutiveness)
+    the measured bandwidth and, when the profiler fitted them
+    (``profile_alpha_beta``), the α (latency ms) / β (MB/ms) pair — the
+    latency-aware collective model the search engine prices TP with."""
+    out = out or sys.stdout
+    w = lambda s="": print(s, file=out)
+    w(f"== hardware profile: {path} ==")
+    w(f"{'group':<14}{'bw MB/ms':>10}{'alpha ms':>12}{'beta MB/ms':>12}")
+    headline: Dict[str, Any] = {"groups": 0, "alpha_beta_groups": 0}
+    for key in sorted(cfg):
+        if not (key.startswith("allreduce_size_")
+                and key.split("_")[-1] in ("0", "1")):
+            continue
+        parts = key.split("_")  # allreduce_size_{n}_consec_{c}
+        n, c = parts[2], parts[4]
+        label = f"{n} {'consec' if c == '1' else 'strided'}"
+        alpha = cfg.get(f"allreduce_size_{n}_consec_{c}_alpha_ms")
+        beta = cfg.get(f"allreduce_size_{n}_consec_{c}_beta_mb_per_ms")
+        headline["groups"] += 1
+        if alpha is not None and beta is not None:
+            headline["alpha_beta_groups"] += 1
+            w(f"{label:<14}{_fmt(cfg[key]):>10}{_fmt(alpha):>12}"
+              f"{_fmt(beta):>12}")
+        else:
+            w(f"{label:<14}{_fmt(cfg[key]):>10}{'-':>12}{'-':>12}")
+    if not headline["alpha_beta_groups"]:
+        w("(no fitted alpha/beta keys: legacy bandwidth-only profile — "
+          "the cost model uses the measured latency tables)")
+    return headline
+
+
 def summarize(path: str, out=None) -> Dict[str, Any]:
     """Print the summary; returns the headline numbers (for tests)."""
     out = out or sys.stdout
     w = lambda s="": print(s, file=out)
+    hw = _load_hardware_json(path)
+    if hw is not None:
+        return summarize_hardware(hw, path, out)
     records = load_records(path)
     latest = last_by_name(records)
 
@@ -112,6 +163,15 @@ def summarize(path: str, out=None) -> Dict[str, Any]:
         g = get("gauge", f"train/{key}")
         if g:
             w(f"final {key:<10} {_fmt(g['value'])}")
+    hid = get("gauge", "tp/comm_hidden_frac")
+    if hid is not None:
+        headline["tp_comm_hidden_frac"] = hid["value"]
+        # coverage, not a timing claim: the share of TP collective TRAFFIC
+        # running the ring-overlap path (how much of it actually hides
+        # depends on the compute/comm balance — cost model's
+        # tp_overlap_hidden_frac)
+        w(f"TP comm overlapped {hid['value'] * 100:.1f}% "
+          "(traffic share on ring-overlap layers)")
     mems = [(lb, r) for (k, n, lb), r in latest.items()
             if k == "gauge" and n == "device/mem_mb"]
     if mems:
@@ -190,7 +250,8 @@ def summarize(path: str, out=None) -> Dict[str, Any]:
 
     rest = [((k, n, lb), r) for (k, n, lb), r in sorted(latest.items())
             if k in ("counter", "gauge")
-            and not n.startswith(("train/", "device/", "plan/", "serve/"))]
+            and not n.startswith(("train/", "device/", "plan/", "serve/",
+                                  "tp/"))]
     if rest:
         w()
         w("-- other counters/gauges --")

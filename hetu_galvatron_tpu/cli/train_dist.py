@@ -89,6 +89,51 @@ def train(args) -> Dict[str, Any]:
     drill = FaultDrill(args.rerun)
     start_iter = 0
 
+    # overlapped-TP collectives (tp_overlap.enable, ops/overlap.py):
+    # resolve per-layer eligibility once from the plan, log every fallback
+    # with its reason, and remember the overlapped layer set for the
+    # tp/comm_hidden_frac gauge. The compiled pipeline engine disables the
+    # whole feature below (shard_map cannot nest under its stacked vmap).
+    tp_overlap_on = args.tp_overlap.enable
+    overlapped_layers: list = []
+    if tp_overlap_on:
+        from hetu_galvatron_tpu.ops.overlap import plan_overlap_reasons
+
+        reasons = plan_overlap_reasons(cfg, hpc)
+        overlapped_layers = [i for i, r in reasons if r is None]
+        for i, r in reasons:
+            if r is not None:
+                state.log(f"tp_overlap: layer {i} falls back to GSPMD "
+                          f"collectives ({r})")
+        if not overlapped_layers:
+            state.log("tp_overlap.enable set but no layer is eligible; "
+                      "running the GSPMD path")
+            tp_overlap_on = False
+
+    def finish_tp_overlap_setup(step_fn):
+        """Once the engine choice has settled: emit the coverage gauge and
+        wrap the step in the ``tp/overlap_step`` span."""
+        if not tp_overlap_on:
+            return step_fn
+        state.log(f"tp_overlap: {len(overlapped_layers)}/{len(hpc.layers)} "
+                  "layers run decomposed ring collective matmuls")
+        if telemetry is not None:
+            from hetu_galvatron_tpu.observability.telemetry import (
+                plan_tp_overlap_hidden_frac,
+            )
+
+            telemetry.registry.gauge("tp/comm_hidden_frac").set(
+                plan_tp_overlap_hidden_frac(
+                    hpc, cfg, overlapped_layers,
+                    mixed_precision=args.parallel.mixed_precision != "fp32"))
+        from hetu_galvatron_tpu.observability.tracing import span
+
+        def stepped(sp_, so_, b):
+            with span("tp/overlap_step"):
+                return step_fn(sp_, so_, b)
+
+        return stepped
+
     # batch-size ramp (reference --rampup-batch-size): the micro size
     # gbsz/chunks stays FIXED; only the microbatch count varies per step
     calc = rebatch = None
@@ -414,6 +459,15 @@ def train(args) -> Dict[str, Any]:
                           f"this plan ({reason}); falling back to the host "
                           "engine")
             else:
+                if tp_overlap_on:
+                    # same constraint as the engine's attention kernels:
+                    # shard_map cannot nest under the stacked per-stage vmap
+                    state.log("tp_overlap: unsupported under "
+                              "pipeline.schedule_impl=compiled (shard_map "
+                              "cannot nest under the stacked vmap); running "
+                              "GSPMD collectives")
+                    tp_overlap_on = False
+                    overlapped_layers = []
                 # donation halves live model-state memory but is only safe
                 # when the rerun machine never re-runs pre-update buffers
                 eng = CompiledPipelineEngine(
@@ -426,18 +480,20 @@ def train(args) -> Dict[str, Any]:
         if eng is None:
             eng = PipelineEngine(cfg, hpc, args.train, devices=state.devices,
                                  compute_dtype=compute_dtype,
-                                 dcn_slices=args.parallel.dcn_slices)
+                                 dcn_slices=args.parallel.dcn_slices,
+                                 tp_overlap=tp_overlap_on)
         sp = eng.split_params(params, axes)
         so = eng.init_opt(sp, axes)
         sp, so, start_iter = maybe_resume(sp, so)
         if valid_iter is not None or test_iter is not None:
             eval_box["fn"] = lambda sp_, raw: eng.eval_step(sp_, raw)["loss"]
         if calc is None:
-            sp, so = run_loop(sp, so, eng.train_step)
+            sp, so = run_loop(sp, so, finish_tp_overlap_setup(eng.train_step))
         else:
             # the stage jits are microbatch-shaped: a ramp reuses them all
-            sp, so = run_loop(sp, so, lambda sp_, so_, b: eng.train_step(
-                sp_, so_, b, num_microbatches=calc.num_micro_batches))
+            sp, so = run_loop(sp, so, finish_tp_overlap_setup(
+                lambda sp_, so_, b: eng.train_step(
+                    sp_, so_, b, num_microbatches=calc.num_micro_batches)))
     else:
         mesh = build_mesh(world, 1, devices=state.devices,
                           dcn_slices=args.parallel.dcn_slices)
@@ -445,7 +501,7 @@ def train(args) -> Dict[str, Any]:
         # rerun machine will never re-call the step on pre-update buffers
         step, pspecs, ospecs, batch_shd = make_spmd_train_step(
             cfg, hpc, mesh, axes, tx, params, compute_dtype=compute_dtype,
-            donate=not rerun.enabled)
+            donate=not rerun.enabled, tp_overlap=tp_overlap_on)
         nshd = jax.tree.map(
             lambda s: NamedSharding(mesh, s), ospecs,
             is_leaf=lambda x: isinstance(x, PartitionSpec))
@@ -461,7 +517,8 @@ def train(args) -> Dict[str, Any]:
                 step_cache[ch] = make_spmd_train_step(
                     cfg, hpc, mesh, axes, tx, params,
                     compute_dtype=compute_dtype,
-                    donate=not rerun.enabled, chunks=ch)[0]
+                    donate=not rerun.enabled, chunks=ch,
+                    tp_overlap=tp_overlap_on)[0]
             return step_cache[ch]
 
         def spmd_step(sp, so, raw):
@@ -479,7 +536,8 @@ def train(args) -> Dict[str, Any]:
             from hetu_galvatron_tpu.parallel.spmd import make_spmd_eval_step
 
             eval_fn, eval_shd = make_spmd_eval_step(
-                cfg, hpc, mesh, axes, compute_dtype=compute_dtype)
+                cfg, hpc, mesh, axes, compute_dtype=compute_dtype,
+                tp_overlap=tp_overlap_on)
 
             def spmd_eval(sp_, raw):
                 raw = dict(raw)
@@ -489,7 +547,7 @@ def train(args) -> Dict[str, Any]:
 
             eval_box["fn"] = spmd_eval
 
-        sp, so = run_loop(sp, so, spmd_step)
+        sp, so = run_loop(sp, so, finish_tp_overlap_setup(spmd_step))
 
     wait_for_checkpoints()
     test_loss = None

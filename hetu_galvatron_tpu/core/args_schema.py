@@ -215,6 +215,26 @@ class PipelineArgs(BaseModel):
     schedule_impl: Literal["host", "compiled"] = "host"
 
 
+class TpOverlapArgs(BaseModel):
+    """Overlapped tensor-parallel collective knobs (``ops/overlap.py``).
+
+    ``enable`` swaps every eligible Megatron-TP layer's four projection
+    matmuls (attention qkv/out, MLP fc1/fc2) for decomposed ring
+    all-gather/reduce-scatter matmuls under full-manual ``shard_map``: the
+    sequence chunks `lax.ppermute` around the tp ring while each rank
+    multiplies the chunk it already holds, so the transfer hides behind
+    dependent compute instead of serializing against it (GSPMD's
+    auto-partitioned all-gather -> matmul). Layers the path cannot express
+    fall back to GSPMD with a logged ``unsupported_reason``: tp == 1,
+    Ulysses (tp axes carry sequence), cp layers, tp not dividing the
+    sequence/projection widths, MoE/t5 layers — and the compiled pipeline
+    engine rejects the whole feature (shard_map cannot nest under its
+    stacked per-stage vmap, the same constraint its attention kernels
+    documented)."""
+
+    enable: bool = False
+
+
 class TrainArgs(BaseModel):
     lr: float = 1e-4
     min_lr: float = 1e-5
@@ -451,6 +471,15 @@ class SearchArgs(BaseModel):
     # cranking dispatch_us pushes the host-impl search away from deep pp.
     dispatch_us: float = 0.0
     pipeline_schedule_impl: Literal["host", "compiled"] = "host"
+    # Overlapped-TP pricing (ops/overlap.py + the α-β collective model):
+    # 1 prices eligible Megatron-TP layers with the max(comm, compute)-style
+    # overlap discount (cost_model/cost.py layer_time_cost), mirroring a
+    # runtime that sets tp_overlap.enable. The α (latency) term itself is
+    # independent: it activates whenever the allreduce-bandwidth JSON
+    # carries fitted alpha/beta keys (hardware_profiler.profile_alpha_beta)
+    # and falls back to the legacy latency tables otherwise, so legacy
+    # profiles reproduce golden costs exactly.
+    tp_overlap: int = 0
 
 
 class ModelProfileArgs(BaseModel):
@@ -487,6 +516,10 @@ class HardwareProfileArgs(BaseModel):
     start_mb: int = 1
     end_mb: int = 512
     scale: int = 2
+    # smallest sub-MB all-reduce point (KB) for the α-β latency fit
+    # (profile_sp_time 'sub_' keys + profile_alpha_beta); layer-wise TP
+    # messages live in this regime, where the α term dominates
+    sub_mb_floor_kb: int = 64
     warmup_iters: int = 5
     profile_iters: int = 20
     avg_or_min_or_first: Literal["avg", "min", "first"] = "avg"
@@ -503,6 +536,7 @@ class CoreArgs(BaseModel):
     model: ModelArgs = Field(default_factory=ModelArgs)
     parallel: ParallelArgs = Field(default_factory=ParallelArgs)
     pipeline: PipelineArgs = Field(default_factory=PipelineArgs)
+    tp_overlap: TpOverlapArgs = Field(default_factory=TpOverlapArgs)
     train: TrainArgs = Field(default_factory=TrainArgs)
     ckpt: CheckpointArgs = Field(default_factory=CheckpointArgs)
     data: DataArgs = Field(default_factory=DataArgs)

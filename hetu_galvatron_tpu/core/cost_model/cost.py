@@ -94,6 +94,18 @@ class CostContext:
     # 0.0 (the default) keeps the reference-equivalent arithmetic exact.
     dispatch_us: float = 0.0
     schedule_impl: str = "host"
+    # latency-aware (α-β) TP collective model + overlapped-TP discount
+    # (beyond the reference, which prices TP purely from the measured
+    # latency tables): tp_alpha_beta maps "{size}_{consec}" -> (alpha_ms,
+    # beta_mb_per_ms) fitted by hardware_profiler.profile_alpha_beta on
+    # the ALLREDUCE curve; a Megatron-SP ag/rs-equivalent message costs
+    # 0.5 * (α + size/β). Empty dict (legacy profiles) falls back to the
+    # measured latency-table lookup, leaving golden costs byte-identical.
+    # tp_overlap=True applies the max(comm, compute)-style discount of the
+    # decomposed ring matmuls (ops/overlap.py) to overlap-expressible
+    # layers only (tp > 1, no cp, not under the compiled pipeline engine).
+    tp_alpha_beta: Dict[str, Tuple[float, float]] = field(default_factory=dict)
+    tp_overlap: bool = False
 
 
 def _zero_ratios(chunks: int, mixed_precision: bool, async_grad_reduce: bool):
@@ -122,18 +134,58 @@ def _zero_ratios(chunks: int, mixed_precision: bool, async_grad_reduce: bool):
 # ---------------------------------------------------------------------------
 
 
-def layer_time_cost(
-    s: "SearchStrategy", ctx: CostContext, gbsz: int, chunks: int
-) -> Tuple[float, float]:
-    """Per-layer time in seconds: (with grad sync, without). Mirrors
-    TimeCostModelBase end-to-end (layer_cost.py:88-213)."""
-    lbsz = gbsz // chunks // s.dp
-    param_mb = ctx.parameter_size / s.tp
-    n = ctx.layer_num
+def tp_overlap_expressible(s: "SearchStrategy", ctx: CostContext) -> bool:
+    """Can this layer run the decomposed ring-overlap matmuls
+    (ops/overlap.layer_overlap_reason, the shape checks aside — the search
+    works in degrees, not concrete widths)? Megatron TP only (Ulysses has
+    s.tp == 1 here), no cp, and never under the compiled pipeline engine
+    (shard_map cannot nest under its stacked per-stage vmap)."""
+    return (ctx.tp_overlap and s.tp > 1 and s.cp == 1
+            and not (s.pp > 1 and ctx.schedule_impl == "compiled"))
 
-    # computation (layer_cost.py:88-103); cp shards the sequence, so the
-    # per-device compute divides by cp too (zigzag ring keeps the causal
-    # work balanced across the ring — ops/ring_attention.py)
+
+def _overlap_window(comm: float, comp: float, coe: float) -> float:
+    """Wall time of (collective ∥ dependent compute), mirroring the dp
+    ``overlap()`` split (layer_cost.py:161-178): both sides run slowed by
+    the profiled overlap coefficient until the shorter one drains, the
+    remainder finishes at full speed."""
+    comm_ov, comp_ov = comm * coe, comp * coe
+    if comm_ov > comp_ov:
+        return comp_ov + (comm - comp_ov / coe)
+    if comm_ov < comp_ov:
+        return comm_ov + (comp - comm_ov / coe)
+    return comm_ov
+
+
+def _tp_message_ms(s: "SearchStrategy", ctx: CostContext,
+                   message_mb: float) -> float:
+    """One Megatron-SP ag/rs-equivalent collective of ``message_mb`` MB:
+    the fitted α-β model when the profile carries it (half the allreduce
+    curve, matching profiles.remap_collective_latency's allgather
+    derivation), else the legacy measured-table lookup. Only called with
+    s.tp > 1; tp groups are consecutive (the same assumption the legacy
+    dc_key encodes), so the "{n}_1" pair applies."""
+    ab = ctx.tp_alpha_beta.get(f"{s.tp}_1")
+    if ab is not None:
+        alpha, beta = ab
+        return 0.5 * (alpha + message_mb / beta)
+    return _lookup_latency(ctx.allgather_latency[s.tp], message_mb)
+
+
+def _tp_terms(s: "SearchStrategy", ctx: CostContext, gbsz: int, chunks: int
+              ) -> Tuple[float, float, float]:
+    """Shared per-layer (fct, bct, tp_time) arithmetic — consumed by both
+    :func:`layer_time_cost` (the price the search optimizes) and
+    :func:`tp_overlap_hidden_frac` (the diagnostic), so the two can never
+    drift apart.
+
+    computation (layer_cost.py:88-103): cp shards the sequence, so the
+    per-device compute divides by cp too (zigzag ring keeps the causal
+    work balanced across the ring — ops/ring_attention.py).
+    tp/sp collectives (layer_cost.py:119-150): the Megatron-TP path
+    prices one message via the α-β fit when present (_tp_message_ms)."""
+    lbsz = gbsz // chunks // s.dp
+    n = ctx.layer_num
     fct_in = ctx.forward_computation_time
     if isinstance(fct_in, (np.ndarray, tuple, list)):
         fct = _linear(lbsz / s.tp_sp / s.cp, fct_in) * n
@@ -143,6 +195,34 @@ def layer_time_cost(
     if s.checkpoint:
         bct += fct
 
+    if s.tp_sp == 1:
+        tp_time = 0.0
+    else:
+        message_mb = (lbsz * ctx.seq_length * ctx.hidden_size *
+                      (2 if ctx.mixed_precision else 4) / 1024 / 1024)
+        if s.tp == 1:  # Ulysses: 2 a2a fwd + 2 bwd per layer
+            comm_num = 4 * n
+            per_msg = _lookup_latency(ctx.all2all_latency[s.sp], message_mb)
+        else:  # Megatron TP+SP: 3 ag-equivalents fwd + 3 bwd per layer
+            comm_num = 6 * n
+            per_msg = _tp_message_ms(s, ctx, message_mb)
+        if s.checkpoint:
+            comm_num *= 1.5
+        tp_time = per_msg * comm_num
+    return fct, bct, tp_time
+
+
+def layer_time_cost(
+    s: "SearchStrategy", ctx: CostContext, gbsz: int, chunks: int
+) -> Tuple[float, float]:
+    """Per-layer time in seconds: (with grad sync, without). Mirrors
+    TimeCostModelBase end-to-end (layer_cost.py:88-213)."""
+    lbsz = gbsz // chunks // s.dp
+    param_mb = ctx.parameter_size / s.tp
+    n = ctx.layer_num
+
+    fct, bct, tp_time = _tp_terms(s, ctx, gbsz, chunks)
+
     # dp gradient sync (layer_cost.py:105-116)
     dp_message = 2 * (s.sdp - 1) * (param_mb / s.sdp) * n
     if ctx.mixed_precision:
@@ -151,22 +231,6 @@ def layer_time_cost(
     dc_key = f"{s.sdp}_0" if s.tp != 1 else f"{s.sdp}_1"
     dc = ctx.comm_coe_dict[dc_key]
     dc_overlap = dc * ctx.dp_overlap_coe
-
-    # tp/sp collectives (layer_cost.py:119-150)
-    if s.tp_sp == 1:
-        tp_time = 0.0
-    else:
-        if s.tp == 1:  # Ulysses: 2 a2a fwd + 2 bwd per layer
-            comm_num = 4 * n
-            select = ctx.all2all_latency[s.sp]
-        else:  # Megatron TP+SP: 3 ag-equivalents fwd + 3 bwd per layer
-            comm_num = 6 * n
-            select = ctx.allgather_latency[s.tp]
-        if s.checkpoint:
-            comm_num *= 1.5
-        message_mb = (lbsz * ctx.seq_length * ctx.hidden_size *
-                      (2 if ctx.mixed_precision else 4) / 1024 / 1024)
-        tp_time = _lookup_latency(select, message_mb) * comm_num
 
     # cp ring-attention communication (beyond the reference, which ships
     # cp disabled — search_engine/args_schema.py:29): each ring step
@@ -203,18 +267,30 @@ def layer_time_cost(
             return dp_t, bct - dp_t / ctx.bct_overlap_coe
         return bct_t, 0.0
 
+    # overlapped-TP discount: the decomposed ring matmuls hide the TP
+    # collectives under the dependent chunk compute. dp=1 layers overlap
+    # against the full fwd+bwd matmul window; layers that also overlap dp
+    # comm against the backward keep only the forward window free.
+    overlap_tp = tp_overlap_expressible(s, ctx) and tp_time > 0
+
+    def tp_term(window: float) -> float:
+        """Exposed TP comm time beyond the compute window it hides under."""
+        if not overlap_tp:
+            return tp_time
+        return _overlap_window(tp_time, window, ctx.bct_overlap_coe) - window
+
     def result(no_sync: bool) -> float:
         factor = 0 if no_sync else 1
         if s.tp_sp == 1 and s.dp > 1:
             ov, rest = overlap(dp_message * factor)
             r = fct + ov + rest + ctx.extra_overhead
         elif s.dp == 1 and s.tp_sp > 1:
-            r = fct + bct + tp_time
+            r = fct + bct + tp_term(fct + bct)
         elif s.dp == 1 and s.tp_sp == 1:
             r = fct + bct
         else:
             ov, rest = overlap(dp_message * factor)
-            r = fct + ov + rest + tp_time + ctx.extra_overhead
+            r = fct + ov + rest + tp_term(fct) + ctx.extra_overhead
         if s.dp_type == DPType.ZERO3:
             r += fsdp_allgather * dc
         if s.pp > 1 and p2p_coe is not None:
@@ -223,6 +299,26 @@ def layer_time_cost(
         return r * 0.001 * ctx.costmodel_coe / n
 
     return result(False), result(True)
+
+
+def tp_overlap_hidden_frac(s: "SearchStrategy", ctx: CostContext,
+                           gbsz: int, chunks: int) -> float:
+    """Predicted fraction of one layer's TP collective time hidden under
+    the decomposed matmuls' compute, from the same arithmetic the search
+    prices (``layer_time_cost``'s tp_term): 0.0 for inexpressible layers,
+    approaching ``2 - overlap_coe`` in the compute-bound regime. This is
+    the cost-side per-layer prediction (it needs the profiled hardware
+    tables, so it lives with the search); the runtime's
+    ``tp/comm_hidden_frac`` gauge instead reports profile-free COVERAGE
+    (observability.telemetry.plan_tp_overlap_hidden_frac)."""
+    if not tp_overlap_expressible(s, ctx):
+        return 0.0
+    fct, bct, tp_time = _tp_terms(s, ctx, gbsz, chunks)
+    if tp_time <= 0:
+        return 0.0
+    window = (fct + bct) if s.dp == 1 else fct
+    exposed = _overlap_window(tp_time, window, ctx.bct_overlap_coe) - window
+    return max(0.0, min(1.0, 1.0 - exposed / tp_time))
 
 
 # ---------------------------------------------------------------------------

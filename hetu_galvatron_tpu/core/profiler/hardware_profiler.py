@@ -82,18 +82,20 @@ class HardwareProfiler:
             jnp.ones((elems,), jnp.float32),
             NamedSharding(mesh, P(None)))
 
-        from jax import shard_map
+        # NOTE: this jax pin has no top-level jax.shard_map; the
+        # experimental entry point (check_rep kwarg) is the one that works
+        from jax.experimental.shard_map import shard_map
 
         if op == "allreduce":
             fn = shard_map(lambda v: jax.lax.psum(v, "g"), mesh=mesh,
                            in_specs=P(None), out_specs=P(None),
-                           check_vma=False)
+                           check_rep=False)
         elif op == "allgather":
             x = jax.device_put(jnp.ones((elems,), jnp.float32),
                                NamedSharding(mesh, P("g")))
             fn = shard_map(lambda v: jax.lax.all_gather(v, "g", tiled=True),
                            mesh=mesh, in_specs=P("g"), out_specs=P(None),
-                           check_vma=False)
+                           check_rep=False)
         elif op == "all2all":
             x = jax.device_put(jnp.ones((n, elems // n), jnp.float32),
                                NamedSharding(mesh, P("g", None)))
@@ -101,12 +103,12 @@ class HardwareProfiler:
                 lambda v: jax.lax.all_to_all(v, "g", split_axis=1,
                                              concat_axis=0, tiled=True),
                 mesh=mesh, in_specs=P("g", None), out_specs=P(None, "g"),
-                check_vma=False)
+                check_rep=False)
         elif op == "p2p":
             perm = [(i, (i + 1) % n) for i in range(n)]
             fn = shard_map(lambda v: jax.lax.ppermute(v, "g", perm),
                            mesh=mesh, in_specs=P(None), out_specs=P(None),
-                           check_vma=False)
+                           check_rep=False)
         else:
             raise ValueError(op)
         jfn = jax.jit(fn)
@@ -144,10 +146,25 @@ class HardwareProfiler:
             pp *= 2
         return out
 
+    def _sub_mb_sizes(self) -> List[float]:
+        """Sub-MB message sizes (MB) for the α (latency) fit: halvings of
+        start_mb down to sub_mb_floor_kb. Layer-wise TP puts per-collective
+        messages well under a megabyte, where the latency term dominates
+        ("Revisiting the Time Cost Model of AllReduce", PAPERS.md) — the
+        integer-MB sweep alone cannot see it."""
+        out: List[float] = []
+        kb = self.args.start_mb * 1024 // 2
+        while kb >= self.args.sub_mb_floor_kb:
+            out.append(kb / 1024.0)
+            kb //= 2
+        return sorted(out)
+
     def profile_sp_time(self) -> Dict[str, float]:
         """sp_time_*.json: all-reduce + all-to-all latency (ms) per group
         size per message size in MB (profile_allreduce.py latency mode +
-        profile_all2all.py)."""
+        profile_all2all.py), plus sub-MB all-reduce points under the
+        ``sub_`` prefix (KB-keyed; invisible to the legacy remap parsers,
+        consumed by :meth:`profile_alpha_beta`'s α-β fit)."""
         out: Dict[str, float] = {}
         sizes = []
         mb = self.args.start_mb
@@ -163,6 +180,54 @@ class HardwareProfiler:
             for mb in sizes:
                 out[f"all2all_size_{size}_{mb}MB_time"] = \
                     self._collective_ms("all2all", group, mb)
+            for mb in self._sub_mb_sizes():
+                kb = int(round(mb * 1024))
+                out[f"sub_allreduce_size_{size}_{kb}KB_time"] = \
+                    self._collective_ms("allreduce", group, mb)
+            size //= 2
+        return out
+
+    def profile_alpha_beta(self, sp_times: Optional[Dict[str, float]] = None
+                           ) -> Dict[str, float]:
+        """Latency-aware collective fit: per (group size, consecutiveness),
+        fit the allreduce time curve ``t(size) = α + size / β`` over the
+        sub-MB + integer-MB points and emit ``allreduce_size_{n}_consec_
+        {c}_alpha_ms`` / ``..._beta_mb_per_ms`` keys (merged into the
+        bandwidth JSON alongside the legacy keys — profiles.read_alpha_beta
+        parses them, legacy readers ignore them). Consecutive groups reuse
+        ``sp_times`` measurements when provided; non-consecutive (strided)
+        groups are measured here."""
+        fit_sizes = self._sub_mb_sizes() + [float(self.args.start_mb),
+                                            float(self.args.start_mb * 2),
+                                            float(self.args.start_mb * 4)]
+        out: Dict[str, float] = {}
+        size = self.world
+        while size >= 2:
+            for consec in ([1] if size == self.world else [1, 0]):
+                xs, ys = [], []
+                group = _group_devices(self.devices, size, bool(consec),
+                                       self.world)
+                for mb in fit_sizes:
+                    t = None
+                    if consec and sp_times is not None:
+                        if mb < 1:
+                            t = sp_times.get(
+                                f"sub_allreduce_size_{size}_"
+                                f"{int(round(mb * 1024))}KB_time")
+                        else:
+                            t = sp_times.get(
+                                f"allreduce_size_{size}_{int(mb)}MB_time")
+                    if t is None:
+                        t = self._collective_ms("allreduce", group, mb)
+                    xs.append(mb)
+                    ys.append(t)
+                slope, alpha = np.polyfit(xs, ys, 1)
+                alpha = max(float(alpha), 0.0)
+                beta = 1.0 / max(float(slope), 1e-9)
+                out[f"allreduce_size_{size}_consec_{consec}_alpha_ms"] = \
+                    round(alpha, 6)
+                out[f"allreduce_size_{size}_consec_{consec}_beta_mb_per_ms"] \
+                    = round(beta, 3)
             size //= 2
         return out
 
@@ -181,7 +246,7 @@ class HardwareProfiler:
         elems = int(message_mb * 1024 * 1024 // 4)
         x = jax.device_put(jnp.ones((elems,), jnp.float32),
                            NamedSharding(mesh, P(None)))
-        from jax import shard_map
+        from jax.experimental.shard_map import shard_map
 
         def compute_only(m):
             for _ in range(8):
@@ -189,7 +254,7 @@ class HardwareProfiler:
             return m
 
         @partial(shard_map, mesh=mesh, in_specs=(P(None, None), P(None)),
-                 out_specs=(P(None, None), P(None)), check_vma=False)
+                 out_specs=(P(None, None), P(None)), check_rep=False)
         def both(m, v):
             v = jax.lax.psum(v, "g")
             for _ in range(8):
@@ -201,7 +266,7 @@ class HardwareProfiler:
                           iters=self.args.profile_iters)
         comm_fn = jax.jit(shard_map(lambda v: jax.lax.psum(v, "g"), mesh=mesh,
                                     in_specs=P(None), out_specs=P(None),
-                                    check_vma=False))
+                                    check_rep=False))
         t_comm = _time_fn(comm_fn, x, warmup=self.args.warmup_iters,
                           iters=self.args.profile_iters)
         jboth = jax.jit(lambda m, v: both(m, v))
@@ -226,12 +291,15 @@ class HardwareProfiler:
         a = self.args
         out_dir = output_dir or a.output_dir
         tag = f"{a.num_nodes}nodes_{a.num_devices_per_node}gpus_per_node"
+        sp_times = self.profile_sp_time()
+        bandwidth = self.profile_allreduce_bandwidth()
+        # α-β pairs ride the bandwidth JSON next to the legacy keys
+        bandwidth.update(self.profile_alpha_beta(sp_times))
         paths = {}
         for name, cfg in [
-            (f"allreduce_bandwidth_{tag}.json",
-             self.profile_allreduce_bandwidth()),
+            (f"allreduce_bandwidth_{tag}.json", bandwidth),
             (f"p2p_bandwidth_{tag}.json", self.profile_p2p_bandwidth()),
-            (f"sp_time_{tag}.json", self.profile_sp_time()),
+            (f"sp_time_{tag}.json", sp_times),
             ("overlap_coefficient.json", self.profile_overlap_coefficient()),
         ]:
             path = os.path.join(out_dir, name)

@@ -203,6 +203,8 @@ class SearchEngine:
             allreduce_latency=hw.allreduce_latency,
             dispatch_us=self.args.dispatch_us,
             schedule_impl=self.args.pipeline_schedule_impl,
+            tp_alpha_beta=hw.alpha_beta,
+            tp_overlap=bool(self.args.tp_overlap),
         )
 
     # ---------------- outer loop ----------------

@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import json
 import os
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Any, Dict, List, Sequence, Tuple
 
 import numpy as np
@@ -80,6 +80,28 @@ def read_allreduce_bandwidth(config: Any, device_num: int
         bw[k] = np.inf
         coe[k] = 0.0
     return bw, coe
+
+
+def read_alpha_beta(config: Any) -> Dict[str, Tuple[float, float]]:
+    """Fitted latency-bandwidth pairs per (group size, consecutiveness)
+    from the allreduce-bandwidth JSON: ``allreduce_size_{n}_consec_{c}_
+    alpha_ms`` / ``..._beta_mb_per_ms`` keys (written by
+    ``hardware_profiler.profile_alpha_beta``) -> {"{n}_{c}": (α ms,
+    β MB/ms)}. Legacy bandwidth-only JSONs simply yield an empty dict —
+    the cost model then falls back to the measured latency tables, so old
+    profiles keep producing byte-identical golden costs."""
+    env = read_json(config) if isinstance(config, str) else config
+    out: Dict[str, Tuple[float, float]] = {}
+    for key, val in env.items():
+        if not (key.startswith("allreduce_size_")
+                and key.endswith("_alpha_ms")):
+            continue
+        parts = key.split("_")  # allreduce_size_{n}_consec_{c}_alpha_ms
+        n, c = parts[2], parts[4]
+        beta = env.get(f"allreduce_size_{n}_consec_{c}_beta_mb_per_ms")
+        if beta:
+            out[f"{n}_{c}"] = (float(val), float(beta))
+    return out
 
 
 def read_p2p_bandwidth(config: Any) -> Tuple[Dict[int, float], Dict[int, float]]:
@@ -154,6 +176,8 @@ class HardwareProfile:
     allreduce_latency: Dict[int, Dict[Any, float]]
     allgather_latency: Dict[int, Dict[Any, float]]
     all2all_latency: Dict[int, Dict[Any, float]]
+    # fitted α-β pairs per "{size}_{consec}" (empty for legacy profiles)
+    alpha_beta: Dict[str, Tuple[float, float]] = field(default_factory=dict)
 
 
 def load_hardware_profile(
@@ -167,6 +191,7 @@ def load_hardware_profile(
     """Read the four hardware_configs JSONs (reference
     get_profiled_hardware_configs, search_engine.py:419-462)."""
     bw, coe = read_allreduce_bandwidth(allreduce_path, world_size)
+    alpha_beta = read_alpha_beta(allreduce_path)
     p2p_bw, p2p_coe = read_p2p_bandwidth(p2p_path)
     overlap = read_json(overlap_path)["overlap_coe"]
     sp = read_json(sp_time_path)
@@ -181,6 +206,7 @@ def load_hardware_profile(
         allreduce_latency=remap_collective_latency(sp, "allreduce"),
         allgather_latency=remap_collective_latency(sp, "allgather"),
         all2all_latency=remap_collective_latency(sp, "all2all"),
+        alpha_beta=alpha_beta,
     )
 
 

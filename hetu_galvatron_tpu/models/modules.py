@@ -24,6 +24,12 @@ TPU-first design, deliberately unlike the torch reference:
   same layer runs XLA attention, a Pallas flash kernel, Ulysses all-to-all, or
   ring attention depending on the layer's strategy (reference dispatch:
   attention.py:664-720).
+* **Swappable projection matmuls.** ``apply_attention`` / ``apply_mlp`` take a
+  ``matmul_fns`` dict ({"qkv", "out"} / {"fc1", "fc2"}) so tensor-parallel
+  layers can run the decomposed ring all-gather/reduce-scatter matmuls
+  (ops/overlap.py) instead of leaving the collectives to GSPMD — same
+  per-layer dispatch idiom as ``sdpa_fn``. Each fn maps (x, w) to the fp32
+  product the default einsum would produce.
 """
 
 from __future__ import annotations
@@ -319,13 +325,18 @@ def apply_attention(
     causal: bool = True,
     dropout_rng: Optional[jax.Array] = None,
     segment_ids: Optional[jax.Array] = None,
+    matmul_fns: Optional[Dict[str, Callable]] = None,
 ) -> jax.Array:
     B, S, H = x.shape
     hd = cfg.head_dim
     nq, nkv = cfg.num_attention_heads, cfg.kv_heads
+    mm = matmul_fns or {}
     w = p["wqkv"].astype(compute_dtype)
-    qkv = jnp.einsum("bsh,hf->bsf", x.astype(compute_dtype), w,
-                     preferred_element_type=jnp.float32)
+    if "qkv" in mm:
+        qkv = mm["qkv"](x.astype(compute_dtype), w)
+    else:
+        qkv = jnp.einsum("bsh,hf->bsf", x.astype(compute_dtype), w,
+                         preferred_element_type=jnp.float32)
     if "bqkv" in p:
         qkv = qkv + p["bqkv"]
     qkv = qkv.astype(compute_dtype)
@@ -373,8 +384,12 @@ def apply_attention(
     else:
         out = sdpa_fn(q, k, v, causal=causal)
     out = out.reshape(B, S, nq * hd)
-    y = jnp.einsum("bsf,fh->bsh", out, p["wo"].astype(compute_dtype),
-                   preferred_element_type=jnp.float32)
+    wo = p["wo"].astype(compute_dtype)
+    if "out" in mm:
+        y = mm["out"](out, wo)
+    else:
+        y = jnp.einsum("bsf,fh->bsh", out, wo,
+                       preferred_element_type=jnp.float32)
     if "bo" in p:
         y = y + p["bo"]
     return y.astype(compute_dtype)
@@ -421,21 +436,45 @@ _ACTS = {
 
 
 def apply_mlp(p: Params, x: jax.Array, cfg: ModelArgs,
-              compute_dtype=jnp.bfloat16) -> jax.Array:
+              compute_dtype=jnp.bfloat16,
+              matmul_fns: Optional[Dict[str, Callable]] = None) -> jax.Array:
     act = _ACTS[cfg.hidden_act]
-    hproj = jnp.einsum("bsh,hf->bsf", x.astype(compute_dtype),
-                       p["win"].astype(compute_dtype),
-                       preferred_element_type=jnp.float32)
-    if "bin" in p:
-        hproj = hproj + p["bin"]
-    hproj = hproj.astype(compute_dtype)
-    if _is_gated(cfg.hidden_act):
-        gate, up = jnp.split(hproj, 2, axis=-1)
-        hproj = act(gate) * up
+    mm = matmul_fns or {}
+    win = p["win"].astype(compute_dtype)
+    gated = _is_gated(cfg.hidden_act)
+    if gated and "fc1_pair" in mm:
+        # overlapped gated fc1: one ring over both weight halves keeps the
+        # gate/up PRODUCT shard-aligned — splitting the fused [B, S, 2F]
+        # output globally resharded activations per token; the pair form
+        # pays only a weight-half reshard instead
+        # (ops/overlap.make_ag_matmul_pair)
+        F = p["wout"].shape[0]
+        gate, up = mm["fc1_pair"](x.astype(compute_dtype),
+                                  win[:, :F], win[:, F:])
+        if "bin" in p:
+            gate = gate + p["bin"][:F]
+            up = up + p["bin"][F:]
+        hproj = act(gate.astype(compute_dtype)) * up.astype(compute_dtype)
     else:
-        hproj = act(hproj)
-    y = jnp.einsum("bsf,fh->bsh", hproj, p["wout"].astype(compute_dtype),
-                   preferred_element_type=jnp.float32)
+        if "fc1" in mm:
+            hproj = mm["fc1"](x.astype(compute_dtype), win)
+        else:
+            hproj = jnp.einsum("bsh,hf->bsf", x.astype(compute_dtype), win,
+                               preferred_element_type=jnp.float32)
+        if "bin" in p:
+            hproj = hproj + p["bin"]
+        hproj = hproj.astype(compute_dtype)
+        if gated:
+            gate, up = jnp.split(hproj, 2, axis=-1)
+            hproj = act(gate) * up
+        else:
+            hproj = act(hproj)
+    wout = p["wout"].astype(compute_dtype)
+    if "fc2" in mm:
+        y = mm["fc2"](hproj, wout)
+    else:
+        y = jnp.einsum("bsf,fh->bsh", hproj, wout,
+                       preferred_element_type=jnp.float32)
     if "bout" in p:
         y = y + p["bout"]
     return y.astype(compute_dtype)
@@ -468,12 +507,15 @@ def apply_decoder_layer(
     causal: Optional[bool] = None,
     dropout_rng: Optional[jax.Array] = None,
     segment_ids: Optional[jax.Array] = None,
+    matmul_fns: Optional[Dict[str, Callable]] = None,
 ) -> jax.Array:
     """Pre-norm residual block (reference GalvatronDecoderLayer,
     modules.py:233). Encoder families (bert, t5 encoder stack) run the same
     block with bidirectional attention; ``causal=None`` derives from the
     model family. ``dropout_rng`` enables attention/hidden dropout
-    (HF semantics: sublayer output dropped before the residual add)."""
+    (HF semantics: sublayer output dropped before the residual add).
+    ``matmul_fns`` ({"qkv", "out", "fc1", "fc2"}) swaps the projection
+    matmuls for overlapped tensor-parallel impls (ops/overlap.py)."""
     if causal is None:
         causal = cfg.model_type != "bert"
     r_attn = r_res1 = r_res2 = None
@@ -492,22 +534,26 @@ def apply_decoder_layer(
                                        sdpa_fn=sdpa_fn,
                                        compute_dtype=compute_dtype,
                                        causal=causal, dropout_rng=r_attn,
-                                       segment_ids=segment_ids),
+                                       segment_ids=segment_ids,
+                                       matmul_fns=matmul_fns),
                        r_res1),
             cfg)
         return apply_norm(
             p["ln2"],
             x + drop_h(apply_mlp(p["mlp"], x, cfg,
-                                 compute_dtype=compute_dtype), r_res2),
+                                 compute_dtype=compute_dtype,
+                                 matmul_fns=matmul_fns), r_res2),
             cfg)
     h = apply_norm(p["ln1"], x, cfg)
     x = x + drop_h(apply_attention(p["attn"], h, cfg, rope=rope,
                                    sdpa_fn=sdpa_fn,
                                    compute_dtype=compute_dtype, causal=causal,
                                    dropout_rng=r_attn,
-                                   segment_ids=segment_ids), r_res1)
+                                   segment_ids=segment_ids,
+                                   matmul_fns=matmul_fns), r_res1)
     h = apply_norm(p["ln2"], x, cfg)
-    x = x + drop_h(apply_mlp(p["mlp"], h, cfg, compute_dtype=compute_dtype),
+    x = x + drop_h(apply_mlp(p["mlp"], h, cfg, compute_dtype=compute_dtype,
+                             matmul_fns=matmul_fns),
                    r_res2)
     return x
 

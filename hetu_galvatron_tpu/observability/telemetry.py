@@ -310,6 +310,26 @@ def plan_comm_volume(
     return out
 
 
+def plan_tp_overlap_hidden_frac(hpc, model, overlapped: Sequence[int],
+                                mixed_precision: bool = True) -> float:
+    """Predicted fraction of the plan's TP collective traffic hidden under
+    compute by the decomposed overlap matmuls: the volume-weighted share
+    (``plan_comm_volume``'s per-layer ``tp_collective_mb``) carried by the
+    layers actually running overlapped (``overlapped`` = indices where
+    ops/overlap.plan_overlap_reasons reported None). In the cost model's
+    compute-bound regime that traffic is hidden up to the overlap-slowdown
+    residue (cost_model.cost.tp_overlap_hidden_frac); this gauge reports
+    the coverage term, which needs no hardware profile at runtime."""
+    vols = plan_comm_volume(hpc.layers, model, global_bsz=hpc.global_bsz,
+                            chunks=max(hpc.chunks, 1),
+                            mixed_precision=mixed_precision)
+    total = sum(v["tp_collective_mb"] for v in vols)
+    if not total:
+        return 0.0
+    hidden = sum(vols[i]["tp_collective_mb"] for i in overlapped)
+    return hidden / total
+
+
 def emit_plan_telemetry(registry: MetricsRegistry, hpc, model,
                         mixed_precision: bool = True) -> None:
     """Emit the plan's predicted comm volume as ONE ``plan`` event at

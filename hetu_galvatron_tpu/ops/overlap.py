@@ -1,0 +1,370 @@
+"""Decomposed tensor-parallel collective matmuls: ring all-gather/
+reduce-scatter fused with the projection they feed, so the transfer hides
+behind dependent compute.
+
+Why: under GSPMD auto-partitioning every Megatron-SP layer runs
+<all-gather over sequence> -> <matmul> (column-parallel) and
+<matmul> -> <reduce-scatter over sequence> (row-parallel) as two
+dependent ops — the collective sits on the critical path. The decomposed
+form splits the sequence into one chunk per tp rank and `lax.ppermute`s
+chunks around the ring while each rank multiplies the chunk it already
+holds; XLA's latency-hiding scheduler overlaps the permute DMA with the
+chunk matmul, so only the first hop is exposed ("The Big Send-off",
+PAPERS.md; TransformerEngine's ring-exchange ag/rs overlap is the GPU
+analogue). The α-β cost model (cost_model/cost.py) prices this as the
+``tp_overlap`` discount.
+
+Discipline: full-manual ``shard_map`` over the layer's (dp, tp) mesh axes —
+the same shard_map style ``runtime/compiled_pipeline.py`` and the flash
+kernel wrapper use — with custom VJPs so the backward runs the transposed
+collectives ring-overlapped too:
+
+* :func:`make_ag_matmul` (column-parallel, e.g. qkv / MLP fc1):
+  x [B, S/tp, H] (sequence-sharded) x w [H, F/tp] -> y [B, S, F/tp];
+  bwd: dx = ring-reduce-scatter(dy @ w^T), dw = ring-ag(x)^T @ dy.
+* :func:`make_matmul_rs` (row-parallel, e.g. attn out / MLP fc2):
+  h [B, S, F/tp] x w [F/tp, H] -> y [B, S/tp, H] (partial products ring
+  reduce-scattered as they finish); bwd mirrors with the ag ring.
+
+Both are tolerance-identical to the GSPMD reference (the einsum paths in
+``models/modules.py``): fp32 accumulation, per-chunk matmuls, only the
+reduction ORDER across tp ranks differs (tests/kernels/test_tp_overlap.py
+pins fwd+bwd parity at tp∈{2,4} in bf16 and f32).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+from hetu_galvatron_tpu.runtime.mesh import axes_size as _axis_prod
+
+
+def _ring_perm(tp: int):
+    return [(i, (i + 1) % tp) for i in range(tp)]
+
+
+# ---------------------------------------------------------------------------
+# per-shard ring kernels (run inside shard_map; axes/tp are static)
+# ---------------------------------------------------------------------------
+
+
+def _ring_ag_matmul(x, w, axes, tp, with_gathered=False):
+    """Local: x [B, C, H] (this rank's sequence chunk), w [H, Fl] ->
+    y [B, tp*C, Fl] fp32 (plus the assembled [B, tp*C, H] gather when
+    ``with_gathered`` — the chunks pass through anyway, and saving them
+    lets the backward form dw with ZERO extra collectives, exactly like
+    GSPMD saving the gathered activation). Step t multiplies the chunk
+    currently held (origin rank (r - t) % tp) while the ppermute ships it
+    onward — the rotation is independent of the matmul, so the scheduler
+    overlaps them."""
+    r = jax.lax.axis_index(axes)
+    B, C, _ = x.shape
+    out = jnp.zeros((B, tp * C, w.shape[1]), jnp.float32)
+    gathered = jnp.zeros((B, tp * C, x.shape[2]), x.dtype) \
+        if with_gathered else None
+    perm = _ring_perm(tp)
+    cur = x
+    for t in range(tp):
+        c = (r - t) % tp  # origin chunk id of the block currently held
+        part = jnp.einsum("bch,hf->bcf", cur, w,
+                          preferred_element_type=jnp.float32)
+        out = jax.lax.dynamic_update_slice(out, part, (0, c * C, 0))
+        if with_gathered:
+            gathered = jax.lax.dynamic_update_slice(
+                gathered, cur, (0, c * C, 0))
+        if t < tp - 1:
+            cur = jax.lax.ppermute(cur, axes, perm)
+    return (out, gathered) if with_gathered else out
+
+
+def _ring_matmul_rs(h, w, axes, tp):
+    """Local: h [B, S, Fl], w [Fl, Hd] -> this rank's sequence chunk of
+    sum_over_ranks(h @ w): [B, S/tp, Hd] fp32. The partial-sum accumulator
+    for chunk c starts at rank (c+1) % tp and rides the ring, each rank
+    adding its partial product for that chunk as it passes through; the
+    add and the next hop overlap with the following chunk's matmul."""
+    r = jax.lax.axis_index(axes)
+    B, S, _ = h.shape
+    C = S // tp
+    perm = _ring_perm(tp)
+    acc = None
+    for t in range(tp):
+        c = (r - 1 - t) % tp  # chunk whose accumulator this rank holds now
+        blk = jax.lax.dynamic_slice(h, (0, c * C, 0), (B, C, h.shape[2]))
+        part = jnp.einsum("bcf,fh->bch", blk, w,
+                          preferred_element_type=jnp.float32)
+        acc = part if acc is None else (
+            jax.lax.ppermute(acc, axes, perm) + part)
+    return acc  # after tp-1 hops the chunk lands on its home rank r
+
+
+def _ring_ag_grads(dy, w, h, axes, tp):
+    """Fused backward ring for matmul_rs: ONE rotation of the cotangent
+    chunk dy [B, C, Hd] serves both outputs —
+    dh [B, tp*C, Fl] = all-gather(dy) @ w^T placed chunk-wise, and
+    dw [Fl, Hd] = h^T @ all-gather(dy) accumulated chunk-wise."""
+    r = jax.lax.axis_index(axes)
+    B, C, _ = dy.shape
+    Fl = w.shape[0]
+    dh = jnp.zeros((B, tp * C, Fl), jnp.float32)
+    dw = jnp.zeros((Fl, dy.shape[2]), jnp.float32)
+    perm = _ring_perm(tp)
+    cur = dy
+    for t in range(tp):
+        c = (r - t) % tp
+        part = jnp.einsum("bch,fh->bcf", cur, w,
+                          preferred_element_type=jnp.float32)
+        dh = jax.lax.dynamic_update_slice(dh, part, (0, c * C, 0))
+        h_c = jax.lax.dynamic_slice(h, (0, c * C, 0), (B, C, Fl))
+        dw = dw + jnp.einsum("bcf,bch->fh", h_c, cur,
+                             preferred_element_type=jnp.float32)
+        if t < tp - 1:
+            cur = jax.lax.ppermute(cur, axes, perm)
+    return dh, dw
+
+
+# ---------------------------------------------------------------------------
+# public builders
+# ---------------------------------------------------------------------------
+
+
+def make_ag_matmul(mesh: Mesh, dp_axes: Tuple[str, ...],
+                   tp_axes: Tuple[str, ...]) -> Callable:
+    """Column-parallel overlapped matmul: callable(x, w) with GLOBAL arrays
+    x [B, S, H] (batch over dp, sequence over tp) and w [H, F] (columns over
+    tp), returning fp32 [B, S, F] (features over tp) — the drop-in
+    replacement for ``all-gather(seq) -> einsum`` in apply_attention /
+    apply_mlp."""
+    tp = _axis_prod(mesh, tp_axes)
+    axes = tuple(tp_axes)
+
+    @jax.custom_vjp
+    def local(x, w):
+        return _ring_ag_matmul(x, w, axes, tp)
+
+    def fwd(x, w):
+        # save the ring-gathered activation (it passes through anyway):
+        # dw then needs no collectives at all, matching GSPMD's
+        # save-the-gather backward
+        y, x_full = _ring_ag_matmul(x, w, axes, tp, with_gathered=True)
+        return y, (x_full, w)
+
+    def bwd(res, dy):
+        x_full, w = res
+        # dx = reduce-scatter(dy @ w^T) over sequence — the rs ring with
+        # the transposed weight; dw is collective-free off the saved gather
+        # (the gather keeps x's dtype, so the casts below stay primal-exact)
+        dx = _ring_matmul_rs(dy, w.T, axes, tp).astype(x_full.dtype)
+        dw = jnp.einsum("bsh,bsf->hf", x_full, dy,
+                        preferred_element_type=jnp.float32).astype(w.dtype)
+        return dx, dw
+
+    local.defvjp(fwd, bwd)
+    x_spec = P(dp_axes or None, axes, None)
+    w_spec = P(None, axes)
+    y_spec = P(dp_axes or None, None, axes)
+    return shard_map(local, mesh, in_specs=(x_spec, w_spec),
+                     out_specs=y_spec, check_rep=False)
+
+
+def make_ag_matmul_pair(mesh: Mesh, dp_axes: Tuple[str, ...],
+                        tp_axes: Tuple[str, ...]) -> Callable:
+    """Gated-MLP fc1: callable(x, w_gate, w_up) -> (gate, up), both fp32
+    [B, S, F] with features over tp, from ONE ring rotation (each held
+    chunk multiplies both weight halves). Splitting the FUSED [H, 2F]
+    product globally instead would reshard the ACTIVATION: a tp shard of
+    the fused layout holds contiguous columns of [gate | up], so the
+    global split crosses shard boundaries and GSPMD pays a per-token
+    collective to realign. The pair form moves that realignment to the
+    weight halves instead (slicing the fused param re-shards each [H, F]
+    half over tp) — weights are a per-step constant-size transfer, far
+    smaller than the [B, S, F] activations, and the bench showed the swap
+    is worth 30-50%% of step time at tp4/swiglu."""
+    tp = _axis_prod(mesh, tp_axes)
+    axes = tuple(tp_axes)
+
+    def _pair_body(x, wg, wu, with_gathered=False):
+        r = jax.lax.axis_index(axes)
+        B, C, _ = x.shape
+        g = jnp.zeros((B, tp * C, wg.shape[1]), jnp.float32)
+        u = jnp.zeros((B, tp * C, wu.shape[1]), jnp.float32)
+        gathered = jnp.zeros((B, tp * C, x.shape[2]), x.dtype) \
+            if with_gathered else None
+        perm = _ring_perm(tp)
+        cur = x
+        for t in range(tp):
+            c = (r - t) % tp
+            g = jax.lax.dynamic_update_slice(
+                g, jnp.einsum("bch,hf->bcf", cur, wg,
+                              preferred_element_type=jnp.float32),
+                (0, c * C, 0))
+            u = jax.lax.dynamic_update_slice(
+                u, jnp.einsum("bch,hf->bcf", cur, wu,
+                              preferred_element_type=jnp.float32),
+                (0, c * C, 0))
+            if with_gathered:
+                gathered = jax.lax.dynamic_update_slice(
+                    gathered, cur, (0, c * C, 0))
+            if t < tp - 1:
+                cur = jax.lax.ppermute(cur, axes, perm)
+        return g, u, gathered
+
+    @jax.custom_vjp
+    def local(x, wg, wu):
+        g, u, _ = _pair_body(x, wg, wu)
+        return g, u
+
+    def fwd(x, wg, wu):
+        g, u, x_full = _pair_body(x, wg, wu, with_gathered=True)
+        return (g, u), (x_full, wg, wu)
+
+    def bwd(res, dys):
+        x_full, wg, wu = res
+        dg, du = dys
+        # dx: ONE rs ring whose per-chunk partial sums both halves'
+        # products; dw halves are collective-free off the saved gather
+        r = jax.lax.axis_index(axes)
+        B, S, _ = dg.shape
+        C = S // tp
+        perm = _ring_perm(tp)
+        acc = None
+        for t in range(tp):
+            c = (r - 1 - t) % tp
+            g_c = jax.lax.dynamic_slice(dg, (0, c * C, 0),
+                                        (B, C, dg.shape[2]))
+            u_c = jax.lax.dynamic_slice(du, (0, c * C, 0),
+                                        (B, C, du.shape[2]))
+            part = (jnp.einsum("bcf,hf->bch", g_c, wg,
+                               preferred_element_type=jnp.float32)
+                    + jnp.einsum("bcf,hf->bch", u_c, wu,
+                                 preferred_element_type=jnp.float32))
+            acc = part if acc is None else (
+                jax.lax.ppermute(acc, axes, perm) + part)
+        dx = acc.astype(x_full.dtype)
+        dwg = jnp.einsum("bsh,bsf->hf", x_full, dg,
+                         preferred_element_type=jnp.float32).astype(wg.dtype)
+        dwu = jnp.einsum("bsh,bsf->hf", x_full, du,
+                         preferred_element_type=jnp.float32).astype(wu.dtype)
+        return dx, dwg, dwu
+
+    local.defvjp(fwd, bwd)
+    x_spec = P(dp_axes or None, axes, None)
+    w_spec = P(None, axes)
+    y_spec = P(dp_axes or None, None, axes)
+    return shard_map(local, mesh, in_specs=(x_spec, w_spec, w_spec),
+                     out_specs=(y_spec, y_spec), check_rep=False)
+
+
+def make_matmul_rs(mesh: Mesh, dp_axes: Tuple[str, ...],
+                   tp_axes: Tuple[str, ...]) -> Callable:
+    """Row-parallel overlapped matmul: callable(h, w) with GLOBAL arrays
+    h [B, S, F] (features over tp) and w [F, H] (rows over tp), returning
+    fp32 [B, S, H] (sequence over tp) — replacing
+    ``einsum -> reduce-scatter(seq)``."""
+    tp = _axis_prod(mesh, tp_axes)
+    axes = tuple(tp_axes)
+
+    @jax.custom_vjp
+    def local(h, w):
+        return _ring_matmul_rs(h, w, axes, tp)
+
+    def fwd(h, w):
+        return _ring_matmul_rs(h, w, axes, tp), (h, w)
+
+    def bwd(res, dy):
+        h, w = res
+        # one fused ring rotation of dy yields both dh = all-gather(dy) @
+        # w^T and dw = h^T @ all-gather(dy)
+        dh, dw = _ring_ag_grads(dy, w, h, axes, tp)
+        return dh.astype(h.dtype), dw.astype(w.dtype)
+
+    local.defvjp(fwd, bwd)
+    h_spec = P(dp_axes or None, None, axes)
+    w_spec = P(axes, None)
+    y_spec = P(dp_axes or None, axes, None)
+    return shard_map(local, mesh, in_specs=(h_spec, w_spec),
+                     out_specs=y_spec, check_rep=False)
+
+
+# ---------------------------------------------------------------------------
+# per-layer eligibility + dispatch
+# ---------------------------------------------------------------------------
+
+
+# shared fallback-reason strings: the launcher's plan-level logging
+# (plan_overlap_reasons) and the actual dispatch (parallel/spmd.py
+# tp_overlap_overrides) must report the SAME reasons
+T5_REASON = "t5 encoder-decoder layers keep the GSPMD projection path"
+MOE_REASON = ("MoE layer: expert matmuls route through the ep/etp "
+              "dispatcher, not the dense projections")
+
+
+def layer_overlap_reason(cfg: Any, sharding: Any, tp: int,
+                         seq_len: Optional[int] = None) -> Optional[str]:
+    """Why this layer cannot run the decomposed overlap path (None =
+    eligible). Mirrors ``CompiledPipelineEngine.unsupported_reason`` style:
+    the caller logs the reason and falls back to GSPMD."""
+    if getattr(sharding, "ulysses", False):
+        return ("ulysses layer: the tp axes carry sequence (all-to-all "
+                "attention), not weight shards")
+    if tp <= 1:
+        return "tp == 1 (no tensor-parallel collectives to overlap)"
+    if getattr(sharding, "cp_axes", ()):
+        return ("cp layer: the boundary activation is sequence-sharded "
+                "over cp, not tp (ring attention owns the sequence axis)")
+    seq = seq_len if seq_len is not None else cfg.seq_length
+    if seq % tp:
+        return (f"tp {tp} does not divide the sequence length {seq} into "
+                "ring chunks")
+    hd, nq, nkv = cfg.head_dim, cfg.num_attention_heads, cfg.kv_heads
+    if ((nq + 2 * nkv) * hd) % tp or (nq * hd) % tp:
+        return f"tp {tp} does not divide the qkv/out projection widths"
+    f = cfg.ffn_dim
+    gated = cfg.hidden_act in ("swiglu", "geglu")
+    if f % tp or (gated and (2 * f) % tp):
+        return f"tp {tp} does not divide the MLP width {f}"
+    return None
+
+
+def plan_overlap_reasons(cfg: Any, hpc: Any) -> list:
+    """Per-layer eligibility from the PLAN alone (``hpc.layers``
+    LayerStrategy rows; no mesh needed) — the launcher's logging/telemetry
+    view of what :func:`~hetu_galvatron_tpu.parallel.spmd.
+    tp_overlap_overrides` will dispatch. Returns [(layer index,
+    reason-or-None)]; reason None = the layer runs overlapped."""
+    from types import SimpleNamespace
+
+    from hetu_galvatron_tpu.models.moe import is_moe_layer
+
+    out = []
+    for i, s in enumerate(hpc.layers):
+        if cfg.model_type == "t5":
+            out.append((i, T5_REASON))
+            continue
+        if is_moe_layer(cfg, i):
+            out.append((i, MOE_REASON))
+            continue
+        shim = SimpleNamespace(ulysses=s.sp,
+                               cp_axes=("cp",) if s.cp_size > 1 else ())
+        out.append((i, layer_overlap_reason(cfg, shim, s.tp_size)))
+    return out
+
+
+def make_layer_matmuls(mesh: Mesh, dp_axes: Tuple[str, ...],
+                       tp_axes: Tuple[str, ...]) -> Dict[str, Callable]:
+    """The projection matmuls of one decoder layer as overlapped
+    ring-decomposed fns (``matmul_fns`` for modules.apply_decoder_layer):
+    column-parallel qkv/fc1 share one ag_matmul, row-parallel out/fc2 share
+    one matmul_rs (the builders are shape-polymorphic), and gated MLPs use
+    the shard-aligned ``fc1_pair`` instead of splitting the fused product
+    globally."""
+    ag = make_ag_matmul(mesh, dp_axes, tp_axes)
+    rs = make_matmul_rs(mesh, dp_axes, tp_axes)
+    pair = make_ag_matmul_pair(mesh, dp_axes, tp_axes)
+    return {"qkv": ag, "out": rs, "fc1": ag, "fc2": rs, "fc1_pair": pair}

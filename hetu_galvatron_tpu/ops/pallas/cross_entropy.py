@@ -26,6 +26,11 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# pin-compat: the CompilerParams dataclass was named TPUCompilerParams on
+# older jax releases (this toolchain's pin); same fields either way
+_CompilerParams = getattr(pltpu, "CompilerParams",
+                          getattr(pltpu, "TPUCompilerParams", None))
+
 NEG_INF = float(jnp.finfo(jnp.float32).min)
 
 
@@ -107,7 +112,7 @@ def _ce_fwd_call(logits, labels2d, *, block_t, block_v, interpret):
             pltpu.VMEM((block_t,), jnp.float32),
             pltpu.VMEM((block_t,), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
     )(logits, labels2d)
@@ -130,7 +135,7 @@ def _ce_bwd_call(logits, labels2d, lse, a, b, *, block_t, block_v,
         ],
         out_specs=pl.BlockSpec((block_t, block_v), lambda t, v: (t, v)),
         out_shape=jax.ShapeDtypeStruct((T, V), logits.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel")),
         interpret=interpret,
     )(logits, labels2d, lse, a, b)
@@ -261,9 +266,11 @@ def make_vocab_parallel_ce(mesh, vocab_sharding, *, z_loss: float = 0.0,
                 nll = nll + z_loss * jnp.square(lse[:, 0])
             return nll.reshape(Bl, Sl)
 
-        return jax.shard_map(local, mesh=mesh,
+        from jax.experimental.shard_map import shard_map
+
+        return shard_map(local, mesh=mesh,
                              in_specs=(logits_spec, labels_spec),
                              out_specs=labels_spec,
-                             check_vma=False)(logits, labels)
+                             check_rep=False)(logits, labels)
 
     return nll_fn

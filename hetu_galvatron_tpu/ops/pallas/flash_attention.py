@@ -24,10 +24,15 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# pin-compat: the CompilerParams dataclass was named TPUCompilerParams on
+# older jax releases (this toolchain's pin); same fields either way
+_CompilerParams = getattr(pltpu, "CompilerParams",
+                          getattr(pltpu, "TPUCompilerParams", None))
+
 NEG_INF = float(jnp.finfo(jnp.float32).min)
 
 
-def keep_mask(seed, bn, qpos, kpos, s_total: int, rate: float):
+def keep_mask(seed, bn, qpos, kpos, rate: float):
     """Deterministic counter-based dropout keep-mask (splitmix32 finalizer
     chain over global coordinates). Depends only on GLOBAL coordinates
     (seed, batch*heads index, q position, k position), so forward/backward
@@ -38,18 +43,17 @@ def keep_mask(seed, bn, qpos, kpos, s_total: int, rate: float):
     the test reference. qpos/kpos are int32 arrays broadcastable to the
     mask shape; returns bool (True = keep).
 
-    ``s_total`` is unused by the hash and kept only for call-site
-    compatibility: qpos and kpos are mixed through SEPARATE finalizer
-    rounds instead of a linear ``qpos * s_total + kpos`` counter, which
-    wrapped uint32 once s_total exceeded 2**16 (S^2 >= 2^32) and aliased
-    masks between distant (qpos, kpos) pairs within one head. With the
-    chained mix there is no sequence-length bound; distinct coordinate
-    pairs collide only by hash accident, like head streams."""
+    There is no sequence-length bound: qpos and kpos are mixed through
+    SEPARATE finalizer rounds rather than a linear ``qpos * S + kpos``
+    counter (which wrapped uint32 once S exceeded 2**16 and aliased masks
+    between distant (qpos, kpos) pairs within one head — the PR 1 fix), so
+    distinct coordinate pairs collide only by hash accident, like head
+    streams. The old ``s_total`` parameter that rode along for call-site
+    compatibility is gone."""
     import numpy as np
 
     # numpy scalar literals (NOT jnp arrays): closed-over jnp constants are
     # rejected by the pallas_call lowering
-    del s_total  # no longer bounds validity; see docstring
     u32 = jnp.uint32
     c = np.uint32
 
@@ -71,18 +75,18 @@ def keep_mask(seed, bn, qpos, kpos, s_total: int, rate: float):
 
 
 def _tile_keep(seed_ref, bn, qi, ki, block_q: int, block_k: int,
-               s_total: int, rate: float):
+               rate: float):
     qpos = qi * block_q + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0)
     kpos = ki * block_k + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 1)
-    return keep_mask(seed_ref[0], bn, qpos, kpos, s_total, rate)
+    return keep_mask(seed_ref[0], bn, qpos, kpos, rate)
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, *rest,
                   block_q: int, block_k: int, num_k: int, causal: bool,
                   scale: float, has_seg: bool = False,
-                  dropout_rate: float = 0.0, s_total: int = 0):
+                  dropout_rate: float = 0.0):
     if dropout_rate > 0.0:
         seed_ref, rest = rest[0], rest[1:]
     else:
@@ -137,7 +141,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, *rest,
         l_ref[...] = l_ref[...] * corr + jnp.sum(p, axis=1)
         if dropout_rate > 0.0:
             keep = _tile_keep(seed_ref, bn, qi, ki, block_q, block_k,
-                              s_total, dropout_rate)
+                              dropout_rate)
             p = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
         acc_ref[...] = acc_ref[...] * corr[:, None] + jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())),
@@ -190,7 +194,7 @@ def flash_attention_hmajor(
     kernel = functools.partial(
         _flash_kernel, block_q=block_q, block_k=block_k, num_k=num_k,
         causal=causal, scale=1.0 / math.sqrt(D), has_seg=has_seg,
-        dropout_rate=dropout_rate, s_total=Sk)
+        dropout_rate=dropout_rate)
     in_specs = [
         pl.BlockSpec((1, 1, block_q, D),
                      lambda b, n, qi, ki: (b, n, qi, 0)),
@@ -234,7 +238,7 @@ def flash_attention_hmajor(
         ],
         # only the k-block axis carries loop state (the online softmax);
         # everything else may be reordered/partitioned by Mosaic
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,
@@ -245,7 +249,7 @@ def _flash_bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                            *rest, block_q: int, block_k: int, num_q: int,
                            G: int, causal: bool, scale: float,
                            has_seg: bool = False,
-                           dropout_rate: float = 0.0, s_total: int = 0):
+                           dropout_rate: float = 0.0):
     """Grid (B, KV, kb, G, qb): accumulate dk/dv for one k/v tile across the
     G query heads of this kv head and all q blocks."""
     if dropout_rate > 0.0:
@@ -298,7 +302,7 @@ def _flash_bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         if dropout_rate > 0.0:
             # mask is (qpos, kpos)-indexed; this kernel's tile is q=qb, k=kb
             keep = _tile_keep(seed_ref, bn, qb, kb, block_q, block_k,
-                              s_total, dropout_rate)
+                              dropout_rate)
             pd = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
             dp = jnp.where(keep, dp / (1.0 - dropout_rate), 0.0)
         dv_acc[...] += jax.lax.dot_general(
@@ -321,7 +325,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                          *rest, block_q: int, block_k: int,
                          num_k: int, causal: bool, scale: float,
                          has_seg: bool = False,
-                         dropout_rate: float = 0.0, s_total: int = 0):
+                         dropout_rate: float = 0.0):
     """Grid (B, N, qb, kb): accumulate dq for one q tile across k blocks."""
     if dropout_rate > 0.0:
         seed_ref, rest = rest[0], rest[1:]
@@ -367,7 +371,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                                  preferred_element_type=jnp.float32)
         if dropout_rate > 0.0:
             keep = _tile_keep(seed_ref, bn, qb, kb,
-                              block_q, block_k, s_total, dropout_rate)
+                              block_q, block_k, dropout_rate)
             dp = jnp.where(keep, dp / (1.0 - dropout_rate), 0.0)
         ds = p * (dp - delta) * scale
         dq_acc[...] += jax.lax.dot_general(
@@ -446,7 +450,7 @@ def flash_attention_bwd_hmajor(
         functools.partial(_flash_bwd_dkdv_kernel, block_q=block_q,
                           block_k=block_k, num_q=num_q, G=G, causal=causal,
                           scale=scale, has_seg=has_seg,
-                          dropout_rate=dropout_rate, s_total=Sk),
+                          dropout_rate=dropout_rate),
         grid=(B, KV, num_k, G, num_q),
         in_specs=dkdv_in_specs,
         out_specs=[
@@ -464,7 +468,7 @@ def flash_attention_bwd_hmajor(
             pltpu.VMEM((block_k, D), jnp.float32),
         ],
         # dk/dv accumulate across the (g, qb) axes; kb tiles are independent
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary", "arbitrary")),
         interpret=interpret,
@@ -498,7 +502,7 @@ def flash_attention_bwd_hmajor(
         functools.partial(_flash_bwd_dq_kernel, block_q=block_q,
                           block_k=block_k, num_k=num_k, causal=causal,
                           scale=scale, has_seg=has_seg,
-                          dropout_rate=dropout_rate, s_total=Sk),
+                          dropout_rate=dropout_rate),
         grid=(B, N, num_q, num_k),
         in_specs=dq_in_specs,
         out_specs=pl.BlockSpec((1, 1, block_q, D),
@@ -506,7 +510,7 @@ def flash_attention_bwd_hmajor(
         out_shape=jax.ShapeDtypeStruct((B, N, S, D), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
         # dq accumulates across k blocks only
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,
@@ -679,8 +683,10 @@ def make_flash_sdpa(mesh, dp_axes=(), tp_axes=(), *, interpret: bool = False):
             return _flash_with_vjp(a, b, c, s, sd, causal, interpret,
                                    bq, bk, dropout_rate)
 
-        fn = jax.shard_map(local, mesh=mesh, in_specs=tuple(in_specs),
-                           out_specs=spec, check_vma=False)
+        from jax.experimental.shard_map import shard_map
+
+        fn = shard_map(local, mesh=mesh, in_specs=tuple(in_specs),
+                       out_specs=spec, check_rep=False)
         return fn(*operands)
 
     sdpa.supports_segments = True

@@ -93,10 +93,12 @@ def _ring_body(step, carry, *, q, qseg, my_idx, cp, causal, zigzag, axis):
     return o, m, l, k, v, kseg
 
 
-def _ring_attention_local(q, k, v, seg=None, *, axis, causal, zigzag=False):
+def _ring_attention_local(q, k, v, seg=None, *, axis, cp, causal,
+                          zigzag=False):
     """Per-shard kernel under shard_map: q/k/v are the local sequence blocks
-    [B, S/cp, N|K, D]; ``seg`` [B, S/cp] packed-document segment ids."""
-    cp = jax.lax.axis_size(axis)
+    [B, S/cp, N|K, D]; ``seg`` [B, S/cp] packed-document segment ids.
+    ``cp`` is the static ring size (this jax pin has no jax.lax.axis_size;
+    the caller knows it from the mesh anyway)."""
     my_idx = jax.lax.axis_index(axis)
     B, Sq, N, D = q.shape
     K = k.shape[2]
@@ -404,14 +406,16 @@ def make_ring_sdpa(
             # packed documents ride the dense fold: k-side segment ids
             # rotate with their k/v block; the flash-in-ring kernels would
             # need unequal-length q/k segment operands (future work)
-            local = partial(_ring_attention_local, axis=axis, causal=causal,
-                            zigzag=zigzag)
+            local = partial(_ring_attention_local, axis=axis, cp=cp,
+                            causal=causal, zigzag=zigzag)
         seg_spec = P(spec[0], cp_axes)
         in_specs = (spec, spec, spec) + ((seg_spec,) if has_seg else ())
-        fn = jax.shard_map(
+        from jax.experimental.shard_map import shard_map
+
+        fn = shard_map(
             local,
             mesh=mesh, in_specs=in_specs, out_specs=spec,
-            check_vma=False)
+            check_rep=False)
         relayout = zigzag and not data_zigzagged
         if relayout:
             q, k, v = (zigzag_layout(t, cp) for t in (q, k, v))

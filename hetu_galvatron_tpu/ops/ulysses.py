@@ -81,11 +81,13 @@ def make_ulysses_sdpa(
             v = jnp.repeat(v, rep, axis=2)
 
         def run(inner):
-            return jax.shard_map(
+            from jax.experimental.shard_map import shard_map
+
+            return shard_map(
                 partial(_ulysses_local, axis=axis, causal=causal,
                         local_sdpa=inner),
                 mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-                check_vma=False)(q, k, v)
+                check_rep=False)(q, k, v)
         if core is not xla_sdpa:
             try:
                 return run(core)  # e.g. flash: may reject untileable shapes

@@ -173,6 +173,52 @@ def attention_overrides(
     return out
 
 
+def tp_overlap_overrides(
+    per_layer: List[LayerSharding],
+    mesh: Mesh,
+    cfg: ModelArgs,
+    *,
+    is_moe_layer_fn: Optional[Any] = None,
+) -> Tuple[Dict[int, Dict[str, Any]], List[Tuple[int, str]]]:
+    """Per-layer overlapped-TP matmul dispatch (the ``matmul_fns`` analogue
+    of :func:`attention_overrides`): eligible Megatron-TP layers get the
+    decomposed ring all-gather/reduce-scatter matmuls (ops/overlap.py);
+    everything else stays on GSPMD. Returns (overrides, fallbacks) where
+    ``fallbacks`` lists (layer index, unsupported_reason) for layers the
+    caller asked to overlap but could not — the launcher logs them."""
+    from hetu_galvatron_tpu.models.moe import is_moe_layer
+    from hetu_galvatron_tpu.ops.overlap import (
+        MOE_REASON,
+        T5_REASON,
+        layer_overlap_reason,
+        make_layer_matmuls,
+    )
+    from hetu_galvatron_tpu.runtime.mesh import axes_size
+
+    moe_of = is_moe_layer_fn or is_moe_layer
+    out: Dict[int, Dict[str, Any]] = {}
+    fallbacks: List[Tuple[int, str]] = []
+    cache: Dict[Tuple, Dict[str, Any]] = {}
+    for i, sh in enumerate(per_layer):
+        if cfg.model_type == "t5":
+            fallbacks.append((i, T5_REASON))
+            continue
+        if moe_of(cfg, i):
+            fallbacks.append((i, MOE_REASON))
+            continue
+        tp_axes = sh.weight_tp_axes
+        reason = layer_overlap_reason(cfg, sh, axes_size(mesh, tp_axes))
+        if reason is not None:
+            fallbacks.append((i, reason))
+            continue
+        key = (sh.dp_axes, tp_axes)
+        if key not in cache:
+            cache[key] = {"matmul_fns": make_layer_matmuls(
+                mesh, sh.dp_axes, tp_axes)}
+        out[i] = cache[key]
+    return out, fallbacks
+
+
 def make_boundary_fn(
     per_layer: List[LayerSharding],
     vocab: LayerSharding,
@@ -254,11 +300,16 @@ def build_spmd_loss_fn(
     compute_dtype=jnp.bfloat16,
     layer_overrides: Optional[Dict[int, Dict[str, Any]]] = None,
     with_moe_stats: bool = False,
+    tp_overlap: bool = False,
 ):
     """The plan-lowered loss closure shared by the train and eval steps:
     per-layer shardings, boundary constraints, attention-impl dispatch,
     remat flags, fused CE, and the ZeRO-3 embed use-site constraint.
-    Returns (loss_fn, pspecs, batch_shd, per_layer, vocab, enc_per)."""
+    Returns (loss_fn, pspecs, batch_shd, per_layer, vocab, enc_per).
+    ``tp_overlap`` swaps eligible Megatron-TP layers' projection matmuls
+    for the decomposed ring collectives (:func:`tp_overlap_overrides`);
+    ineligible layers silently keep GSPMD — the launcher logs the reasons.
+    """
     enc_per, per_layer, vocab, pspecs = _lower_specs(hpc, mesh, axes_tree)
     boundary = make_boundary_fn(per_layer, vocab, mesh)
     enc_boundary = (make_boundary_fn(enc_per, vocab, mesh)
@@ -270,6 +321,12 @@ def build_spmd_loss_fn(
         cp_zigzag=getattr(hpc, "cp_zigzag", False))
     enc_overrides = (attention_overrides(enc_per, mesh, use_flash=use_flash)
                      if enc_per else None)
+    if tp_overlap:
+        overlap_ov, _ = tp_overlap_overrides(per_layer, mesh, cfg)
+        # merged UNDER ring/caller overrides per key: an explicit
+        # sdpa_fn/matmul_fns from either always wins
+        for i, kw in overlap_ov.items():
+            ring[i] = {**kw, **ring.get(i, {})}
     if ring:
         # per-key merge: a caller override on a cp layer must not drop the
         # ring sdpa_fn unless it sets sdpa_fn itself
@@ -325,6 +382,7 @@ def make_spmd_eval_step(
     *,
     compute_dtype=jnp.bfloat16,
     layer_overrides: Optional[Dict[int, Dict[str, Any]]] = None,
+    tp_overlap: bool = False,
 ):
     """Jitted held-out loss under the SAME plan shardings as training
     (reference evaluate(), training.py side of dataloader.py:462): no
@@ -336,7 +394,7 @@ def make_spmd_eval_step(
                          "PipelineEngine.eval_step for pp>1")
     loss_fn, pspecs, batch_shd, _, _, _ = build_spmd_loss_fn(
         cfg, hpc, mesh, axes_tree, compute_dtype=compute_dtype,
-        layer_overrides=layer_overrides)
+        layer_overrides=layer_overrides, tp_overlap=tp_overlap)
     nshd = jax.tree.map(
         lambda s: NamedSharding(mesh, s), pspecs,
         is_leaf=lambda x: isinstance(x, P))
@@ -355,6 +413,7 @@ def make_spmd_train_step(
     layer_overrides: Optional[Dict[int, Dict[str, Any]]] = None,
     donate: bool = True,
     chunks: Optional[int] = None,
+    tp_overlap: bool = False,
 ):
     """Build the jitted hybrid-parallel train step (no pipeline; pp=1).
 
@@ -363,6 +422,8 @@ def make_spmd_train_step(
     ``batch_shd``. The pipeline engine (pp>1) wraps this per-stage.
     ``chunks`` overrides the plan's microbatch count (batch-size ramp:
     the launcher rebuilds the step per chunk count at a fixed micro size).
+    ``tp_overlap`` runs eligible TP layers' projections as decomposed
+    ring-collective matmuls (ops/overlap.py).
     """
     if hpc.pp_deg != 1:
         raise ValueError("make_spmd_train_step is the pp=1 path; use the "
@@ -371,7 +432,8 @@ def make_spmd_train_step(
     loss_fn, pspecs, batch_shd, per_layer, vocab, enc_per = (
         build_spmd_loss_fn(
             cfg, hpc, mesh, axes_tree, compute_dtype=compute_dtype,
-            layer_overrides=layer_overrides, with_moe_stats=moe_stats))
+            layer_overrides=layer_overrides, with_moe_stats=moe_stats,
+            tp_overlap=tp_overlap))
     opt_pspecs = param_specs(axes_tree, per_layer, vocab, opt=True,
                              enc_per_layer=enc_per or None)
     opt_specs = opt_state_specs(tx, params, opt_pspecs)

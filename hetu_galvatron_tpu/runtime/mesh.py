@@ -144,6 +144,16 @@ def stage_axes(mesh: Mesh) -> Tuple[str, ...]:
     return tuple(n for n in mesh.axis_names if n != "pp")
 
 
+def axes_size(mesh: Mesh, axes: Sequence[str]) -> int:
+    """Product of the named mesh axes' sizes (1 for the empty tuple) — the
+    degree a (dp/cp/tp) axis-tuple assignment actually carries. Shared by
+    the SPMD lowering and the overlapped-TP dispatch (ops/overlap.py)."""
+    n = 1
+    for a in axes:
+        n *= mesh.shape[a]
+    return n
+
+
 def spec_tree(axes: Any, sh: "LayerSharding", opt: bool = False) -> Any:
     """Map a logical-axis pytree (tuples of axis-name strings at the leaves,
     models/modules.py init_*) to PartitionSpecs under one layer's sharding.
@@ -218,6 +228,14 @@ class LayerSharding:
 
     def _weight_axes(self) -> Tuple[str, ...]:
         return () if self.ulysses else self.tp_axes
+
+    @property
+    def weight_tp_axes(self) -> Tuple[str, ...]:
+        """The mesh axes actually sharding this layer's WEIGHTS — () under
+        Ulysses, where the tp axes carry sequence instead. The overlapped-TP
+        dispatch keys off this (a layer with no weight-tp axes has no
+        collective-vs-matmul pair to decompose)."""
+        return self._weight_axes()
 
     def param_spec(self, logical_axes: Tuple[str, ...],
                    zero3_override: Optional[bool] = None) -> P:

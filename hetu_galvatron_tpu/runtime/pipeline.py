@@ -111,11 +111,16 @@ class PipelineEngine:
         *,
         compute_dtype=jnp.bfloat16,
         dcn_slices: int = 1,
+        tp_overlap: bool = False,
     ):
         self.cfg = cfg
         self.hpc = hpc
         self.train = train
         self.compute_dtype = compute_dtype
+        # overlapped-TP projection matmuls inside the stage programs
+        # (ops/overlap.py); eligible layers only — same dispatch as the
+        # SPMD path's tp_overlap_overrides, per stage submesh
+        self.tp_overlap = tp_overlap
         self.pp = hpc.pp_deg
         if self.pp < 2:
             # pp=1 routes through the SPMD path (cli/train_dist.py). The
@@ -381,6 +386,17 @@ class PipelineEngine:
             st.shardings, st.mesh,
             use_flash=None if cfg.use_flash_attn else False,
             cp_zigzag=getattr(self.hpc, "cp_zigzag", False))
+        if self.tp_overlap:
+            from hetu_galvatron_tpu.parallel.spmd import tp_overlap_overrides
+
+            # MoE detection must look at THIS stage's param slice — the
+            # global moe_layer_freq alternation is invisible to stage-local
+            # indices
+            ov, _ = tp_overlap_overrides(
+                st.shardings, st.mesh, cfg,
+                is_moe_layer_fn=lambda _c, j: "moe" in sp["layers"][j])
+            for j, kw in ov.items():
+                overrides[j] = {**kw, **overrides.get(j, {})}
         seg_kw = ({"segment_ids": segment_ids}
                   if segment_ids is not None else {})
         aux_total = jnp.zeros((), jnp.float32)

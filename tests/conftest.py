@@ -63,6 +63,24 @@ _SLOW_TESTS = {
     "test_interleaved_virtual_stages_match_single_device",
     "test_interleaved_tied_embeddings",
     "test_uneven_pp_division",
+    # kernels repaired in round 10 (the jax.shard_map / CompilerParams pin
+    # fixes): they failed at the seed, so the fast tier never counted them
+    # — the heavy ones run in the full suite to keep tier-1 inside its
+    # budget; cheap smokes (one flash, one ring, one fused-CE) stay fast
+    "test_spmd_train_step_fused_ce_matches",
+    "test_vocab_parallel_ce_matches_single_device",
+    "test_vocab_parallel_ce_multi_axis_and_vsp",
+    "test_ring_flash_gradients_match",
+    "test_ring_flash_matches_dense",
+    "test_ring_flash_with_dp_and_tp_axes",
+    "test_ring_flash_noncausal",
+    "test_ring_flash_falls_back_to_dense_for_segments",
+    "test_ring_segment_gradients_match",
+    "test_ring_segment_ids_match_dense",
+    "test_flash_dropout_gradients_match_masked_dense",
+    "test_flash_dropout_matches_masked_dense",
+    "test_flash_segment_ids_match_dense",
+    "test_distributed_flash_segment_ids",
     # kernels (8-device shard_map compiles)
     "test_ulysses_gradients",
     "test_ulysses_matches_xla_core",
@@ -90,6 +108,12 @@ _SLOW_TESTS = {
     "test_train_dist_rampup_cli",
     "test_train_dist_rampup_pipeline_cli",
     "test_train_dist_cli_pipeline",
+    # tp-overlap secondary legs (the acceptance drill
+    # test_trajectory_drill_searched_tp2_dp2_plan + recompile pinning stay
+    # fast-tier)
+    "test_train_dist_cli_tp_overlap",
+    "test_tp_overlap_cli_fallback_reasons",
+    "test_host_pipeline_engine_tp_overlap_parity",
     "test_train_dist_cli_checkpoint_resume",
     "test_resume_continues_training",
     "test_hf_gpt2_roundtrip_and_forward",

@@ -1,0 +1,42 @@
+"""The overlapped-TP A/B microbench must run, produce self-consistent
+numbers, and (acceptance) not regress the GSPMD path it replaces on the
+virtual CPU mesh — pooled-median overlap_vs_gspmd <= 1.0 with zero
+steady-state recompiles."""
+
+import pytest
+
+pytestmark = [pytest.mark.core, pytest.mark.tp_overlap]
+
+
+def _bench(**kw):
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "..",
+                                    "tools"))
+    import tp_overlap_bench as b
+
+    return b.run(**kw)
+
+
+@pytest.mark.slow
+def test_tp_overlap_bench_runs_and_is_consistent():
+    out = _bench(iters=3, tps=(2,), hidden=64, seq=64)
+    leg = out["legs"]["tp2"]
+    assert leg["gspmd_step_ms"] > 0 and leg["overlap_step_ms"] > 0
+    assert out["overlap_vs_gspmd"] > 0
+    assert out["overlap_recompiles"] == 0
+    assert out["platform"] == "cpu"
+
+
+@pytest.mark.slow
+def test_tp_overlap_does_not_regress_gspmd_on_cpu_mesh():
+    """Acceptance: at the default (amortizing) shapes, the interleaved
+    pooled-median ratio across tp2 and tp4 stays <= 1.0 and the overlap
+    step never retraces in steady state. On CPU no true overlap exists, so
+    <= 1.0 here means the ring decomposition's bookkeeping is already paid
+    for by the collectives it removes; the on-chip run (--tpu) is where
+    the hidden-transfer win lands on top."""
+    out = _bench(iters=10)
+    assert out["overlap_recompiles"] == 0, out
+    assert out["overlap_vs_gspmd"] <= 1.0, out

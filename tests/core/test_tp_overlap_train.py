@@ -1,0 +1,218 @@
+"""Overlapped-TP training parity + hygiene on the virtual 8-device mesh:
+the acceptance drill (3-step train trajectory, overlap on vs off, under a
+searched-format tp2 x dp2 plan JSON), steady-state recompile pinning with a
+transfer guard, and the launcher-level fallback/telemetry wiring."""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from hetu_galvatron_tpu.core.args_schema import CoreArgs, ModelArgs, TrainArgs
+from hetu_galvatron_tpu.models.builder import init_causal_lm
+from hetu_galvatron_tpu.parallel.spmd import make_spmd_train_step, shard_params
+from hetu_galvatron_tpu.runtime.dataloader import make_batch
+from hetu_galvatron_tpu.runtime.hybrid_config import get_hybrid_parallel_config
+from hetu_galvatron_tpu.runtime.mesh import build_mesh
+from hetu_galvatron_tpu.runtime.optimizer import make_optimizer
+from hetu_galvatron_tpu.utils.strategy import (
+    EmbeddingLMHeadStrategy,
+    LayerStrategy,
+    strategy_list2config,
+)
+
+pytestmark = [pytest.mark.core, pytest.mark.tp_overlap,
+              pytest.mark.distributed]
+
+CFG = ModelArgs(
+    hidden_size=64, num_hidden_layers=2, num_attention_heads=4,
+    vocab_size=128, max_position_embeddings=64, seq_length=16,
+    hidden_act="swiglu", normalization="rmsnorm",
+    position_embedding_type="rope", tie_word_embeddings=False,
+    add_bias_linear=False, add_qkv_bias=False,
+    make_vocab_size_divisible_by=1, ffn_hidden_size=128,
+)
+TRAIN = TrainArgs(lr=1e-2, clip_grad=1.0, weight_decay=0.01,
+                  lr_decay_style="constant", lr_warmup_iters=0)
+
+
+def _searched_plan_json(tmp_path, tp=2, dp=2):
+    """A tp x dp plan in the searched-config interchange format (the JSON
+    the search engine's save_results writes)."""
+    layers = [LayerStrategy(pp_deg=1, tp_size=tp, dp_size=dp)
+              for _ in range(CFG.num_hidden_layers)]
+    cfg = strategy_list2config(
+        layers, global_bsz=8, chunks=1, pipeline_type="pipedream_flush",
+        default_dp_type="ddp", vocab=EmbeddingLMHeadStrategy(vtp=tp),
+        pp_division=[CFG.num_hidden_layers])
+    path = tmp_path / "galvatron_config_drill.json"
+    path.write_text(json.dumps(cfg))
+    return str(path)
+
+
+def _steps(tmp_path, cpu_devices, tp_overlap, world=4, n=3):
+    a = CoreArgs(model=CFG.model_dump(), train=TRAIN.model_dump())
+    a.parallel.config_mode = "json"
+    a.parallel.galvatron_config_path = _searched_plan_json(tmp_path)
+    hpc = get_hybrid_parallel_config(a, world)
+    mesh = build_mesh(world, 1, devices=cpu_devices[:world])
+    tx = make_optimizer(TRAIN)
+    params, axes = init_causal_lm(jax.random.key(0), CFG)
+    step, pspecs, ospecs, batch_shd = make_spmd_train_step(
+        CFG, hpc, mesh, axes, tx, params, compute_dtype=jnp.float32,
+        donate=False, tp_overlap=tp_overlap)
+    sp = shard_params(params, pspecs, mesh)
+    so = jax.jit(tx.init, out_shardings=jax.tree.map(
+        lambda s: jax.sharding.NamedSharding(mesh, s), ospecs,
+        is_leaf=lambda x: isinstance(x, jax.sharding.PartitionSpec)))(sp)
+    data = np.random.RandomState(0).randint(0, 128, (8, CFG.seq_length + 1))
+    b = jax.device_put(jax.tree.map(jnp.asarray, make_batch(data)),
+                       batch_shd)
+    losses = []
+    for _ in range(n):
+        sp, so, m = step(sp, so, b)
+        losses.append(float(m["loss"]))
+    return step, sp, so, b, losses
+
+
+def test_trajectory_drill_searched_tp2_dp2_plan(tmp_path, cpu_devices):
+    """Acceptance: 3-step train trajectory, overlap on vs off, under a
+    searched tp2 x dp2 plan — identical to tolerance, params included."""
+    _, sp0, _, _, l0 = _steps(tmp_path, cpu_devices, tp_overlap=False)
+    _, sp1, _, _, l1 = _steps(tmp_path, cpu_devices, tp_overlap=True)
+    np.testing.assert_allclose(l0, l1, rtol=1e-5, atol=1e-5)
+    for (pa, a), (_, b) in zip(
+            jax.tree_util.tree_leaves_with_path(sp0),
+            jax.tree_util.tree_leaves_with_path(sp1)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=5e-4, atol=3e-4,
+            err_msg=f"param {jax.tree_util.keystr(pa)}")
+
+
+def test_overlap_step_recompile_pinning_and_no_transfers(
+        tmp_path, cpu_devices):
+    """The overlapped step compiles exactly once and its steady state moves
+    no host data (pinned with jax.transfer_guard)."""
+    step, sp, so, b, _ = _steps(tmp_path, cpu_devices, tp_overlap=True, n=1)
+    assert step._cache_size() == 1
+    for _ in range(2):
+        with jax.transfer_guard("disallow"):
+            sp, so, m = step(sp, so, b)
+    jax.block_until_ready(m["loss"])
+    assert step._cache_size() == 1, "steady state recompiled"
+
+
+def test_train_dist_cli_tp_overlap(tmp_path, cpu_devices):
+    """Launcher wiring end to end: tp_overlap.enable trains, logs the
+    overlapped-layer count, emits the tp/comm_hidden_frac gauge and the
+    tp/overlap_step span into the metrics stream, and summarize renders
+    the hidden fraction."""
+    from hetu_galvatron_tpu.cli.summarize import summarize
+    from hetu_galvatron_tpu.cli.train_dist import train
+    from hetu_galvatron_tpu.core.arguments import args_from_cli
+
+    metrics = tmp_path / "metrics.jsonl"
+    args = args_from_cli([
+        "model.hidden_size=64", "model.num_hidden_layers=2",
+        "model.num_attention_heads=4", "model.vocab_size=128",
+        "model.seq_length=16", "model.max_position_embeddings=64",
+        "model.hidden_act=swiglu", "model.normalization=rmsnorm",
+        "model.position_embedding_type=rope",
+        "model.tie_word_embeddings=false", "model.add_bias_linear=false",
+        "model.make_vocab_size_divisible_by=1",
+        "model.ffn_hidden_size=128", "model.use_flash_attn=false",
+        "parallel.global_tp_deg=2", "parallel.global_train_batch_size=8",
+        "parallel.num_devices=8",
+        "tp_overlap.enable=true", "train.train_iters=2",
+        "observability.enabled=true",
+        f"observability.metrics_path={metrics}",
+    ], mode="train_dist")
+    out = train(args)
+    assert len(out["losses"]) == 2
+    assert all(np.isfinite(out["losses"]))
+    records = [json.loads(ln) for ln in
+               metrics.read_text().splitlines() if ln.strip()]
+    gauges = {r["name"]: r for r in records if r.get("kind") == "gauge"}
+    assert "tp/comm_hidden_frac" in gauges
+    # every layer of the uniform tp2 plan is overlap-expressible, so the
+    # whole TP volume is on the overlapped path
+    assert gauges["tp/comm_hidden_frac"]["value"] == pytest.approx(1.0)
+    spans = {json.loads(lb)["path"] for (lb,) in
+             [(json.dumps(r.get("labels") or {}),) for r in records
+              if r.get("kind") == "histogram" and r.get("name") == "span_ms"]}
+    assert "tp/overlap_step" in spans
+    import io
+
+    buf = io.StringIO()
+    head = summarize(str(metrics), out=buf)
+    assert head.get("tp_comm_hidden_frac") == pytest.approx(1.0)
+    assert "TP comm overlapped" in buf.getvalue()
+
+
+def test_tp_overlap_cli_fallback_reasons(tmp_path):
+    """tp_overlap.enable with tp == 1 logs the reason and runs the GSPMD
+    path (no crash, no gauge)."""
+    from hetu_galvatron_tpu.cli.train_dist import train
+    from hetu_galvatron_tpu.core.arguments import args_from_cli
+
+    metrics = tmp_path / "m.jsonl"
+    args = args_from_cli([
+        "model.hidden_size=32", "model.num_hidden_layers=2",
+        "model.num_attention_heads=2", "model.vocab_size=64",
+        "model.seq_length=8", "model.max_position_embeddings=16",
+        "model.make_vocab_size_divisible_by=1",
+        "model.use_flash_attn=false",
+        "parallel.global_train_batch_size=4", "parallel.num_devices=2",
+        "tp_overlap.enable=true", "train.train_iters=1",
+        "observability.enabled=true",
+        f"observability.metrics_path={metrics}",
+    ], mode="train_dist")
+    out = train(args)
+    assert len(out["losses"]) == 1
+    records = [json.loads(ln) for ln in
+               metrics.read_text().splitlines() if ln.strip()]
+    assert not any(r.get("name") == "tp/comm_hidden_frac" for r in records)
+
+
+def test_host_pipeline_engine_tp_overlap_parity(cpu_devices):
+    """pp2 x tp2 x dp2 through the host PipelineEngine: the overlapped
+    stage programs reproduce the GSPMD stage programs' 2-step trajectory."""
+    from hetu_galvatron_tpu.runtime.pipeline import PipelineEngine
+
+    cfg = CFG.model_copy(update={"num_hidden_layers": 4})
+    a = CoreArgs(model=cfg.model_dump(), train=TRAIN.model_dump())
+    a.parallel.pp_deg = 2
+    a.parallel.global_tp_deg = 2
+    a.parallel.chunks = 2
+    a.parallel.pipeline_type = "pipedream_flush"
+    a.parallel.global_train_batch_size = 8
+    hpc = get_hybrid_parallel_config(a, 8)
+    data = np.random.RandomState(0).randint(0, 128, (8, cfg.seq_length + 1))
+    batch = make_batch(data)
+
+    def run(tp_overlap):
+        eng = PipelineEngine(cfg, hpc, TRAIN, devices=cpu_devices,
+                             compute_dtype=jnp.float32,
+                             tp_overlap=tp_overlap)
+        params, axes = init_causal_lm(jax.random.key(0), cfg)
+        sp = eng.split_params(params, axes)
+        so = eng.init_opt(sp, axes)
+        losses = []
+        for _ in range(2):
+            sp, so, m = eng.train_step(sp, so, batch)
+            losses.append(float(m["loss"]))
+        return losses, sp
+
+    l0, sp0 = run(False)
+    l1, sp1 = run(True)
+    np.testing.assert_allclose(l0, l1, rtol=1e-5, atol=1e-5)
+    for s0, s1 in zip(sp0, sp1):
+        for (pa, x), (_, y) in zip(
+                jax.tree_util.tree_leaves_with_path(s0),
+                jax.tree_util.tree_leaves_with_path(s1)):
+            np.testing.assert_allclose(
+                np.asarray(x), np.asarray(y), rtol=5e-4, atol=3e-4,
+                err_msg=f"stage param {jax.tree_util.keystr(pa)}")

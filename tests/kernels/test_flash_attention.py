@@ -218,7 +218,7 @@ def _ref_dropout_attn(q, k, v, seed, rate, causal=True):
           + jnp.arange(N)[None, :])  # flat head index n = kh*G + g
     keep = keep_mask(seed[0], bn[:, :, None, None],
                      jnp.arange(S)[None, None, :, None],
-                     jnp.arange(S)[None, None, None, :], S, rate)
+                     jnp.arange(S)[None, None, None, :], rate)
     keep = keep.reshape(B, K, G, S, S)
     p = jnp.where(keep, p / (1.0 - rate), 0.0)
     out = jnp.einsum("bkgst,btkd->bskgd", p, v,
@@ -279,7 +279,7 @@ def test_flash_dropout_statistics_and_zero_rate():
     # empirical keep fraction over a large grid ~ 1 - rate
     bn = jnp.zeros((1,), jnp.int32)
     m = keep_mask(jnp.int32(123), bn, jnp.arange(512)[:, None],
-                  jnp.arange(512)[None, :], 512, 0.3)
+                  jnp.arange(512)[None, :], 0.3)
     frac = float(jnp.mean(m.astype(jnp.float32)))
     assert abs(frac - 0.7) < 0.01, frac
     # rate 0 == no dropout path
@@ -360,20 +360,19 @@ def test_keep_mask_no_long_sequence_aliasing():
     """ADVICE r5: the old per-element counter qpos*s_total+kpos wrapped
     uint32 once s_total exceeded 2**16, handing distant (qpos, kpos) pairs
     within one head bit-identical dropout masks. The chained finalizer mix
-    has no sequence-length bound: rows that PROVABLY aliased under the old
-    scheme (qpos * s_total === 0 mod 2**32) must now differ."""
+    has no sequence-length bound (and no s_total parameter any more): rows
+    that PROVABLY aliased under the old scheme at s_total = 2**17
+    (qpos * s_total === 0 mod 2**32) must now differ."""
     from hetu_galvatron_tpu.ops.pallas.flash_attention import keep_mask
 
-    s_total = 2 ** 17
     bn = jnp.zeros((1,), jnp.int32)
     kpos = jnp.arange(4096)[None, :]
     rows = []
-    # old counters: 0*s+k, (2**15)*s+k = 2**32+k = k, (2**16)*s+k = k —
-    # all three rows were identical
+    # old counters at s_total=2**17: 0*s+k, (2**15)*s+k = 2**32+k = k,
+    # (2**16)*s+k = k — all three rows were identical
     for q in (0, 2 ** 15, 2 ** 16):
         rows.append(np.asarray(keep_mask(
-            jnp.int32(7), bn, jnp.full((1, 1), q, jnp.int32), kpos,
-            s_total, 0.5)))
+            jnp.int32(7), bn, jnp.full((1, 1), q, jnp.int32), kpos, 0.5)))
     assert not np.array_equal(rows[0], rows[1])
     assert not np.array_equal(rows[0], rows[2])
     assert not np.array_equal(rows[1], rows[2])
@@ -392,8 +391,8 @@ def test_keep_mask_tile_invariance_property():
     bn = jnp.zeros((1,), jnp.int32)
     full = np.asarray(keep_mask(jnp.int32(3), bn,
                                 jnp.arange(S)[:, None],
-                                jnp.arange(S)[None, :], S, 0.3))
+                                jnp.arange(S)[None, :], 0.3))
     tile = np.asarray(keep_mask(jnp.int32(3), bn,
                                 (32 + jnp.arange(16))[:, None],
-                                (64 + jnp.arange(16))[None, :], S, 0.3))
+                                (64 + jnp.arange(16))[None, :], 0.3))
     np.testing.assert_array_equal(full[32:48, 64:80], tile)

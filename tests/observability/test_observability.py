@@ -531,3 +531,60 @@ def test_tensorboard_sink_stepless_records_extend_last_step():
     s.write({"kind": "gauge", "name": "loss", "value": 2.0, "step": 5})
     s.write({"kind": "gauge", "name": "loss", "value": 1.0, "step": None})
     assert scalars == [("loss", 2.0, 5), ("loss", 1.0, 5)]
+
+
+def test_summarize_hardware_alpha_beta_table(tmp_path, capsys):
+    """Pointing summarize at a hardware bandwidth JSON renders the per-
+    group bandwidth + fitted α-β table; a legacy (bandwidth-only) JSON
+    renders with dashes and says the cost model falls back."""
+    import json
+
+    from hetu_galvatron_tpu.cli import summarize as S
+
+    cfg = {"allreduce_size_8_consec_1": 160.4,
+           "allreduce_size_4_consec_1": 164.2,
+           "allreduce_size_4_consec_0": 165.5,
+           "allreduce_size_8_consec_1_alpha_ms": 0.12,
+           "allreduce_size_8_consec_1_beta_mb_per_ms": 320.0}
+    path = tmp_path / "allreduce_bandwidth.json"
+    path.write_text(json.dumps(cfg))
+    head = S.summarize(str(path))
+    out = capsys.readouterr().out
+    assert head["groups"] == 3
+    assert head["alpha_beta_groups"] == 1
+    assert "hardware profile" in out and "alpha ms" in out
+    assert "0.12" in out and "320" in out
+
+    legacy = tmp_path / "legacy.json"
+    legacy.write_text(json.dumps(
+        {"allreduce_size_2_consec_1": 150.0}))
+    head = S.summarize(str(legacy))
+    assert head["alpha_beta_groups"] == 0
+    assert "legacy bandwidth-only" in capsys.readouterr().out
+
+
+def test_plan_tp_overlap_hidden_frac_volume_weighted():
+    """The runtime gauge value: volume-weighted share of TP collective
+    traffic on overlapped layers (1.0 when every tp layer overlaps, 0 with
+    none, partial when only some layers are eligible)."""
+    from types import SimpleNamespace
+
+    from hetu_galvatron_tpu.observability.telemetry import (
+        plan_tp_overlap_hidden_frac,
+    )
+    from hetu_galvatron_tpu.utils.strategy import LayerStrategy
+
+    model = SimpleNamespace(seq_length=16, hidden_size=64,
+                            num_attention_heads=4, kv_heads=4,
+                            head_dim=16, ffn_dim=128, vocab_size=128,
+                            hidden_act="gelu",
+                            tie_word_embeddings=False)
+    tp2 = LayerStrategy(pp_deg=1, tp_size=2, dp_size=4)
+    hpc = SimpleNamespace(layers=[tp2, tp2], global_bsz=8, chunks=1)
+    assert plan_tp_overlap_hidden_frac(hpc, model, [0, 1]) == 1.0
+    assert plan_tp_overlap_hidden_frac(hpc, model, []) == 0.0
+    assert plan_tp_overlap_hidden_frac(hpc, model, [0]) == 0.5
+    # no tp traffic at all -> 0
+    dp8 = LayerStrategy(pp_deg=1, tp_size=1, dp_size=8)
+    hpc0 = SimpleNamespace(layers=[dp8, dp8], global_bsz=8, chunks=1)
+    assert plan_tp_overlap_hidden_frac(hpc0, model, []) == 0.0

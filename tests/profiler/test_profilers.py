@@ -64,6 +64,43 @@ def test_sp_time_profile_feeds_latency_tables(hw_args, cpu_devices):
     assert 4 in tables and "popt" in tables[4]
     a2a = remap_collective_latency(sp, "all2all")
     assert 2 in a2a
+    # the new sub-MB points ride a 'sub_' prefix the legacy remap parsers
+    # never see (their MB values would otherwise read as megabytes)
+    assert "sub_allreduce_size_4_512KB_time" in sp
+    assert all(mb in tables[4] or mb == "popt" for mb in tables[4])
+    assert not any(isinstance(k, int) and k > 128 for k in tables[4])
+
+
+def test_alpha_beta_fit_roundtrips_into_cost_model(cpu_devices):
+    """profile_alpha_beta fits (α ms, β MB/ms) per (size, consec) from the
+    sub-MB + MB allreduce points; the pairs merge into the bandwidth JSON,
+    profiles.read_alpha_beta parses them, and a legacy JSON yields {}."""
+    from hetu_galvatron_tpu.core.search_engine.profiles import (
+        read_alpha_beta,
+    )
+
+    args = HardwareProfileArgs(num_nodes=1, num_devices_per_node=4,
+                               start_mb=1, end_mb=4, scale=2,
+                               warmup_iters=1, profile_iters=1)
+    prof = HardwareProfiler(args, devices=cpu_devices[:4])
+    sp = prof.profile_sp_time()
+    ab = prof.profile_alpha_beta(sp)
+    for size, consec in ((4, 1), (2, 1), (2, 0)):
+        assert f"allreduce_size_{size}_consec_{consec}_alpha_ms" in ab
+        beta = ab[f"allreduce_size_{size}_consec_{consec}_beta_mb_per_ms"]
+        assert beta > 0
+    # merged with the bandwidth keys, the reader recovers the pairs...
+    bw = prof.profile_allreduce_bandwidth(message_mb=1)
+    bw.update(ab)
+    pairs = read_alpha_beta(bw)
+    assert set(pairs) == {"4_1", "2_1", "2_0"}
+    assert all(a >= 0 and b > 0 for a, b in pairs.values())
+    # ...and the legacy reader still parses the merged JSON untouched
+    bw2, coe = read_allreduce_bandwidth(bw, 4)
+    assert coe["4"] > 0
+    # legacy bandwidth-only JSON -> empty table (golden costs unchanged)
+    assert read_alpha_beta(
+        {"allreduce_size_4_consec_1": 100.0}) == {}
 
 
 def test_runtime_profiler_timing_and_log():

@@ -1,0 +1,201 @@
+"""Latency-aware (α-β) TP collective pricing + overlapped-TP discount in
+the cost model: the α term must be able to flip a tp choice the pure
+bandwidth model gets wrong, the overlap discount must flip a choice toward
+tp, and the 0-α / 0-discount defaults must leave every existing cost
+byte-identical (the golden search regressions pin the full-plan version of
+that property against the legacy fixtures)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from hetu_galvatron_tpu.core.args_schema import SearchArgs
+from hetu_galvatron_tpu.core.cost_model.cost import (
+    CostContext,
+    layer_time_cost,
+    tp_overlap_expressible,
+    tp_overlap_hidden_frac,
+)
+from hetu_galvatron_tpu.core.search_engine.engine import SearchEngine
+from hetu_galvatron_tpu.core.search_engine.strategies import SearchStrategy
+
+pytestmark = [pytest.mark.search_engine, pytest.mark.tp_overlap]
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "..", "fixtures")
+
+
+def _latency_table(per_mb=0.01):
+    """Pure-bandwidth measured table: time strictly proportional to size
+    (what the legacy sp_time fixtures encode for fat messages)."""
+    table = {mb: per_mb * mb for mb in (1, 2, 4, 8, 16, 32, 64, 128)}
+    table["popt"] = np.array([per_mb, 0.0])
+    return table
+
+
+def _ctx(**kw):
+    base = dict(
+        parameter_size=48.0, seq_length=128, hidden_size=256, layer_num=4,
+        mixed_precision=True,
+        forward_computation_time=0.05,
+        comm_coe_dict={"8_1": 0.01, "8_0": 0.01, "4_1": 0.01, "4_0": 0.01,
+                       "2_1": 0.01, "2_0": 0.01, "1": 0.0, "1_1": 0.0},
+        dp_overlap_coe=1.1, bct_overlap_coe=1.1,
+        allgather_latency={2: _latency_table(), 4: _latency_table(),
+                           8: _latency_table()},
+        all2all_latency={2: _latency_table(), 4: _latency_table(),
+                         8: _latency_table()},
+    )
+    base.update(kw)
+    return CostContext(**base)
+
+
+def _cost(s, ctx, gbsz=64, chunks=1):
+    return layer_time_cost(s, ctx, gbsz, chunks)[0]
+
+
+TP2 = SearchStrategy(pp=1, tp=2, dp=4)
+TP4 = SearchStrategy(pp=1, tp=4, dp=2)
+
+
+def test_alpha_term_flips_tp_choice():
+    """With expensive dp grad sync, bandwidth-only pricing favours tp4
+    (its dp=2 sync is cheap and its messages ride the same ms/MB slope);
+    the fitted α GROWS with the ring size (more hops per collective —
+    exactly what the per-group-size pairs capture), so the latency term
+    punishes tp4's 6 collectives harder and flips the choice to tp2."""
+    coe = {"8_1": 0.1, "8_0": 0.1, "4_1": 0.1, "4_0": 0.1,
+           "2_1": 0.1, "2_0": 0.1, "1": 0.0, "1_1": 0.0}
+    ctx = _ctx(comm_coe_dict=coe)
+    assert _cost(TP4, ctx) < _cost(TP2, ctx)
+
+    # β matches the tables' slope (allreduce = 2x the ag-equivalent rate),
+    # α grows with group size
+    ab = {"2_1": (0.2, 50.0), "4_1": (2.0, 50.0), "8_1": (4.0, 50.0)}
+    ctx_a = _ctx(comm_coe_dict=coe, tp_alpha_beta=ab)
+    assert _cost(TP2, ctx_a) < _cost(TP4, ctx_a)
+
+
+def test_alpha_beta_zero_alpha_matches_tables():
+    """α = 0 with β matching the measured slope reproduces the legacy
+    table lookup exactly (the ag-equivalent is half the allreduce curve:
+    0.5 * mb / 50 == 0.01 * mb)."""
+    ctx = _ctx()
+    ctx_ab = _ctx(tp_alpha_beta={"2_1": (0.0, 50.0), "4_1": (0.0, 50.0)})
+    for s in (TP2, TP4):
+        assert _cost(s, ctx_ab) == pytest.approx(_cost(s, ctx), rel=1e-12)
+
+
+def test_overlap_discount_flips_choice_toward_tp():
+    """A dp-only plan beats tp2 when TP comm is priced serial; with the
+    overlap discount (the decomposed matmuls hide the collectives under
+    compute) the tp2 plan wins."""
+    dp8 = SearchStrategy(pp=1, tp=1, dp=8)
+    ctx = _ctx(comm_coe_dict={"8_1": 0.003, "8_0": 0.003, "4_1": 0.003,
+                              "4_0": 0.003, "2_1": 0.003, "2_0": 0.003,
+                              "1": 0.0, "1_1": 0.0},
+               allgather_latency={2: _latency_table(0.005),
+                                  4: _latency_table(0.005),
+                                  8: _latency_table(0.005)})
+    assert _cost(dp8, ctx) < _cost(TP2, ctx)
+
+    ctx_ov = _ctx(comm_coe_dict=ctx.comm_coe_dict,
+                  allgather_latency=ctx.allgather_latency, tp_overlap=True)
+    assert _cost(TP2, ctx_ov) < _cost(dp8, ctx_ov)
+    # the discount never touches tp=1 plans
+    assert _cost(dp8, ctx_ov) == _cost(dp8, ctx)
+
+
+def test_defaults_leave_costs_identical():
+    """tp_overlap=False + empty alpha-beta (the defaults) change nothing —
+    the invariant that keeps every existing golden plan byte-identical."""
+    ctx = _ctx()
+    ctx_default = _ctx(tp_alpha_beta={}, tp_overlap=False)
+    for s in (TP2, TP4, SearchStrategy(pp=1, tp=1, dp=8),
+              SearchStrategy(pp=1, tp=2, dp=4, checkpoint=True),
+              SearchStrategy(pp=1, sp=2, tp=1, dp=4)):
+        w0, n0 = layer_time_cost(s, ctx, 64, 2)
+        w1, n1 = layer_time_cost(s, ctx_default, 64, 2)
+        assert (w0, n0) == (w1, n1)
+
+
+def test_overlap_expressibility_gate():
+    ctx = _ctx(tp_overlap=True)
+    assert tp_overlap_expressible(TP2, ctx)
+    assert not tp_overlap_expressible(SearchStrategy(pp=1, tp=1, dp=8), ctx)
+    assert not tp_overlap_expressible(
+        SearchStrategy(pp=1, tp=2, cp=2, dp=2), ctx)
+    # the compiled pipeline engine cannot host the shard_map rings
+    ctx_c = _ctx(tp_overlap=True, schedule_impl="compiled")
+    assert tp_overlap_expressible(SearchStrategy(pp=1, tp=2, dp=4), ctx_c)
+    assert not tp_overlap_expressible(SearchStrategy(pp=2, tp=2, dp=2), ctx_c)
+    off = _ctx(tp_overlap=False)
+    assert not tp_overlap_expressible(TP2, off)
+
+
+def test_hidden_frac_bounds_and_regimes():
+    ctx = _ctx(tp_overlap=True)
+    f = tp_overlap_hidden_frac(TP2, ctx, 64, 1)
+    assert 0.0 < f <= 1.0
+    # compute-bound regime: hidden fraction approaches 2 - overlap_coe
+    big_compute = _ctx(tp_overlap=True, forward_computation_time=100.0)
+    assert tp_overlap_hidden_frac(TP2, big_compute, 64, 1) == pytest.approx(
+        2.0 - 1.1, rel=1e-6)
+    # inexpressible -> 0
+    assert tp_overlap_hidden_frac(
+        SearchStrategy(pp=1, tp=1, dp=8), ctx, 64, 1) == 0.0
+
+
+def test_engine_threads_alpha_beta_and_overlap(tmp_path):
+    """SearchArgs.tp_overlap + the profile's fitted α-β keys flow into
+    every layertype's CostContext; the legacy fixture (no α keys) yields
+    an empty table."""
+    import json
+    import shutil
+
+    bw_src = os.path.join(FIXTURES,
+                          "allreduce_bandwidth_1nodes_8gpus_per_node.json")
+    bw = json.load(open(bw_src))
+    bw["allreduce_size_8_consec_1_alpha_ms"] = 0.25
+    bw["allreduce_size_8_consec_1_beta_mb_per_ms"] = 320.0
+    bw_path = tmp_path / "allreduce_bandwidth.json"
+    bw_path.write_text(json.dumps(bw))
+
+    def make(bw_file, tp_overlap):
+        args = SearchArgs(
+            num_nodes=1, num_devices_per_node=8, memory_constraint=36,
+            settle_bsz=64, settle_chunks=8,
+            default_dp_type="zero2", pipeline_type="pipedream_flush",
+            fine_grained_mode=0, sequence_parallel=True,
+            async_grad_reduce=False, mixed_precision="bf16",
+            time_profile_mode="sequence", memory_profile_mode="sequence",
+            tp_overlap=tp_overlap,
+            time_profiling_path=os.path.join(
+                FIXTURES, "computation_profiling_bf16_llama2-7b_all.json"),
+            memory_profiling_path=os.path.join(
+                FIXTURES, "memory_profiling_bf16_llama2-7b_all.json"),
+            allreduce_bandwidth_config_path=str(bw_file),
+            p2p_bandwidth_config_path=os.path.join(
+                FIXTURES, "p2p_bandwidth_1nodes_8gpus_per_node.json"),
+            overlap_coe_path=os.path.join(FIXTURES,
+                                          "overlap_coefficient.json"),
+            sp_time_path=os.path.join(
+                FIXTURES, "sp_time_1nodes_8gpus_per_node.json"),
+            output_config_path=str(tmp_path),
+        )
+        eng = SearchEngine(args)
+        eng.set_model_info(
+            [{"hidden_size": 4096, "seq_len": 8192, "layer_num": 28}],
+            "llama2-7b")
+        eng.initialize()
+        return eng
+
+    eng = make(bw_path, tp_overlap=1)
+    for ctx in eng.contexts:
+        assert ctx.tp_overlap is True
+        assert ctx.tp_alpha_beta == {"8_1": (0.25, 320.0)}
+
+    legacy = make(bw_src, tp_overlap=0)
+    for ctx in legacy.contexts:
+        assert ctx.tp_overlap is False
+        assert ctx.tp_alpha_beta == {}

@@ -159,6 +159,12 @@ def main() -> int:
         ("pipeline_ab", [py, os.path.join(ROOT, "tools",
                                           "pipeline_dispatch_bench.py"),
                          "--tpu"], 1800, None),
+        # overlapped-TP vs GSPMD collectives on the chip (the CPU-mesh
+        # ratio only bounds the ring decomposition's overhead; on ICI the
+        # ppermute hops hide under the MXU and the ratio is the real win)
+        ("tp_overlap", [py, os.path.join(ROOT, "tools",
+                                         "tp_overlap_bench.py"),
+                        "--tpu"], 1800, None),
         ("bench", [py, os.path.join(ROOT, "bench.py")], 1100, None),
     ]
     for name, argv, deadline, env_extra in steps:
