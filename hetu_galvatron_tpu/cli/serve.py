@@ -180,6 +180,12 @@ def main(argv=None) -> int:
     engine = ServingEngine(params, cfg, serving, mesh=mesh, hpc=hpc,
                            axes_tree=axes if mesh is not None else None,
                            registry=registry)
+    if engine.metrics_port is not None:
+        # serving.metrics_port: Prometheus text endpoint over the serve/*
+        # registry (observability/prometheus.py); port 0 binds ephemeral,
+        # serving.metrics_host widens the (loopback-default) bind
+        print(f"metrics: http://{serving.metrics_host}:"
+              f"{engine.metrics_port}/metrics", file=sys.stderr)
 
     reqs = _read_requests(kv)
     # compile decode + every prefill bucket BEFORE traffic: TTFT must

@@ -22,14 +22,22 @@ def load_records(path: str) -> List[Dict[str, Any]]:
     it. Unparseable lines are counted and warned about, not fatal."""
     out = []
     bad = 0
-    with open(path) as f:
+    with open(path, errors="replace") as f:
         for line in f:
             line = line.strip()
             if not line:
                 continue
             try:
-                out.append(json.loads(line))
+                rec = json.loads(line)
             except json.JSONDecodeError:
+                bad += 1
+                continue
+            # a torn write can also yield VALID JSON that is not a record
+            # (e.g. a bare number from a half-flushed line) — a summary
+            # must skip it, not crash on rec.get()
+            if isinstance(rec, dict):
+                out.append(rec)
+            else:
                 bad += 1
     if bad:
         print(f"warning: skipped {bad} unparseable line(s) in {path} "
@@ -192,6 +200,57 @@ def summarize(path: str, out=None) -> Dict[str, Any]:
         if plan:
             w(f"plan comm MB/step (predicted)  {_fmt(plan['value'])}")
 
+    # -- plan audit calibration table (observability/trace_analysis.py) --
+    audits = [r for r in records if r.get("kind") == "event"
+              and r.get("name") == "plan_audit"]
+    if audits:
+        t = audits[-1].get("data", {})
+        rows = [r for r in t.get("rows", []) if isinstance(r, dict)]
+        headline["audit_components"] = len(rows)
+        w()
+        w(f"-- plan audit: predicted vs actual (per step, per device; "
+          f"{t.get('steps', '?')} steps, {t.get('tracks', '?')} device "
+          "tracks) --")
+        w(f"{'component':<12}{'pred MB':>10}{'pred ms':>10}{'meas ms':>10}"
+          f"{'ratio':>8}{'residual':>10}")
+        for r in rows:
+            if "measured_frac" in r:  # bubble row
+                pf = r.get("predicted_frac")
+                w(f"{r.get('component', '?'):<12}{'-':>10}"
+                  f"{(_fmt(pf) if pf is not None else '-'):>10}"
+                  f"{_fmt(r['measured_frac']):>10}"
+                  f"{'-':>8}{'(frac)':>10}")
+                continue
+            ratio = r.get("ratio")
+            if ratio is not None:
+                headline[f"audit_ratio_{r.get('component')}"] = ratio
+            w(f"{r.get('component', '?'):<12}"
+              f"{(_fmt(r['predicted_mb']) if 'predicted_mb' in r else '-'):>10}"
+              f"{(_fmt(r['predicted_ms']) if 'predicted_ms' in r else '-'):>10}"
+              f"{(_fmt(r['measured_ms']) if 'measured_ms' in r else '-'):>10}"
+              f"{(_fmt(ratio) if ratio is not None else '-'):>8}"
+              f"{(_fmt(r['residual_ms']) if 'residual_ms' in r else '-'):>10}")
+        sd = t.get("step_device_ms")
+        if sd is not None:
+            headline["audit_step_device_ms"] = sd
+            w(f"device busy ms/step  {_fmt(float(sd))}")
+
+    # -- compiled-program cost accounting (cost/* gauges) --
+    costs = [(json.loads(lb).get("program", "?"), n.split("/", 1)[1], r)
+             for (k, n, lb), r in latest.items()
+             if k == "gauge" and n.startswith("cost/")]
+    if costs:
+        by_prog: Dict[str, Dict[str, float]] = {}
+        for prog, stat, r in costs:
+            by_prog.setdefault(prog, {})[stat] = r["value"]
+        w()
+        w("-- program costs (XLA cost_analysis) --")
+        w(f"{'program':<24}{'GFLOPs':>10}{'MB accessed':>13}")
+        for prog, st in sorted(by_prog.items()):
+            gf = st.get("flops", 0.0) / 1e9
+            mb = st.get("bytes_accessed", 0.0) / (1024 * 1024)
+            w(f"{prog:<24}{_fmt(gf):>10}{_fmt(mb):>13}")
+
     # -- serving (engine telemetry, serving/engine.py) --
     srv_tps = get("gauge", "serve/tokens_per_sec")
     ttft = get("histogram", "serve/ttft_ms")
@@ -251,7 +310,7 @@ def summarize(path: str, out=None) -> Dict[str, Any]:
     rest = [((k, n, lb), r) for (k, n, lb), r in sorted(latest.items())
             if k in ("counter", "gauge")
             and not n.startswith(("train/", "device/", "plan/", "serve/",
-                                  "tp/"))]
+                                  "tp/", "audit/", "cost/"))]
     if rest:
         w()
         w("-- other counters/gauges --")

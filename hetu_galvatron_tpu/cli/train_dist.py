@@ -437,6 +437,52 @@ def train(args) -> Dict[str, Any]:
             # crash-safe: flush an open XLA trace window + the metrics
             # stream so both survive the exception they may help debug
             profiler.stop_trace()
+            if (telemetry is not None and args.profile.trace_dir
+                    and args.observability.audit):
+                # close the loop: attribute the captured device trace and
+                # diff it against the plan's cost-model predictions
+                # (audit/* gauges + plan_audit event; flushed by the
+                # telemetry close below). The whole block is guarded like
+                # the checkpoint drain above: it runs on the crash path
+                # too, and a post-mortem helper failing (e.g. an import
+                # missing in a lean deployment) must neither mask the real
+                # traceback nor skip the telemetry close.
+                try:
+                    from hetu_galvatron_tpu.observability.trace_analysis \
+                        import analyze_and_audit
+
+                    ab = None
+                    if args.observability.audit_hardware_config:
+                        from hetu_galvatron_tpu.core.search_engine.profiles \
+                            import read_alpha_beta
+
+                        try:
+                            ab = read_alpha_beta(
+                                args.observability.audit_hardware_config)
+                        except Exception as e:  # noqa: BLE001
+                            state.log(f"warning: audit_hardware_config "
+                                      f"unreadable ({e}); volume-only audit")
+                    # searched plans embed the cost model's per-layer
+                    # compute prediction (ms); audit_plan takes SECONDS
+                    pred_s = None
+                    if hpc.predicted_layer_compute_ms:
+                        pred_s = [v / 1e3
+                                  for v in hpc.predicted_layer_compute_ms]
+                    table = analyze_and_audit(
+                        args.profile.trace_dir, hpc, cfg,
+                        registry=telemetry.registry, alpha_beta=ab,
+                        mixed_precision=(
+                            args.parallel.mixed_precision != "fp32"),
+                        predicted_layer_s=pred_s)
+                    if table:
+                        state.log(
+                            f"plan audit: {len(table['rows'])} components "
+                            f"over {table['steps']} traced step(s) — see "
+                            "the plan_audit event / audit/* gauges in the "
+                            "metrics stream (cli/summarize.py renders the "
+                            "table)")
+                except Exception as e:  # noqa: BLE001 — never mask the crash
+                    state.log(f"warning: plan audit failed: {e}")
             if telemetry is not None:
                 telemetry.close()
         return sp, so

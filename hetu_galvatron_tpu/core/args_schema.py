@@ -327,6 +327,15 @@ class ObservabilityArgs(BaseModel):
     # (observability/telemetry.py) does not know the hardware (CPU smoke
     # runs, new TPU generations); 0 = autodetect-or-skip
     peak_tflops: float = 0.0
+    # predicted-vs-actual plan audit (observability/trace_analysis.py):
+    # when a trace window was captured (profile.trace_dir), attribute the
+    # device time and diff it against the plan's cost-model predictions at
+    # loop exit, emitting audit/* gauges + the plan_audit event
+    audit: bool = True
+    # allreduce-bandwidth JSON (hardware_profiler output) whose fitted α-β
+    # pairs price the audit's predicted collective times; None = volume-
+    # only audit (no fitted hardware profile at hand)
+    audit_hardware_config: Optional[str] = None
 
 
 class ServingArgs(BaseModel):
@@ -359,6 +368,14 @@ class ServingArgs(BaseModel):
     flush_interval: int = 32
     # JSONL metrics file for cli/serve.py; None derives ./serve_metrics.jsonl
     metrics_path: Optional[str] = None
+    # Prometheus text endpoint (observability/prometheus.py) exposing the
+    # serve/* registry metrics over stdlib HTTP: None = off (default),
+    # 0 = bind an ephemeral port (tests; the engine records the bound
+    # port), N = bind that port
+    metrics_port: Optional[int] = None
+    # bind address for the endpoint; loopback by default — the endpoint
+    # is unauthenticated, so exposing it (0.0.0.0) is an explicit choice
+    metrics_host: str = "127.0.0.1"
 
 
 class RerunArgs(BaseModel):

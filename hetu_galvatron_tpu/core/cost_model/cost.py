@@ -321,6 +321,59 @@ def tp_overlap_hidden_frac(s: "SearchStrategy", ctx: CostContext,
     return max(0.0, min(1.0, 1.0 - exposed / tp_time))
 
 
+def layer_time_components(s: "SearchStrategy", ctx: CostContext,
+                          gbsz: int, chunks: int) -> Dict[str, float]:
+    """Decomposed per-layer predicted times in ms: the same arithmetic
+    :func:`layer_time_cost` folds into one scalar, kept separated so the
+    plan audit (``observability/trace_analysis.py``) can compare each
+    component against the measured device-time attribution. Components are
+    the UN-overlapped magnitudes — the audit's measured side (per-HLO-op
+    category time) also counts collectives at face value, so the two sides
+    are comparable; the overlap splits are a property of the folded total,
+    not of the per-component prediction."""
+    n = ctx.layer_num
+    lbsz = gbsz // chunks // s.dp
+    fct, bct, tp_time = _tp_terms(s, ctx, gbsz, chunks)
+
+    param_mb = ctx.parameter_size / s.tp
+    dp_message = 2 * (s.sdp - 1) * (param_mb / s.sdp) * n
+    if ctx.mixed_precision:
+        dp_message /= 2
+    dc_key = f"{s.sdp}_0" if s.tp != 1 else f"{s.sdp}_1"
+    # the folded model only charges the gradient ring when dp > 1 (both
+    # result() overlap branches gate on s.dp); a dp==1 plan whose sdp > 1
+    # via cp/ulysses replicas pays only the ZeRO-3 all-gather premium —
+    # charging dp_message here would invent a component the search never
+    # priced, and total_ms must reconcile with layer_time_cost
+    dp_time = dp_message * ctx.comm_coe_dict[dc_key] if s.dp > 1 else 0.0
+    if s.dp_type == DPType.ZERO3 and s.sdp > 1:
+        dp_time += dp_message * 0.5 * ctx.comm_coe_dict[dc_key]
+
+    cp_time = 0.0
+    if s.cp > 1:
+        block_mb = (lbsz * ctx.seq_length * ctx.hidden_size / s.cp *
+                    (2 if ctx.mixed_precision else 4) / 1024 / 1024)
+        cp_key = f"{s.cp}_0" if s.tp != 1 else f"{s.cp}_1"
+        cp_coe = ctx.comm_coe_dict.get(
+            cp_key, ctx.comm_coe_dict.get(f"{s.cp}"))
+        cp_time = block_mb * 2 * (s.cp - 1) * 3 * cp_coe * n
+
+    pp_time = 0.0
+    if s.pp > 1 and ctx.p2p_comm_coe_dict is not None:
+        p2p_message = (s.pp * 2 * lbsz * ctx.seq_length * ctx.hidden_size *
+                       4 / 1024 / 1024)
+        if ctx.mixed_precision:
+            p2p_message /= 2
+        pp_time = p2p_message * ctx.p2p_comm_coe_dict[s.pp]
+
+    scale = ctx.costmodel_coe / n
+    out = {"fct_ms": fct * scale, "bct_ms": bct * scale,
+           "tp_ms": tp_time * scale, "dp_ms": dp_time * scale,
+           "cp_ms": cp_time * scale, "pp_ms": pp_time * scale}
+    out["total_ms"] = sum(out.values())
+    return out
+
+
 # ---------------------------------------------------------------------------
 # decoder-layer memory
 # ---------------------------------------------------------------------------

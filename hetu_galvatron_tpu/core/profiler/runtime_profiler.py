@@ -122,6 +122,22 @@ class RuntimeProfiler:
         """Idempotent; also called at loop exit so short runs still flush."""
         self._trace.stop()
 
+    def analyze_trace(self):
+        """Device-time attribution of the flushed capture window
+        (``observability/trace_analysis.attribute``), or None when no
+        window was configured or ever flushed."""
+        if not self._trace.enabled:
+            return None
+        from hetu_galvatron_tpu.observability.trace_analysis import (
+            attribute,
+            load_trace,
+        )
+
+        try:
+            return attribute(load_trace(self._trace.trace_dir))
+        except FileNotFoundError:
+            return None
+
     def time_end(self, it: int, sync: Any = None) -> None:
         if self._t0 is None:
             return

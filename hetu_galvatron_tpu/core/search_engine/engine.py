@@ -30,6 +30,7 @@ from hetu_galvatron_tpu.core.cost_model.cost import (
     embed_memory_cost,
     embed_time_cost,
     layer_memory_cost,
+    layer_time_components,
     layer_time_cost,
     pipeline_time_cost,
 )
@@ -634,6 +635,18 @@ class SearchEngine:
                 from dataclasses import replace as _replace
                 r = _replace(r, dp_type=default_dp)
             runtime.append(r)
+        # embed the winner's per-layer compute prediction (fct+bct, ms) so
+        # the runtime's plan audit diffs the EXACT model that picked the
+        # plan — without this the audit's compute row is measured-only
+        pred_ms: List[float] = []
+        li = 0
+        for lt, n in enumerate(self.layernum_list):
+            ctx = self.contexts[lt]
+            for _ in range(n):
+                comp = layer_time_components(
+                    best.strategy_list[li], ctx, best.bsz, best.chunks)
+                pred_ms.append(round(comp["fct_ms"] + comp["bct_ms"], 6))
+                li += 1
         cfg = strategy_list2config(
             runtime, global_bsz=best.bsz, chunks=best.chunks,
             pipeline_type=self.pipeline_type,
@@ -642,7 +655,8 @@ class SearchEngine:
                 vtp=best.vocab_tp_sp, vsp=bool(best.vocab_sp),
                 embed_sdp=bool(best.vocab_sdp)),
             pp_division=best.pp_stage_list,
-            num_encoder_layers=getattr(self, "num_encoder_layers", None))
+            num_encoder_layers=getattr(self, "num_encoder_layers", None),
+            predicted_layer_compute_ms=pred_ms)
         a = self.args
         off = [name for flag, name in (
             (a.disable_dp, "dp"), (a.disable_tp, "tp"), (a.disable_pp, "pp"),

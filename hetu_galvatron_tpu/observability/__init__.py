@@ -43,6 +43,19 @@ from hetu_galvatron_tpu.observability.telemetry import (
     peak_device_tflops,
     plan_comm_volume,
 )
+from hetu_galvatron_tpu.observability.trace_analysis import (
+    Attribution,
+    analyze_and_audit,
+    attribute,
+    audit_plan,
+    jit_cost_summary,
+    load_trace,
+    maybe_record_jit_cost,
+)
+from hetu_galvatron_tpu.observability.prometheus import (
+    MetricsHTTPServer,
+    prometheus_text,
+)
 
 __all__ = [
     "Counter",
@@ -61,4 +74,13 @@ __all__ = [
     "TrainingTelemetry",
     "peak_device_tflops",
     "plan_comm_volume",
+    "Attribution",
+    "analyze_and_audit",
+    "attribute",
+    "audit_plan",
+    "jit_cost_summary",
+    "load_trace",
+    "maybe_record_jit_cost",
+    "MetricsHTTPServer",
+    "prometheus_text",
 ]

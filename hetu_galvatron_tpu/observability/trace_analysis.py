@@ -1,0 +1,628 @@
+"""Close the loop: device-time attribution, program cost accounting, and
+the predicted-vs-actual plan audit.
+
+Everything upstream of this module *predicts*: the search engine prices a
+plan with an analytical cost model, ``plan_comm_volume`` predicts what the
+plan should communicate, and ``profile_alpha_beta`` fits latency/bandwidth
+pairs. Nothing checked those predictions against what the hardware actually
+did — the exact drift failure mode "Revisiting the Time Cost Model of
+AllReduce" (PAPERS.md) documents. This module is the feedback half:
+
+* **Trace parsing** — :func:`load_trace` reads the Chrome-trace JSON that
+  ``jax.profiler.stop_trace`` writes under ``<trace_dir>/plugins/profile/
+  <run>/*.trace.json.gz`` (this jax pin emits it next to the xplane proto;
+  stdlib gzip+json, no tensorflow needed). Torn/corrupt captures from
+  crashed runs are skipped, not fatal.
+* **Attribution** — :func:`attribute` classifies device-op events into
+  compute vs collective categories by HLO op-name stem (``all-reduce``,
+  ``all-gather``/``reduce-scatter``, ``all-to-all``,
+  ``collective-permute``), reconstructs host ``span()`` paths by interval
+  containment, attributes device time to annotations that propagated onto
+  device tracks (TPU; the CPU thunk trace carries ``hlo_op`` args
+  instead), and measures per-track idle time — the pipeline-bubble proxy.
+* **Cost accounting** — :func:`jit_cost_summary` /
+  :func:`maybe_record_jit_cost` wrap ``Lowered.cost_analysis()`` (no
+  backend compile — see the function docstring) so the train-step, both
+  pipeline engines, and the serving prefill/decode programs publish their
+  XLA-counted flops/bytes as ``cost/*`` gauges.
+* **Plan audit** — :func:`audit_plan` diffs the plan's predicted
+  per-component communication (``plan_comm_volume`` message sizes priced
+  through the fitted α-β pairs) against the measured attribution and emits
+  ``audit/*`` gauges plus one ``plan_audit`` event;
+  ``cli/summarize.py`` renders it as a calibration table. This is the
+  data source the topology-aware-collectives roadmap item consumes.
+
+Known attribution limits (documented, not hidden): collective→component
+mapping is by op kind, so ZeRO-3 parameter all-gathers land in the ``tp``
+bucket; the HOST pipeline engine moves stage activations with
+``jax.device_put`` DMAs, which never appear as ``collective-permute`` HLOs
+(the compiled engine's ``ppermute`` transfers do) — its ``pp`` component
+therefore measures near zero on the host path and the bubble/idle metric
+carries the schedule cost instead.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import re
+import weakref
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from hetu_galvatron_tpu.observability.registry import (
+    MetricsRegistry,
+    get_registry,
+)
+
+MB = 1024 * 1024
+
+# ---------------------------------------------------------------------------
+# trace loading (Chrome trace event format, jax.profiler output)
+# ---------------------------------------------------------------------------
+
+
+def latest_profile_dir(trace_dir: str) -> Optional[str]:
+    """Newest ``plugins/profile/<run>`` directory under a TraceCapture
+    trace_dir (run names are timestamps, so lexicographic max = newest);
+    None when no capture ever flushed."""
+    runs = sorted(glob.glob(os.path.join(trace_dir, "plugins", "profile",
+                                         "*")))
+    runs = [r for r in runs if os.path.isdir(r)]
+    return runs[-1] if runs else None
+
+
+@dataclass
+class TraceData:
+    """Merged events + track names from one profile run directory."""
+
+    events: List[dict]
+    process_names: Dict[int, str] = field(default_factory=dict)
+    thread_names: Dict[Tuple[int, int], str] = field(default_factory=dict)
+    path: str = ""
+
+
+def load_trace(trace_dir: str) -> TraceData:
+    """Parse the newest capture under ``trace_dir``. Accepts either the
+    TraceCapture root (``<dir>/plugins/profile/<run>/...``) or a run
+    directory itself. Unreadable/torn files are skipped — a crashed run's
+    half-written capture must not kill the post-mortem."""
+    run = trace_dir
+    if not glob.glob(os.path.join(run, "*.trace.json*")):
+        found = latest_profile_dir(trace_dir)
+        if found is None:
+            raise FileNotFoundError(
+                f"no trace capture under {trace_dir!r} (expected "
+                "plugins/profile/<run>/*.trace.json.gz)")
+        run = found
+    events: List[dict] = []
+    procs: Dict[int, str] = {}
+    threads: Dict[Tuple[int, int], str] = {}
+    for path in sorted(glob.glob(os.path.join(run, "*.trace.json.gz"))
+                       + glob.glob(os.path.join(run, "*.trace.json"))):
+        try:
+            opener = gzip.open if path.endswith(".gz") else open
+            with opener(path, "rt") as f:
+                obj = json.load(f)
+        except (OSError, EOFError, json.JSONDecodeError, UnicodeDecodeError):
+            continue
+        for e in obj.get("traceEvents", []) if isinstance(obj, dict) else []:
+            if not isinstance(e, dict):
+                continue
+            ph = e.get("ph")
+            if ph == "M":
+                args = e.get("args") or {}
+                if e.get("name") == "process_name":
+                    procs[e.get("pid")] = str(args.get("name", ""))
+                elif e.get("name") == "thread_name":
+                    threads[(e.get("pid"), e.get("tid"))] = str(
+                        args.get("name", ""))
+            elif ph == "X" and isinstance(e.get("dur"), (int, float)):
+                events.append(e)
+    return TraceData(events, procs, threads, run)
+
+
+# ---------------------------------------------------------------------------
+# event classification
+# ---------------------------------------------------------------------------
+
+# HLO op-name stems -> collective category. Async pairs
+# ("all-reduce-start"/"-done") both match their stem, so their durations
+# sum into the same bucket.
+_COLLECTIVE_STEMS: Tuple[Tuple[str, str], ...] = (
+    ("all-reduce", "allreduce"),
+    ("reduce-scatter", "reducescatter"),
+    ("all-gather", "allgather"),
+    ("all-to-all", "alltoall"),
+    ("collective-permute", "permute"),
+    ("collective-broadcast", "broadcast"),
+    ("send", "p2p"),
+    ("recv", "p2p"),
+)
+
+# span()-style annotation names: slash-separated identifier segments
+# ("train/step", "pp/fwd_s0", "layer3/attn"). HLO instruction names
+# ("fusion.12", "all-reduce.1") never contain '/'.
+_ANNOTATION_RE = re.compile(r"^[\w.\-]+(/[\w.\-]+)+$")
+_LAYER_RE = re.compile(r"(?:^|/)layer[_]?(\d+)(?:/|$)")
+
+
+def op_category(name: str) -> str:
+    base = name.lower()
+    for stem, cat in _COLLECTIVE_STEMS:
+        if base.startswith(stem):
+            return cat
+    return "compute"
+
+
+def _is_annotation(name: str) -> bool:
+    return bool(_ANNOTATION_RE.match(name))
+
+
+def _merged_busy_ms(intervals: List[Tuple[float, float]]) -> float:
+    """Total covered µs->ms of possibly-overlapping (start, end) pairs."""
+    if not intervals:
+        return 0.0
+    intervals.sort()
+    busy = 0.0
+    cur_s, cur_e = intervals[0]
+    for s, e in intervals[1:]:
+        if s > cur_e:
+            busy += cur_e - cur_s
+            cur_s, cur_e = s, e
+        else:
+            cur_e = max(cur_e, e)
+    busy += cur_e - cur_s
+    return busy / 1000.0
+
+
+@dataclass
+class Attribution:
+    """Measured device-time breakdown of one captured trace window.
+
+    Per-device quantities divide the summed device-track time by the
+    number of tracks, so they compare directly against the cost model's
+    per-device per-step predictions once divided by ``steps``."""
+
+    steps: int = 0
+    tracks: int = 0
+    wall_ms: float = 0.0              # first-to-last device op, one track's view
+    device_busy_ms: float = 0.0       # summed over tracks
+    per_device_busy_ms: float = 0.0
+    bubble_ms: float = 0.0            # per-device idle inside the wall window
+    bubble_frac: float = 0.0
+    categories_ms: Dict[str, float] = field(default_factory=dict)  # per-device
+    per_module_ms: Dict[str, float] = field(default_factory=dict)  # per-device
+    host_span_ms: Dict[str, float] = field(default_factory=dict)   # host wall
+    device_annotation_ms: Dict[str, float] = field(default_factory=dict)
+    per_layer_ms: Dict[int, float] = field(default_factory=dict)
+
+    @property
+    def collective_ms(self) -> float:
+        return sum(v for k, v in self.categories_ms.items()
+                   if k != "compute")
+
+    @property
+    def compute_ms(self) -> float:
+        return self.categories_ms.get("compute", 0.0)
+
+
+# host-span names that mark one optimizer step, tried in order: the SPMD
+# trainer loop, the compiled 1F1B engine, and the host pipeline engine
+# (one "pp/update" per step).
+STEP_SPANS = ("train/step", "pp/compiled_step", "pp/update")
+
+
+def attribute(trace: TraceData,
+              step_spans: Sequence[str] = STEP_SPANS) -> Attribution:
+    """Attribute the captured window. Device-op events are those carrying
+    ``hlo_op``/``hlo_module`` args (CPU thunk trace) or riding a
+    ``/device:*`` process (TPU tracks); annotation events are ``span()``
+    names, reconstructed into nesting paths per thread by interval
+    containment."""
+    dev_events: List[Tuple[int, int, float, float, str, str]] = []
+    ann_events: List[Tuple[int, int, float, float, str]] = []
+    for e in trace.events:
+        name = str(e.get("name", ""))
+        args = e.get("args") if isinstance(e.get("args"), dict) else {}
+        pid, tid = e.get("pid"), e.get("tid")
+        ts, dur = float(e.get("ts", 0.0)), float(e.get("dur", 0.0))
+        on_device = trace.process_names.get(pid, "").startswith("/device")
+        if "hlo_op" in args or "hlo_module" in args:
+            dev_events.append((pid, tid, ts, dur, name,
+                               str(args.get("hlo_module", ""))))
+        elif _is_annotation(name):
+            ann_events.append((pid, tid, ts, dur, name))
+        elif on_device and not name.startswith(("$", "Thread")) \
+                and "::" not in name:
+            dev_events.append((pid, tid, ts, dur, name, ""))
+
+    attr = Attribution()
+    if not dev_events and not ann_events:
+        return attr
+
+    # -- device tracks: busy/idle + category + module attribution --
+    by_track: Dict[Tuple[int, int], List[Tuple[float, float, str, str]]] = {}
+    for pid, tid, ts, dur, name, mod in dev_events:
+        by_track.setdefault((pid, tid), []).append((ts, dur, name, mod))
+    cats: Dict[str, float] = {}
+    mods: Dict[str, float] = {}
+    if by_track:
+        w0 = min(ts for evs in by_track.values() for ts, _, _, _ in evs)
+        w1 = max(ts + d for evs in by_track.values() for ts, d, _, _ in evs)
+        attr.wall_ms = (w1 - w0) / 1000.0
+        for evs in by_track.values():
+            busy = _merged_busy_ms([(ts, ts + d) for ts, d, _, _ in evs])
+            attr.device_busy_ms += busy
+            attr.bubble_ms += max(attr.wall_ms - busy, 0.0)
+            for ts, d, name, mod in evs:
+                cats[op_category(name)] = cats.get(
+                    op_category(name), 0.0) + d / 1000.0
+                if mod:
+                    mods[mod] = mods.get(mod, 0.0) + d / 1000.0
+        attr.tracks = len(by_track)
+        attr.per_device_busy_ms = attr.device_busy_ms / attr.tracks
+        attr.bubble_ms /= attr.tracks
+        denom = attr.per_device_busy_ms + attr.bubble_ms
+        attr.bubble_frac = attr.bubble_ms / denom if denom > 0 else 0.0
+        attr.categories_ms = {k: v / attr.tracks for k, v in cats.items()}
+        attr.per_module_ms = {k: v / attr.tracks for k, v in mods.items()}
+
+    # -- annotations: nesting paths (host spans) + device-track attribution
+    ann_by_track: Dict[Tuple[int, int], List[Tuple[float, float, str]]] = {}
+    for pid, tid, ts, dur, name in ann_events:
+        ann_by_track.setdefault((pid, tid), []).append((ts, dur, name))
+    # steps are counted PER TRACK and the max taken: on TPU the step
+    # annotation propagates onto every device track too, so a global sum
+    # would count (1 + num device tracks) per real step
+    step_counts: Dict[str, Dict[Tuple[int, int], int]] = {}
+    for (pid, tid), evs in ann_by_track.items():
+        # containment stack: events sorted by (start, -dur) so parents
+        # precede the children they cover
+        evs.sort(key=lambda t: (t[0], -t[1]))
+        stack: List[Tuple[float, str]] = []  # (end, path)
+        on_device = trace.process_names.get(pid, "").startswith("/device")
+        for ts, dur, name in evs:
+            while stack and ts >= stack[-1][0] - 1e-9:
+                stack.pop()
+            path = (stack[-1][1] + "/" + name) if stack else name
+            stack.append((ts + dur, path))
+            attr.host_span_ms[path] = attr.host_span_ms.get(path, 0.0) \
+                + dur / 1000.0
+            if name in step_spans:
+                per_track = step_counts.setdefault(name, {})
+                per_track[(pid, tid)] = per_track.get((pid, tid), 0) + 1
+            m = _LAYER_RE.search(name)
+            if m is not None:
+                attr.per_layer_ms[int(m.group(1))] = attr.per_layer_ms.get(
+                    int(m.group(1)), 0.0) + dur / 1000.0
+            if on_device and (pid, tid) in by_track:
+                # TPU device track: sum the device-op time the annotation
+                # interval covers (the propagated-name attribution)
+                covered = [(max(ts, ots), min(ts + dur, ots + od))
+                           for ots, od, _, _ in by_track[(pid, tid)]
+                           if ots < ts + dur and ots + od > ts]
+                attr.device_annotation_ms[name] = \
+                    attr.device_annotation_ms.get(name, 0.0) + \
+                    _merged_busy_ms([c for c in covered if c[1] > c[0]])
+    for name in step_spans:  # first marker that fired wins
+        if step_counts.get(name):
+            attr.steps = max(step_counts[name].values())
+            break
+    return attr
+
+
+# ---------------------------------------------------------------------------
+# compiled-program cost accounting (Compiled.cost_analysis)
+# ---------------------------------------------------------------------------
+
+
+def jit_cost_summary(fn: Any, args: Sequence[Any] = (),
+                     kwargs: Optional[Dict[str, Any]] = None
+                     ) -> Dict[str, float]:
+    """XLA's own static accounting for one jitted program: flops and bytes
+    accessed, read from the LOWERED module (``Lowered.cost_analysis()``).
+    Deliberately NO backend compile: on this jax pin an AOT
+    ``.lower().compile()`` does not populate the jit dispatch cache, so
+    compiling here would double every instrumented program's compile time
+    (minutes for the fused 1F1B program on TPU). ``args`` may be concrete
+    arrays or ``ShapeDtypeStruct``s — lowering never executes and never
+    consumes donated buffers. Returns {} when the backend cannot answer
+    (and never raises: this is telemetry, not the product)."""
+    try:
+        ca = fn.lower(*args, **(kwargs or {})).cost_analysis()
+        d = ca[0] if isinstance(ca, (list, tuple)) and ca else (ca or {})
+        out: Dict[str, float] = {}
+        if d.get("flops"):
+            out["flops"] = float(d["flops"])
+        if d.get("bytes accessed"):
+            out["bytes_accessed"] = float(d["bytes accessed"])
+        return out
+    except Exception:  # noqa: BLE001 — observability must never break a run
+        return {}
+
+
+# one record per (registry, program): keyed on the live registry object so
+# a reused id() after GC can never suppress a fresh registry's recording
+_RECORDED: "weakref.WeakKeyDictionary[MetricsRegistry, set]" = \
+    weakref.WeakKeyDictionary()
+
+
+def maybe_record_jit_cost(program: str, fn: Any, args: Sequence[Any] = (),
+                          kwargs: Optional[Dict[str, Any]] = None,
+                          registry: Optional[MetricsRegistry] = None
+                          ) -> Optional[Dict[str, float]]:
+    """Record one program's cost analysis as ``cost/*`` gauges (labelled
+    ``program=``) plus a one-shot ``program_cost`` event — once per
+    (registry, program). With no explicit registry AND no sinks configured
+    this is a no-op, so un-instrumented runs pay only a set lookup."""
+    reg = registry if registry is not None else get_registry()
+    if registry is None and not reg.sinks:
+        # only the process-default registry is sink-gated: an explicitly
+        # passed registry may be scraped sink-less (the Prometheus endpoint
+        # reads gauges directly), so its caller opted into the lower() cost
+        return None
+    seen = _RECORDED.setdefault(reg, set())
+    if program in seen:
+        return None
+    seen.add(program)
+    out = jit_cost_summary(fn, args, kwargs)
+    if not out:
+        return None
+    for k, v in out.items():
+        reg.gauge(f"cost/{k}", program=program).set(v)
+    reg.event("program_cost", {"program": program, **out})
+    return out
+
+
+# ---------------------------------------------------------------------------
+# predicted communication (plan + fitted α-β pairs)
+# ---------------------------------------------------------------------------
+
+
+def _ab_for(alpha_beta: Dict[str, Tuple[float, float]], size: int,
+            consec: bool) -> Optional[Tuple[float, float]]:
+    return (alpha_beta.get(f"{size}_{1 if consec else 0}")
+            or alpha_beta.get(f"{size}_1") or alpha_beta.get(f"{size}_0"))
+
+
+def predicted_comm_per_step(
+    hpc: Any,
+    model: Any,
+    *,
+    alpha_beta: Optional[Dict[str, Tuple[float, float]]] = None,
+    mixed_precision: bool = True,
+) -> Dict[str, Dict[str, float]]:
+    """Per component (tp/dp/sp/cp/pp): the plan's predicted per-step MB
+    (``plan_comm_volume``) and — for the allreduce-derived collectives,
+    when fitted α-β pairs are available — the predicted per-device ms,
+    priced exactly the way the cost model prices them: one Megatron-SP
+    ag/rs-equivalent message costs ``0.5 * (α + size/β)``
+    (``cost_model.cost._tp_message_ms``) and a dp ring all-reduce of a
+    ``size``-MB gradient buffer costs ``α + size/β`` (the curve
+    ``profile_alpha_beta`` fitted). sp/cp/pp volumes are reported MB-only:
+    their collectives were not fitted on the allreduce curve, so a time
+    prediction here would be invented, not measured.
+
+    The measured side (``Attribution``) is a per-device-track average, and
+    each device only runs the layers of its own pipeline stage — so the
+    priced times sum over all layers and divide by ``pp_deg`` (the uniform
+    per-device average; volumes stay whole-plan MB)."""
+    from hetu_galvatron_tpu.observability.telemetry import (
+        layer_param_mb,
+        plan_comm_volume,
+    )
+
+    chunks = max(hpc.chunks, 1)
+    pp = max(getattr(hpc, "pp_deg", 1), 1)
+    vols = plan_comm_volume(hpc.layers, model, global_bsz=hpc.global_bsz,
+                            chunks=chunks, mixed_precision=mixed_precision)
+    ab = alpha_beta or {}
+    param_mb = layer_param_mb(model)
+    seq, h = model.seq_length, model.hidden_size
+    elem = 2 if mixed_precision else 4
+    out: Dict[str, Dict[str, float]] = {
+        c: {"predicted_mb": 0.0} for c in ("tp", "dp", "sp", "cp", "pp")}
+    for s, v in zip(hpc.layers, vols):
+        ulysses = s.tp_size if s.sp else 1
+        out["sp" if ulysses > 1 else "tp"]["predicted_mb"] += \
+            v["tp_collective_mb"]
+        out["dp"]["predicted_mb"] += v["dp_allreduce_mb"]
+        out["cp"]["predicted_mb"] += v["cp_ring_mb"]
+        out["pp"]["predicted_mb"] += v["pp_p2p_mb"]
+        # α-β time predictions (allreduce-fitted collectives only)
+        tp = 1 if s.sp else s.tp_size
+        lbsz = max(hpc.global_bsz // chunks // max(s.dp_size, 1), 1)
+        if tp > 1:
+            # mirror cost._tp_message_ms EXACTLY: the search only ever
+            # prices tp with the "{tp}_1" pair (tp groups are consecutive
+            # by construction) — auditing against any other pair would
+            # measure drift vs a curve the search never used
+            pair = ab.get(f"{tp}_1")
+            if pair is not None:
+                alpha, beta = pair
+                act_mb = lbsz * seq * h * elem / MB
+                n_msgs = 6 * chunks * (1.5 if s.checkpoint else 1.0)
+                out["tp"]["predicted_ms"] = out["tp"].get(
+                    "predicted_ms", 0.0) + \
+                    n_msgs * 0.5 * (alpha + act_mb / beta) / pp
+        sdp = max(s.dp_size * s.cp_size * ulysses, 1)
+        if sdp > 1:
+            # dc_key convention (cost.py): tp>1 groups leave dp strided
+            pair = _ab_for(ab, sdp, tp == 1)
+            if pair is not None:
+                alpha, beta = pair
+                grad_mb = param_mb / max(tp, 1) * \
+                    (0.5 if mixed_precision else 1.0)
+                out["dp"]["predicted_ms"] = out["dp"].get(
+                    "predicted_ms", 0.0) + (alpha + grad_mb / beta) / pp
+    return {c: d for c, d in out.items()
+            if d["predicted_mb"] or d.get("predicted_ms")}
+
+
+# ---------------------------------------------------------------------------
+# the plan audit
+# ---------------------------------------------------------------------------
+
+
+def measured_components(attr: Attribution, hpc: Any) -> Dict[str, float]:
+    """Map measured collective categories onto plan components using the
+    plan as the disambiguator: ag/rs -> tp (Megatron-SP activations; ZeRO-3
+    parameter gathers land here too — documented), a2a -> sp (Ulysses),
+    allreduce -> dp when the plan has a dp/ZeRO shard group else tp (plain
+    TP without SP all-reduces activations), permute/p2p -> pp when the
+    plan is pipelined, else cp (ring attention), else tp (ring overlap)."""
+    cat = attr.categories_ms
+    any_sdp = any(
+        max(s.dp_size * s.cp_size * (s.tp_size if s.sp else 1), 1) > 1
+        for s in hpc.layers)
+    any_cp = any(s.cp_size > 1 for s in hpc.layers)
+    permute_to = ("pp" if hpc.pp_deg > 1 else ("cp" if any_cp else "tp"))
+    out: Dict[str, float] = {}
+
+    def add(comp, ms):
+        if ms:
+            out[comp] = out.get(comp, 0.0) + ms
+
+    add("tp", cat.get("allgather", 0.0) + cat.get("reducescatter", 0.0))
+    add("sp", cat.get("alltoall", 0.0))
+    add("dp" if any_sdp else "tp", cat.get("allreduce", 0.0))
+    add(permute_to, cat.get("permute", 0.0) + cat.get("p2p", 0.0)
+        + cat.get("broadcast", 0.0))
+    return out
+
+
+def audit_plan(
+    attr: Attribution,
+    hpc: Any,
+    model: Any,
+    *,
+    registry: Optional[MetricsRegistry] = None,
+    alpha_beta: Optional[Dict[str, Tuple[float, float]]] = None,
+    mixed_precision: bool = True,
+    predicted_layer_s: Optional[Sequence[float]] = None,
+    steps: Optional[int] = None,
+) -> Dict[str, Any]:
+    """Diff the active plan's predictions against the measured attribution
+    and emit the calibration data: per component, predicted MB + (α-β)
+    predicted ms vs measured per-step per-device ms, the measured/predicted
+    time ratio, and the α-β residual (measured − predicted, the number the
+    topology-aware collective-selection work needs to know when the fitted
+    curve has drifted). Also audits compute time against the cost model's
+    per-layer predictions when given, and the pipeline bubble fraction
+    against the 1F1B analytical ``2(pp−1)/(m+2(pp−1))``.
+
+    Emits ``audit/*`` gauges (labelled ``component=``) into ``registry``
+    (the process default when omitted) plus one ``plan_audit`` event
+    carrying the whole table for ``cli/summarize.py``; returns the table.
+    """
+    reg = registry if registry is not None else get_registry()
+    n_steps = steps or attr.steps or 1
+    measured = {c: ms / n_steps for c, ms in
+                measured_components(attr, hpc).items()}
+    predicted = predicted_comm_per_step(
+        hpc, model, alpha_beta=alpha_beta, mixed_precision=mixed_precision)
+
+    rows: List[Dict[str, Any]] = []
+    for comp in ("tp", "dp", "sp", "cp", "pp"):
+        m_ms = measured.get(comp)
+        pred = predicted.get(comp, {})
+        if m_ms is None and not pred:
+            continue
+        row: Dict[str, Any] = {"component": comp,
+                               "measured_ms": round(m_ms or 0.0, 4),
+                               "predicted_mb": round(
+                                   pred.get("predicted_mb", 0.0), 3)}
+        p_ms = pred.get("predicted_ms")
+        if p_ms:
+            row["predicted_ms"] = round(p_ms, 4)
+            row["ratio"] = round((m_ms or 0.0) / p_ms, 4)
+            row["residual_ms"] = round((m_ms or 0.0) - p_ms, 4)
+        rows.append(row)
+
+    compute_row: Dict[str, Any] = {
+        "component": "compute",
+        "measured_ms": round(attr.compute_ms / n_steps, 4)}
+    if predicted_layer_s:
+        # predicted_layer_s is per-layer SECONDS for ONE microbatch (the
+        # cost model prices at lbsz = gbsz/chunks/dp; the parameter name
+        # carries the unit so callers cannot pass ms by mistake). One
+        # optimizer step runs `chunks` microbatches, and the measured side
+        # is a per-device average where each device executes only its own
+        # stage's layers — scale by chunks/pp to the same normalization.
+        p = (float(sum(predicted_layer_s)) * 1000.0
+             * max(hpc.chunks, 1) / max(hpc.pp_deg, 1))
+        compute_row["predicted_ms"] = round(p, 4)
+        if p > 0:
+            compute_row["ratio"] = round(
+                attr.compute_ms / n_steps / p, 4)
+            compute_row["residual_ms"] = round(
+                attr.compute_ms / n_steps - p, 4)
+    rows.append(compute_row)
+
+    bubble_row: Dict[str, Any] = {"component": "bubble",
+                                  "measured_frac": round(attr.bubble_frac, 4)}
+    if hpc.pp_deg > 1:
+        m = max(hpc.chunks, 1)
+        bubble_row["predicted_frac"] = round(
+            2 * (hpc.pp_deg - 1) / (m + 2 * (hpc.pp_deg - 1)), 4)
+    rows.append(bubble_row)
+
+    table = {
+        "steps": n_steps,
+        "tracks": attr.tracks,
+        "step_device_ms": round(attr.per_device_busy_ms / n_steps, 4),
+        "rows": rows,
+    }
+    for row in rows:
+        comp = row["component"]
+        for key, gauge in (("measured_ms", "audit/measured_ms"),
+                           ("predicted_ms", "audit/predicted_ms"),
+                           ("ratio", "audit/time_ratio"),
+                           ("residual_ms", "audit/residual_ms"),
+                           ("predicted_mb", "audit/predicted_mb"),
+                           ("measured_frac", "audit/measured_frac"),
+                           ("predicted_frac", "audit/predicted_frac")):
+            if key in row:
+                reg.gauge(gauge, component=comp).set(row[key])
+    reg.gauge("audit/step_device_ms").set(table["step_device_ms"])
+    reg.event("plan_audit", table)
+    return table
+
+
+def analyze_and_audit(
+    trace_dir: str,
+    hpc: Any,
+    model: Any,
+    *,
+    registry: Optional[MetricsRegistry] = None,
+    alpha_beta: Optional[Dict[str, Tuple[float, float]]] = None,
+    mixed_precision: bool = True,
+    predicted_layer_s: Optional[Sequence[float]] = None,
+    step_spans: Sequence[str] = STEP_SPANS,
+) -> Optional[Dict[str, Any]]:
+    """One-call closed loop for the launchers: parse the newest capture
+    under ``trace_dir``, attribute it, audit it against the plan. Thread
+    per-layer per-MICROBATCH compute predictions in SECONDS via
+    ``predicted_layer_s`` to get a compute-row ratio (``audit_plan`` scales
+    them by chunks/pp itself) — searched plans carry them as
+    ``hpc.predicted_layer_compute_ms`` (``cost_model.layer_time_components``
+    fct+bct, in MILLISECONDS — divide by 1e3 before passing, as
+    ``cli/train_dist.py`` does); without it the compute row is
+    measured-only. Returns the audit
+    table, or None when no capture/attribution is available (never raises
+    — this runs in crash-path ``finally`` blocks)."""
+    try:
+        attr = attribute(load_trace(trace_dir), step_spans=step_spans)
+        if not attr.tracks and not attr.host_span_ms:
+            return None
+        return audit_plan(attr, hpc, model, registry=registry,
+                          alpha_beta=alpha_beta,
+                          mixed_precision=mixed_precision,
+                          predicted_layer_s=predicted_layer_s)
+    except FileNotFoundError:
+        return None
+    except Exception:  # noqa: BLE001 — post-mortem helper, never fatal
+        return None

@@ -75,6 +75,9 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from hetu_galvatron_tpu.core.args_schema import ModelArgs, TrainArgs
 from hetu_galvatron_tpu.models import modules as M
 from hetu_galvatron_tpu.observability.registry import get_registry
+from hetu_galvatron_tpu.observability.trace_analysis import (
+    maybe_record_jit_cost,
+)
 from hetu_galvatron_tpu.observability.tracing import span
 from hetu_galvatron_tpu.runtime.hybrid_config import HybridParallelConfig
 from hetu_galvatron_tpu.runtime.mesh import (
@@ -606,6 +609,13 @@ class CompiledPipelineEngine:
         if m not in self._step_jits:
             self._step_jits[m] = self._build_step(m, self._use_dropout)
         fn = self._step_jits[m]
+        # XLA-counted flops/bytes for the fused program (cost/* gauges;
+        # no-op without a metrics sink). BEFORE the call: the step donates
+        # (sp, opt, batch), and lowering only reads avals
+        maybe_record_jit_cost(
+            f"pp/compiled_step_m{m}", fn,
+            (sp, opt, batch, step_rng) if self._use_dropout
+            else (sp, opt, batch))
         with span("pp/compiled_step"):
             if self._use_dropout:
                 out = fn(sp, opt, batch, step_rng)

@@ -13,7 +13,7 @@ microbatch count.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import List
+from typing import List, Optional
 
 import math
 
@@ -55,6 +55,10 @@ class HybridParallelConfig:
     # Interleaved virtual stages (beyond the reference): pp_division has
     # pp_deg * vpp_deg entries; chunk c runs on physical group c % pp_deg.
     vpp_deg: int = 1
+    # Searched plans carry the cost model's per-layer compute prediction
+    # (fct+bct, ms) so the plan audit can diff the exact model that picked
+    # the plan; None for GLOBAL-mode or pre-audit plan files.
+    predicted_layer_compute_ms: Optional[List[float]] = None
 
     @property
     def enc_strategies(self) -> List[LayerStrategy]:
@@ -135,6 +139,7 @@ def get_hybrid_parallel_config(
         vpp = max(extras.get("vpp_deg", 1), 1)
         pp_division = extras["pp_division"] or default_pp_division(
             n_layers, pp_deg * vpp)
+        pred_layer_ms = extras.get("predicted_layer_compute_ms")
     else:
         pp_deg = par.pp_deg
         if world_size % pp_deg:
@@ -166,6 +171,7 @@ def get_hybrid_parallel_config(
         vpp = max(par.virtual_pp_deg, 1)
         pp_division = default_pp_division(n_layers, pp_deg * vpp)
         chunks = get_chunks(args, world_size)
+        pred_layer_ms = None
 
     # guard both branches: a JSON plan with pp*vpp > layers would otherwise
     # slip through as zero-layer chunks from default_pp_division
@@ -208,5 +214,5 @@ def get_hybrid_parallel_config(
         pp_division=list(pp_division), chunks=chunks, global_bsz=global_bsz,
         pipeline_type=pipeline_type, default_dp_type=default_dp,
         world_size=world_size, num_encoder_layers=n_enc, vpp_deg=vpp,
-        cp_zigzag=cp_zigzag,
+        cp_zigzag=cp_zigzag, predicted_layer_compute_ms=pred_layer_ms,
     )

@@ -203,6 +203,18 @@ def train_loop(
                 batch = put(next(data_iter))
             if use_dropout:
                 batch["dropout_rng"] = jax.random.fold_in(drop_key, it)
+            if it == 0:
+                # XLA's own flops/bytes for the step program (cost/* gauges;
+                # no-op unless a metrics sink is configured). BEFORE the
+                # call: lowering only reads avals, so donated buffers are
+                # still valid (and it stays lowering-only — no extra
+                # backend compile).
+                from hetu_galvatron_tpu.observability.trace_analysis import (
+                    maybe_record_jit_cost,
+                )
+
+                maybe_record_jit_cost("train/step", train_step,
+                                      (params, opt_state, batch))
             with span("train/step"):
                 params, opt_state, metrics = train_step(
                     params, opt_state, batch)

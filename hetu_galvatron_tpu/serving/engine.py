@@ -48,6 +48,9 @@ from hetu_galvatron_tpu.observability.registry import (
     MetricsRegistry,
     get_registry,
 )
+from hetu_galvatron_tpu.observability.trace_analysis import (
+    maybe_record_jit_cost,
+)
 from hetu_galvatron_tpu.serving.kv_cache import (
     PagedKVCache,
     gather_pages,
@@ -206,6 +209,20 @@ class ServingEngine:
         self._decode_fn = self._build_decode()
         self._prefill_fns: Dict[int, Callable] = {}
 
+        # Prometheus /metrics endpoint (serving.metrics_port): off unless
+        # asked for; port 0 binds ephemeral and .metrics_port reports it
+        self.metrics_server = None
+        self.metrics_port: Optional[int] = None
+        if serving.metrics_port is not None:
+            from hetu_galvatron_tpu.observability.prometheus import (
+                MetricsHTTPServer,
+            )
+
+            self.metrics_server = MetricsHTTPServer(
+                self.registry, port=int(serving.metrics_port),
+                host=serving.metrics_host)
+            self.metrics_port = self.metrics_server.start()
+
         self._lock = threading.RLock()
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -360,9 +377,15 @@ class ServingEngine:
         for b in buckets:
             fn = self._prefill_for(b)
             table = np.zeros((b // self.kv.block_size,), np.int32)
-            new_pools, tok = fn(self.params, self.kv.pools,
-                                jnp.zeros((1, b), jnp.int32),
-                                jnp.asarray(table), 1, 0.0, 0)
+            args = (self.params, self.kv.pools,
+                    jnp.zeros((1, b), jnp.int32),
+                    jnp.asarray(table), 1, 0.0, 0)
+            # record the bucket's XLA flops/bytes here, off the request
+            # path: the one-shot lower() is a full retrace, and TTFT must
+            # never pay it (BEFORE the call — the program donates pools)
+            maybe_record_jit_cost(f"serve/prefill_{b}", fn, args,
+                                  registry=self.registry)
+            new_pools, tok = fn(*args)
             self.kv.pools = new_pools
             jax.block_until_ready(tok)
         toks = self._run_decode(self.scheduler.decode_state())
@@ -504,23 +527,32 @@ class ServingEngine:
         table = np.asarray(slot.blocks[: bucket // self.kv.block_size],
                            np.int32)
         fn = self._prefill_for(bucket)
-        new_pools, tok = fn(self.params, self.kv.pools, jnp.asarray(padded),
-                            jnp.asarray(table), prompt_len,
-                            float(req.temperature), int(req.seed))
+        args = (self.params, self.kv.pools, jnp.asarray(padded),
+                jnp.asarray(table), prompt_len,
+                float(req.temperature), int(req.seed))
+        # fallback for buckets warmup() never covered — warmed buckets
+        # were recorded there, so this early-outs to a set lookup and the
+        # request path never pays the lower() retrace (BEFORE the call —
+        # the program donates the pools)
+        maybe_record_jit_cost(f"serve/prefill_{bucket}", fn, args,
+                              registry=self.registry)
+        new_pools, tok = fn(*args)
         self.kv.pools = new_pools
         tok = int(np.asarray(tok))
         self.registry.counter("serve/prefill_tokens").inc(prompt_len)
         self._emit(slot, tok, first=True)
 
     def _run_decode(self, state) -> np.ndarray:
-        new_pools, toks = self._decode_fn(
-            self.params, self.kv.pools,
-            jnp.asarray(state["tokens"], jnp.int32),
-            jnp.asarray(state["pos"], jnp.int32),
-            jnp.asarray(state["tables"], jnp.int32),
-            jnp.asarray(state["temps"], jnp.float32),
-            jnp.asarray(state["seeds"], jnp.int32),
-            jnp.asarray(state["gen_idx"], jnp.int32))
+        args = (self.params, self.kv.pools,
+                jnp.asarray(state["tokens"], jnp.int32),
+                jnp.asarray(state["pos"], jnp.int32),
+                jnp.asarray(state["tables"], jnp.int32),
+                jnp.asarray(state["temps"], jnp.float32),
+                jnp.asarray(state["seeds"], jnp.int32),
+                jnp.asarray(state["gen_idx"], jnp.int32))
+        maybe_record_jit_cost("serve/decode", self._decode_fn, args,
+                              registry=self.registry)
+        new_pools, toks = self._decode_fn(*args)
         self.kv.pools = new_pools
         return np.asarray(toks)
 
@@ -590,6 +622,9 @@ class ServingEngine:
         self._closed = True
         self.stop()
         self.flush()
+        if self.metrics_server is not None:
+            self.metrics_server.stop()
+            self.metrics_server = None
 
     def __enter__(self):
         return self

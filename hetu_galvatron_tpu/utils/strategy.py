@@ -158,6 +158,7 @@ def strategy_list2config(
     pp_division: Optional[Sequence[int]] = None,
     num_encoder_layers: Optional[int] = None,
     vpp_deg: Optional[int] = None,
+    predicted_layer_compute_ms: Optional[Sequence[float]] = None,
 ) -> Dict[str, Any]:
     """Serialize per-layer strategies to the interchange dict.
 
@@ -216,6 +217,18 @@ def strategy_list2config(
         # interleaved virtual stages (beyond the reference): pp_division then
         # has pp_deg * vpp_deg entries, chunk c on physical group c % pp_deg
         cfg["vpp_deg"] = int(vpp_deg)
+    if predicted_layer_compute_ms is not None:
+        # the cost model's per-layer COMPUTE prediction (fct+bct ms, no
+        # collectives — those are re-priced from plan_comm_volume at audit
+        # time), embedded so the runtime's plan audit diffs the exact model
+        # that picked the plan without needing the profile files
+        if len(predicted_layer_compute_ms) != len(strategies):
+            raise ValueError(
+                f"predicted_layer_compute_ms has "
+                f"{len(predicted_layer_compute_ms)} entries for "
+                f"{len(strategies)} layers")
+        cfg["predicted_layer_compute_ms"] = [
+            float(x) for x in predicted_layer_compute_ms]
     return cfg
 
 
@@ -289,6 +302,13 @@ def config2strategy(
         "num_encoder_layers": (int(cfg["num_encoder_layers"])
                                if "num_encoder_layers" in cfg else None),
         "vpp_deg": int(cfg.get("vpp_deg", 1)),
+        # optional per-layer compute prediction (see strategy_list2config);
+        # a hand-edited plan whose vector no longer matches the layer count
+        # is dropped rather than mis-attributed to the wrong layers
+        "predicted_layer_compute_ms": (
+            [float(x) for x in cfg["predicted_layer_compute_ms"]]
+            if isinstance(cfg.get("predicted_layer_compute_ms"), list)
+            and len(cfg["predicted_layer_compute_ms"]) == n else None),
     }
     return strategies, vocab, extras
 

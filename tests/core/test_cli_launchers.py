@@ -111,6 +111,10 @@ def test_search_dist_cli(tmp_path, capsys):
     assert "max throughput 2.64850914" in out
     files = os.listdir(tmp_path)
     assert len(files) == 1 and files[0].startswith("galvatron_config_")
+    # the winner embeds its per-layer compute prediction for the plan audit
+    cfg = json.load(open(os.path.join(tmp_path, files[0])))
+    pred = cfg["predicted_layer_compute_ms"]
+    assert len(pred) == 28 and all(v > 0 for v in pred)
 
 
 def test_profiler_cli_computation(tmp_path, capsys):

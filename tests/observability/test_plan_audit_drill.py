@@ -1,0 +1,121 @@
+"""Acceptance drill: on the virtual 8-device mesh, train under a searched
+tp2 x dp2 x pp2 plan with a trace window + fitted α-β pairs, and the closed
+loop must report per-component predicted-vs-actual ratios in the plan_audit
+event, with cli/summarize.py rendering the calibration table."""
+
+import io
+import json
+import os
+
+import pytest
+
+from hetu_galvatron_tpu.utils.strategy import (
+    EmbeddingLMHeadStrategy,
+    LayerStrategy,
+    strategy_list2config,
+)
+
+pytestmark = [pytest.mark.observability, pytest.mark.distributed]
+
+ZOO = os.path.join(os.path.dirname(__file__), "..", "..",
+                   "hetu_galvatron_tpu", "models", "configs")
+
+
+def _searched_plan(tmp_path):
+    """tp2 x dp2 x pp2 in the searched-config interchange format (what the
+    search engine's save_results writes and config_mode=json loads)."""
+    layers = [LayerStrategy(pp_deg=2, tp_size=2, dp_size=2)
+              for _ in range(2)]
+    cfg = strategy_list2config(
+        layers, global_bsz=8, chunks=2, pipeline_type="pipedream_flush",
+        default_dp_type="ddp", vocab=EmbeddingLMHeadStrategy(vtp=1),
+        pp_division=[1, 1],
+        # save_results embeds the cost model's per-layer compute prediction
+        # (fct+bct ms); the audit's compute row must pick it up
+        predicted_layer_compute_ms=[0.5, 0.5])
+    path = tmp_path / "galvatron_config_audit_drill.json"
+    path.write_text(json.dumps(cfg))
+    return str(path)
+
+
+def test_plan_audit_drill_mesh8(tmp_path):
+    from hetu_galvatron_tpu.cli.summarize import summarize
+    from hetu_galvatron_tpu.cli.train_dist import main
+
+    # fitted α-β pairs for the plan's group size (2, both layouts): the
+    # keys hardware_profiler.profile_alpha_beta writes
+    hw = {"allreduce_size_2_consec_1_alpha_ms": 0.02,
+          "allreduce_size_2_consec_1_beta_mb_per_ms": 400.0,
+          "allreduce_size_2_consec_0_alpha_ms": 0.03,
+          "allreduce_size_2_consec_0_beta_mb_per_ms": 300.0}
+    hw_path = tmp_path / "hw_alpha_beta.json"
+    hw_path.write_text(json.dumps(hw))
+    metrics = str(tmp_path / "metrics.jsonl")
+
+    rc = main([
+        os.path.join(ZOO, "llama2-7b.yaml"),
+        "model.hidden_size=32", "model.num_hidden_layers=2",
+        "model.num_attention_heads=2", "model.num_key_value_heads=2",
+        "model.vocab_size=64", "model.seq_length=8",
+        "model.max_position_embeddings=16", "model.ffn_hidden_size=64",
+        "model.make_vocab_size_divisible_by=1",
+        "train.train_iters=3", "parallel.mixed_precision=fp32",
+        "parallel.config_mode=json",
+        f"parallel.galvatron_config_path={_searched_plan(tmp_path)}",
+        "observability.enabled=true",
+        f"observability.metrics_path={metrics}",
+        f"observability.audit_hardware_config={hw_path}",
+        f"profile.trace_dir={tmp_path / 'trace'}",
+        "profile.profile_warmup=1", "profile.trace_iters=2",
+    ])
+    assert rc == 0
+
+    records = [json.loads(l) for l in open(metrics)]
+    audits = [r for r in records if r.get("kind") == "event"
+              and r.get("name") == "plan_audit"]
+    assert len(audits) == 1
+    table = audits[-1]["data"]
+    assert table["steps"] == 2  # the traced window
+    rows = {r["component"]: r for r in table["rows"]}
+
+    # per-component predicted-vs-actual: the pipelined plan communicates
+    # on tp (ag/rs), dp (grad all-reduce), and pp (stage transfers); the
+    # α-β pairs price tp and dp, so those rows carry RATIOS
+    for comp in ("tp", "dp"):
+        row = rows[comp]
+        assert row["measured_ms"] > 0
+        assert row["predicted_ms"] > 0
+        assert row["ratio"] == pytest.approx(
+            row["measured_ms"] / row["predicted_ms"], rel=1e-2)
+        assert row["residual_ms"] == pytest.approx(
+            row["measured_ms"] - row["predicted_ms"], abs=1e-3)
+    # the compute row diffs against the plan-embedded per-layer prediction
+    comp = rows["compute"]
+    assert comp["measured_ms"] > 0
+    assert comp["predicted_ms"] == pytest.approx(1.0)  # 2 x 0.5 ms
+    assert comp["ratio"] == pytest.approx(comp["measured_ms"] / 1.0, rel=1e-2)
+    assert comp["residual_ms"] == pytest.approx(
+        comp["measured_ms"] - 1.0, abs=1e-3)
+    # 1F1B analytical bubble for pp2, m=2 chunks: 2(pp-1)/(m+2(pp-1))
+    assert rows["bubble"]["predicted_frac"] == pytest.approx(0.5)
+    assert 0.0 <= rows["bubble"]["measured_frac"] <= 1.0
+
+    # audit gauges landed in the stream too
+    gauges = {(r["name"], tuple(sorted((r.get("labels") or {}).items())))
+              for r in records if r.get("kind") == "gauge"}
+    assert ("audit/time_ratio", (("component", "tp"),)) in gauges
+
+    # the program cost accounting fired for the pipeline stage programs
+    progs = {r["data"]["program"] for r in records
+             if r.get("kind") == "event" and r.get("name") == "program_cost"}
+    assert any(p.startswith("pp/") for p in progs)
+
+    # summarize renders the calibration table with the ratio column
+    buf = io.StringIO()
+    headline = summarize(metrics, out=buf)
+    text = buf.getvalue()
+    assert "plan audit: predicted vs actual" in text
+    assert "ratio" in text and "residual" in text
+    assert headline["audit_ratio_tp"] == rows["tp"]["ratio"]
+    assert headline["audit_ratio_dp"] == rows["dp"]["ratio"]
+    assert "program costs (XLA cost_analysis)" in text

@@ -1,0 +1,115 @@
+"""Prometheus text-format exposition + the stdlib /metrics endpoint:
+rendering rules (sanitized names, counter _total, histogram summary
+convention, label escaping), a live scrape smoke over an ephemeral port,
+and the serving.metrics_port engine wiring."""
+
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from hetu_galvatron_tpu.observability.prometheus import (
+    MetricsHTTPServer,
+    prometheus_text,
+    sanitize_name,
+)
+from hetu_galvatron_tpu.observability.registry import MetricsRegistry
+
+pytestmark = pytest.mark.observability
+
+
+def test_sanitize_name():
+    assert sanitize_name("serve/ttft_ms") == "serve_ttft_ms"
+    assert sanitize_name("audit/time_ratio") == "audit_time_ratio"
+    assert sanitize_name("9lives") == "_9lives"
+
+
+def test_prometheus_text_rendering():
+    reg = MetricsRegistry()
+    reg.counter("serve/requests", outcome="completed").inc(3)
+    reg.gauge("serve/kv_occupancy").set(0.25)
+    h = reg.histogram("serve/ttft_ms")
+    for v in (10.0, 20.0, 30.0):
+        h.observe(v)
+    reg.gauge("audit/time_ratio", component="tp").set(1.2)
+    text = prometheus_text(reg)
+    lines = text.strip().splitlines()
+    assert "# TYPE serve_requests_total counter" in lines
+    assert 'serve_requests_total{outcome="completed"} 3.0' in lines
+    assert "serve_kv_occupancy 0.25" in lines
+    assert "# TYPE serve_ttft_ms summary" in lines
+    assert 'serve_ttft_ms{quantile="0.5"} 20.0' in lines
+    assert "serve_ttft_ms_sum 60.0" in lines
+    assert "serve_ttft_ms_count 3" in lines
+    assert 'audit_time_ratio{component="tp"} 1.2' in lines
+    assert text.endswith("\n")
+
+
+def test_prometheus_label_escaping():
+    reg = MetricsRegistry()
+    reg.gauge("g", reason='quo"te\nnl').set(1.0)
+    text = prometheus_text(reg)
+    assert 'reason="quo\\"te\\nnl"' in text
+
+
+def test_http_server_scrape_smoke():
+    reg = MetricsRegistry()
+    reg.counter("serve/submitted").inc(7)
+    with MetricsHTTPServer(reg, port=0, host="127.0.0.1") as srv:
+        assert srv.port > 0  # ephemeral port was bound and reported
+        url = f"http://127.0.0.1:{srv.port}/metrics"
+        with urllib.request.urlopen(url, timeout=5) as resp:
+            assert resp.status == 200
+            assert resp.headers["Content-Type"].startswith("text/plain")
+            body = resp.read().decode()
+        assert "serve_submitted_total 7.0" in body
+        # scrapes see live values, not a bind-time snapshot
+        reg.counter("serve/submitted").inc()
+        with urllib.request.urlopen(url, timeout=5) as resp:
+            assert "serve_submitted_total 8.0" in resp.read().decode()
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/nope", timeout=5)
+    srv.stop()  # idempotent after the context exit
+
+
+def test_serving_engine_metrics_port_wiring():
+    """serving.metrics_port=0 binds an ephemeral endpoint for the engine's
+    registry; close() tears it down. Off (None) by default."""
+    from hetu_galvatron_tpu.core.args_schema import ModelArgs, ServingArgs
+    from hetu_galvatron_tpu.models.builder import init_causal_lm
+    from hetu_galvatron_tpu.serving.engine import ServingEngine
+
+    cfg = ModelArgs(
+        hidden_size=32, num_hidden_layers=1, num_attention_heads=2,
+        vocab_size=64, max_position_embeddings=64, seq_length=16,
+        make_vocab_size_divisible_by=1, ffn_hidden_size=64,
+        tie_word_embeddings=False)
+    params, _ = init_causal_lm(jax.random.key(0), cfg)
+    params = jax.tree.map(lambda x: x.astype(jnp.float32), params)
+    sv = ServingArgs(max_batch_size=2, kv_block_size=8, max_seq_len=32,
+                     max_new_tokens=4, metrics_port=0)
+    reg = MetricsRegistry()
+    eng = ServingEngine(params, cfg, sv, registry=reg)
+    try:
+        assert eng.metrics_port and eng.metrics_port > 0
+        reg.gauge("serve/queue_depth").set(0.0)
+        url = f"http://127.0.0.1:{eng.metrics_port}/metrics"
+        with urllib.request.urlopen(url, timeout=5) as resp:
+            assert "serve_queue_depth 0.0" in resp.read().decode()
+    finally:
+        eng.close()
+    assert eng.metrics_server is None
+    with pytest.raises((urllib.error.URLError, ConnectionError, OSError)):
+        urllib.request.urlopen(url, timeout=2)
+
+    # default: no server
+    off = ServingEngine(params, cfg, ServingArgs(
+        max_batch_size=2, kv_block_size=8, max_seq_len=32,
+        max_new_tokens=4), registry=MetricsRegistry())
+    try:
+        assert off.metrics_port is None and off.metrics_server is None
+    finally:
+        off.close()

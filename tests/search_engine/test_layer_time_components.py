@@ -1,0 +1,122 @@
+"""Decomposed per-layer cost components (cost_model.layer_time_components):
+the audit's predicted side must stay glued to the same primitives the
+search prices with (_tp_terms, the dp/cp/pp message arithmetic), so the
+calibration table can never audit a different model than the one that
+picked the plan."""
+
+import numpy as np
+import pytest
+
+from hetu_galvatron_tpu.core.cost_model.cost import (
+    CostContext,
+    _tp_terms,
+    layer_time_components,
+)
+from hetu_galvatron_tpu.core.search_engine.strategies import SearchStrategy
+from hetu_galvatron_tpu.utils.strategy import DPType
+
+pytestmark = pytest.mark.search_engine
+
+
+def _latency_table(per_mb=0.01):
+    table = {mb: per_mb * mb for mb in (1, 2, 4, 8, 16, 32, 64, 128)}
+    table["popt"] = np.array([per_mb, 0.0])
+    return table
+
+
+def _ctx(**kw):
+    base = dict(
+        parameter_size=48.0, seq_length=128, hidden_size=256, layer_num=4,
+        mixed_precision=True, forward_computation_time=0.05,
+        comm_coe_dict={"8_1": 0.01, "8_0": 0.01, "4_1": 0.01, "4_0": 0.01,
+                       "2_1": 0.01, "2_0": 0.01, "1": 0.0, "1_1": 0.0},
+        allgather_latency={2: _latency_table(), 4: _latency_table(),
+                           8: _latency_table()},
+        all2all_latency={2: _latency_table(), 4: _latency_table(),
+                         8: _latency_table()},
+        p2p_comm_coe_dict={2: 0.02, 4: 0.02},
+    )
+    base.update(kw)
+    return CostContext(**base)
+
+
+def test_components_track_tp_terms_and_sum():
+    ctx = _ctx()
+    s = SearchStrategy(pp=1, tp=2, dp=4)
+    comp = layer_time_components(s, ctx, 64, 1)
+    fct, bct, tp_time = _tp_terms(s, ctx, 64, 1)
+    scale = ctx.costmodel_coe / ctx.layer_num
+    assert comp["fct_ms"] == pytest.approx(fct * scale)
+    assert comp["bct_ms"] == pytest.approx(bct * scale)
+    assert comp["tp_ms"] == pytest.approx(tp_time * scale)
+    assert comp["dp_ms"] > 0       # sdp=4 gradient sync
+    assert comp["cp_ms"] == 0.0 and comp["pp_ms"] == 0.0
+    assert comp["total_ms"] == pytest.approx(
+        sum(v for k, v in comp.items() if k != "total_ms"))
+
+
+def test_dp_component_zero_without_replicas_and_zero3_premium():
+    ctx = _ctx()
+    assert layer_time_components(
+        SearchStrategy(pp=1, tp=8, dp=1), ctx, 64, 1)["dp_ms"] == 0.0
+    ddp = layer_time_components(
+        SearchStrategy(pp=1, tp=2, dp=4), ctx, 64, 1)["dp_ms"]
+    z3 = layer_time_components(
+        SearchStrategy(pp=1, tp=2, dp=4, dp_type=DPType.ZERO3),
+        ctx, 64, 1)["dp_ms"]
+    # ZeRO-3 re-gathers params in the backward: +50% on the same message
+    assert z3 == pytest.approx(1.5 * ddp)
+    # full precision doubles the gradient payload
+    full = layer_time_components(
+        SearchStrategy(pp=1, tp=2, dp=4), _ctx(mixed_precision=False),
+        64, 1)["dp_ms"]
+    assert full == pytest.approx(2 * ddp)
+
+
+def test_dp_ring_not_charged_for_dp1_replica_groups():
+    """A dp==1 plan whose sdp > 1 via cp replicas pays no gradient ring in
+    layer_time_cost's folded branches (both overlap() calls gate on dp>1)
+    — the decomposition must not invent one; under ZeRO-3 only the
+    all-gather premium survives."""
+    ctx = _ctx()
+    s = SearchStrategy(pp=1, tp=2, cp=2, dp=1)
+    assert s.sdp == 2
+    assert layer_time_components(s, ctx, 64, 1)["dp_ms"] == 0.0
+    z3 = layer_time_components(
+        SearchStrategy(pp=1, tp=2, cp=2, dp=1, dp_type=DPType.ZERO3),
+        ctx, 64, 1)["dp_ms"]
+    param_mb = ctx.parameter_size / s.tp
+    msg = 2 * (s.sdp - 1) * (param_mb / s.sdp) * ctx.layer_num / 2  # bf16
+    scale = ctx.costmodel_coe / ctx.layer_num
+    assert z3 == pytest.approx(0.5 * msg * ctx.comm_coe_dict["2_0"] * scale)
+
+
+def test_pp_and_checkpoint_components():
+    ctx = _ctx()
+    pp = layer_time_components(
+        SearchStrategy(pp=2, tp=2, dp=2), ctx, 64, 2)
+    assert pp["pp_ms"] > 0
+    # without a p2p profile the pp term is unpriceable, not invented
+    no_p2p = layer_time_components(
+        SearchStrategy(pp=2, tp=2, dp=2), _ctx(p2p_comm_coe_dict=None),
+        64, 2)
+    assert no_p2p["pp_ms"] == 0.0
+    # remat: backward recomputes the forward (bct += fct) and replays its
+    # collectives (1.5x tp messages)
+    base = layer_time_components(SearchStrategy(pp=1, tp=2, dp=4),
+                                 ctx, 64, 1)
+    ck = layer_time_components(
+        SearchStrategy(pp=1, tp=2, dp=4, checkpoint=True), ctx, 64, 1)
+    assert ck["bct_ms"] == pytest.approx(base["bct_ms"] + base["fct_ms"])
+    assert ck["tp_ms"] == pytest.approx(1.5 * base["tp_ms"])
+
+
+def test_alpha_beta_prices_tp_component():
+    """With fitted pairs the tp component is priced on the α-β curve —
+    the same number predicted_comm_per_step audits against."""
+    s = SearchStrategy(pp=1, tp=2, dp=4)
+    legacy = layer_time_components(s, _ctx(), 64, 1)["tp_ms"]
+    ab = layer_time_components(
+        s, _ctx(tp_alpha_beta={"2_1": (0.5, 100.0)}), 64, 1)["tp_ms"]
+    # a fat α must make the fitted price exceed the pure-bandwidth table
+    assert ab > legacy

@@ -54,6 +54,30 @@ def test_round_trip():
     assert [s.key() for s in back] == [s.key() for s in layers]
     assert vback == vocab
     assert extras["global_bsz"] == 16 and extras["chunks"] == 4
+    assert extras["predicted_layer_compute_ms"] is None  # not embedded
+
+
+def test_predicted_layer_compute_ms_roundtrip():
+    """Searched plans embed the cost model's per-layer compute prediction;
+    it survives the interchange round trip, a wrong-length vector raises at
+    write time and is dropped (not mis-attributed) at read time."""
+    layers = [LayerStrategy(pp_deg=1, tp_size=2, dp_size=2)
+              for _ in range(3)]
+    pred = [0.25, 0.5, 0.125]
+    cfg = strategy_list2config(
+        layers, global_bsz=8, chunks=1, predicted_layer_compute_ms=pred)
+    assert cfg["predicted_layer_compute_ms"] == pred
+    _, _, extras = config2strategy(cfg, world_size=4)
+    assert extras["predicted_layer_compute_ms"] == pred
+
+    with pytest.raises(ValueError, match="predicted_layer_compute_ms"):
+        strategy_list2config(
+            layers, global_bsz=8, chunks=1,
+            predicted_layer_compute_ms=[1.0])
+
+    cfg["predicted_layer_compute_ms"] = [1.0, 2.0]  # hand-edited plan drift
+    _, _, extras = config2strategy(cfg, world_size=4)
+    assert extras["predicted_layer_compute_ms"] is None
 
 
 def test_reference_format_json_parses():

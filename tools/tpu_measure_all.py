@@ -98,6 +98,48 @@ def run_step(name: str, argv: list, deadline_s: float,
     return True
 
 
+def _last_json_line(log_path: str):
+    """Last stdout line of a step log that parses as a JSON dict (every
+    bench prints exactly one such result line), or None."""
+    try:
+        with open(log_path) as f:
+            lines = f.readlines()
+    except OSError:
+        return None
+    for line in reversed(lines):
+        line = line.strip()
+        if not (line.startswith("{") and line.endswith("}")):
+            continue
+        try:
+            obj = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(obj, dict):
+            return obj
+    return None
+
+
+def build_bench_candidate():
+    """Merge the fresh step outputs into one bench_gate candidate: bench.py's
+    result line is the base, the pipeline A/B contributes compiled_vs_host,
+    and the TP-overlap A/B contributes tp_overlap_vs_gspmd when bench.py's
+    own tp_overlap leg did not run. Returns the candidate path, or None when
+    no bench result line exists (bench never completed)."""
+    base = _last_json_line(os.path.join(LOG_DIR, "bench.log"))
+    if base is None:
+        return None
+    ab = _last_json_line(os.path.join(LOG_DIR, "pipeline_ab.log"))
+    if ab and isinstance(ab.get("compiled_vs_host"), (int, float)):
+        base.setdefault("compiled_vs_host", ab["compiled_vs_host"])
+    tp = _last_json_line(os.path.join(LOG_DIR, "tp_overlap.log"))
+    if tp and isinstance(tp.get("overlap_vs_gspmd"), (int, float)):
+        base.setdefault("tp_overlap_vs_gspmd", tp["overlap_vs_gspmd"])
+    path = os.path.join(LOG_DIR, "bench_candidate.json")
+    with open(path, "w") as f:
+        json.dump({"parsed": base}, f, indent=2)
+    return path
+
+
 def merge_comp_json(extra_path: str) -> None:
     """Merge a sequence-mode computation JSON into the batch-mode one
     (disjoint keys: bsz{b}_seq1024 vs bsz1_seq{S})."""
@@ -173,6 +215,23 @@ def main() -> int:
         if name == "comp_sequence":
             merge_comp_json(os.path.join(
                 seq_dir, "computation_profiling_bf16_gpt2-small_all.json"))
+
+    # perf regression sentinel over the measurements THIS run just took
+    # (the driver archives BENCH_r*.json only after the suite exits, so
+    # gating "newest history" here would judge last round's numbers): merge
+    # the fresh step outputs into one candidate and gate it against the
+    # committed baseline. rc=1 on a regressed leg is logged like any step
+    # rc — the suite continues (measurement must finish), but the per-leg
+    # delta report lands in bench_gate.log.
+    candidate = build_bench_candidate()
+    gate = [py, os.path.join(ROOT, "tools", "bench_gate.py")]
+    if candidate:
+        gate += ["--candidate", candidate]
+    else:
+        log("bench_gate: no fresh bench output parsed; gating newest "
+            "archived history instead")
+    if not run_step("bench_gate", gate, 300, {"JAX_PLATFORMS": "cpu"}):
+        return 2
     log("suite complete")
     return 0
 
