@@ -100,6 +100,32 @@ def run_leg(spec: dict, journal: str) -> int:
                      tp_overlap_recompiles=out["overlap_recompiles"],
                      tp_overlap_legs=out["legs"], platform=out["platform"])
             return 0
+        if spec.get("kind") == "compiled_overlap":
+            # unified-path A/B leg: host vs compiled 1F1B with the
+            # shard_map kernels (ring tp matmuls + flash) live on both
+            # engines (tools/pipeline_dispatch_bench.py --kernels). Needs
+            # the 8-device virtual mesh on CPU, like the tp_overlap leg.
+            if spec["platform"] == "cpu":
+                os.environ["JAX_PLATFORMS"] = "cpu"
+                flag = "--xla_force_host_platform_device_count=8"
+                if "xla_force_host_platform_device_count" not in \
+                        os.environ.get("XLA_FLAGS", ""):
+                    os.environ["XLA_FLAGS"] = (
+                        os.environ.get("XLA_FLAGS", "") + " " + flag).strip()
+            sys.path.insert(0, os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), "tools"))
+            import pipeline_dispatch_bench
+
+            out = pipeline_dispatch_bench.run_kernels(
+                on_tpu=spec["platform"] == "tpu")
+            if "skipped" in out:
+                emit("error", error=out["skipped"])
+            else:
+                emit("ok",
+                     compiled_overlap_vs_host=out["compiled_overlap_vs_host"],
+                     compiled_overlap_recompiles=out["compiled_recompiles"],
+                     platform=out["platform"])
+            return 0
         if spec["platform"] == "cpu":
             # tunnel-safe: pin the platform BEFORE jax loads any backend...
             os.environ["JAX_PLATFORMS"] = "cpu"
@@ -600,6 +626,34 @@ def main() -> int:
             print(f"warning: tp-overlap A/B leg failed: {res.get('error')}",
                   file=sys.stderr)
 
+    # unified-path A/B (pipeline_dispatch_bench --kernels): compiled 1F1B
+    # with the shard_map kernels inside vs the host engine with the same
+    # kernels. On by default on BOTH platforms (unlike tp_overlap, the
+    # CPU-mesh ratio here is meaningful — it is the committed
+    # bench_baseline.json compiled_overlap leg); BENCH_COMPILED_OVERLAP=0
+    # opts out. The leg runs the bench tool's own pinned reference
+    # workload (tp2 x dp2 x pp2 at its documented shapes/iters); the
+    # seq/bsz/flash fields below only label the journal + abandon log,
+    # same as the tp_overlap leg's spec.
+    co_ab = None
+    if (not orch.wedged
+            and os.environ.get("BENCH_COMPILED_OVERLAP", "1") != "0"):
+        state["stage"] = "compiled-overlap"
+        res = orch.run({"kind": "compiled_overlap", "platform": platform,
+                        "seq": seq, "bsz": best["bsz"], "iters": iters,
+                        "flash": False, "fused_ce": False}, leg_budget)
+        if res["status"] == "ok":
+            co_ab = {"compiled_overlap_vs_host":
+                     res["compiled_overlap_vs_host"],
+                     "compiled_overlap_recompiles":
+                     res["compiled_overlap_recompiles"]}
+            print(f"bench compiled-overlap A/B: compiled_overlap_vs_host "
+                  f"{res['compiled_overlap_vs_host']} (recompiles "
+                  f"{res['compiled_overlap_recompiles']})", file=sys.stderr)
+        else:
+            print(f"warning: compiled-overlap A/B leg failed: "
+                  f"{res.get('error')}", file=sys.stderr)
+
     out = _assemble(best, tpu_error, flash_error, on_tpu)
     out["fused_ce"] = fused_ce
     if ab:
@@ -608,6 +662,8 @@ def main() -> int:
         out.update(ce_ab)
     if tp_ab:
         out.update(tp_ab)
+    if co_ab:
+        out.update(co_ab)
     if orch.abandoned:
         out["abandoned_children"] = orch.abandoned
     _emit_result(out)

@@ -92,8 +92,9 @@ def train(args) -> Dict[str, Any]:
     # overlapped-TP collectives (tp_overlap.enable, ops/overlap.py):
     # resolve per-layer eligibility once from the plan, log every fallback
     # with its reason, and remember the overlapped layer set for the
-    # tp/comm_hidden_frac gauge. The compiled pipeline engine disables the
-    # whole feature below (shard_map cannot nest under its stacked vmap).
+    # tp/comm_hidden_frac gauge. The rings run under BOTH pipeline
+    # schedule impls: per stage submesh on the host engine, and as
+    # stage-stacked shard_maps inside the compiled engine's fused program.
     tp_overlap_on = args.tp_overlap.enable
     overlapped_layers: list = []
     if tp_overlap_on:
@@ -505,24 +506,26 @@ def train(args) -> Dict[str, Any]:
                           f"this plan ({reason}); falling back to the host "
                           "engine")
             else:
-                if tp_overlap_on:
-                    # same constraint as the engine's attention kernels:
-                    # shard_map cannot nest under the stacked per-stage vmap
-                    state.log("tp_overlap: unsupported under "
-                              "pipeline.schedule_impl=compiled (shard_map "
-                              "cannot nest under the stacked vmap); running "
-                              "GSPMD collectives")
-                    tp_overlap_on = False
-                    overlapped_layers = []
                 # donation halves live model-state memory but is only safe
-                # when the rerun machine never re-runs pre-update buffers
+                # when the rerun machine never re-runs pre-update buffers.
+                # tp_overlap rides INSIDE the fused program since the stage
+                # axis was de-vmapped (stage-stacked shard_map kernels)
                 eng = CompiledPipelineEngine(
                     cfg, hpc, args.train, devices=state.devices,
                     compute_dtype=compute_dtype,
                     dcn_slices=args.parallel.dcn_slices,
-                    donate=not rerun.enabled)
+                    donate=not rerun.enabled,
+                    tp_overlap=tp_overlap_on)
+                if tp_overlap_on and not eng.tp_overlap:
+                    state.log("tp_overlap: no eligible layer under the "
+                              f"compiled schedule ({eng.overlap_reason}); "
+                              "running GSPMD collectives")
+                    tp_overlap_on = False
+                    overlapped_layers = []
                 state.log("pipeline schedule: compiled single-program 1F1B "
-                          f"(bubble_frac {eng.bubble_frac():.3f})")
+                          f"(bubble_frac {eng.bubble_frac():.3f}"
+                          + (", overlapped-TP rings inside"
+                             if eng.tp_overlap else "") + ")")
         if eng is None:
             eng = PipelineEngine(cfg, hpc, args.train, devices=state.devices,
                                  compute_dtype=compute_dtype,

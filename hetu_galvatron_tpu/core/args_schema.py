@@ -227,10 +227,11 @@ class TpOverlapArgs(BaseModel):
     auto-partitioned all-gather -> matmul). Layers the path cannot express
     fall back to GSPMD with a logged ``unsupported_reason``: tp == 1,
     Ulysses (tp axes carry sequence), cp layers, tp not dividing the
-    sequence/projection widths, MoE/t5 layers — and the compiled pipeline
-    engine rejects the whole feature (shard_map cannot nest under its
-    stacked per-stage vmap, the same constraint its attention kernels
-    documented)."""
+    sequence/projection widths, MoE/t5 layers. The rings run under BOTH
+    pipeline schedule impls — per stage submesh on the host engine, and
+    as stage-stacked full-manual shard_maps (``stage_axis="pp"``) inside
+    the compiled engine's fused single program (round 12's de-vmapped
+    stage axis)."""
 
     enable: bool = False
 

@@ -138,10 +138,11 @@ def tp_overlap_expressible(s: "SearchStrategy", ctx: CostContext) -> bool:
     """Can this layer run the decomposed ring-overlap matmuls
     (ops/overlap.layer_overlap_reason, the shape checks aside — the search
     works in degrees, not concrete widths)? Megatron TP only (Ulysses has
-    s.tp == 1 here), no cp, and never under the compiled pipeline engine
-    (shard_map cannot nest under its stacked per-stage vmap)."""
-    return (ctx.tp_overlap and s.tp > 1 and s.cp == 1
-            and not (s.pp > 1 and ctx.schedule_impl == "compiled"))
+    s.tp == 1 here) and no cp. Since the compiled 1F1B engine de-vmapped
+    its stage axis (round 12), the rings run INSIDE the fused program too —
+    pp > 1 under ``schedule_impl="compiled"`` keeps the discount, so the
+    overlap hiding and the dispatch waiver COMPOSE on deep-pp plans."""
+    return ctx.tp_overlap and s.tp > 1 and s.cp == 1
 
 
 def _overlap_window(comm: float, comp: float, coe: float) -> float:
@@ -687,15 +688,19 @@ def pipeline_time_cost(
     # impl pays dispatch linearly in pp * chunks. The waiver only applies
     # to plans the compiled engine can EXPRESS (it falls back to the host
     # engine otherwise — CompiledPipelineEngine.unsupported_reason): 1F1B
-    # only, uniform stage partition, uniform per-layer strategy, no cp.
+    # only, uniform stage partition, uniform per-layer strategy. cp plans
+    # qualify since the engine de-vmapped its stage axis (the ring kernel
+    # runs inside the fused program), so on an overlap-expressible tp plan
+    # the dispatch waiver and the tp_overlap discount now COMPOSE — the
+    # product neither effect produces alone (tests/search_engine/
+    # test_dispatch_cost.py pins a plan flip that needs both).
     ctx0 = contexts[0]
     if pp_size > 1 and ctx0.dispatch_us:
         compiled_expressible = (
             ctx0.schedule_impl == "compiled"
             and ctx0.pipeline_type == "pipedream_flush"
             and len(set(partition)) == 1
-            and all(s == strategy_list[0] for s in strategy_list)
-            and strategy_list[0].cp == 1)
+            and all(s == strategy_list[0] for s in strategy_list))
         if not compiled_expressible:
             result += ctx0.dispatch_us * 1e-6 * 2 * pp_size * chunks
     return result

@@ -148,6 +148,22 @@ _COLLECTIVE_STEMS: Tuple[Tuple[str, str], ...] = (
 _ANNOTATION_RE = re.compile(r"^[\w.\-]+(/[\w.\-]+)+$")
 _LAYER_RE = re.compile(r"(?:^|/)layer[_]?(\d+)(?:/|$)")
 
+# permute-source markers: the kernels stamp their collective-permutes with
+# jax.named_scope metadata (ops/overlap.py TP_RING_SCOPE, ring_attention's
+# cp_ring, mesh.make_pp_rotation's pp_rotate) that shows up in the trace
+# event name or its tf_op/long_name args. A marked permute is billed to its
+# OWN component even when tp-ring, cp-ring and pp stage rotations share one
+# compiled program — the plan-level "permute -> pp iff pipelined" heuristic
+# only covers whatever remains unmarked.
+_PERMUTE_MARKERS: Tuple[Tuple[str, str], ...] = (
+    ("tp_ring", "permute_tp"),
+    ("cp_ring", "permute_cp"),
+    ("pp_rotate", "permute_pp"),
+)
+# device-propagated span() names whose covered permute time belongs to tp
+# (the overlapped-TP step annotation, cli/train_dist.py)
+_TP_SPAN = "tp/overlap_step"
+
 
 def op_category(name: str) -> str:
     base = name.lower()
@@ -222,7 +238,7 @@ def attribute(trace: TraceData,
     ``/device:*`` process (TPU tracks); annotation events are ``span()``
     names, reconstructed into nesting paths per thread by interval
     containment."""
-    dev_events: List[Tuple[int, int, float, float, str, str]] = []
+    dev_events: List[Tuple[int, int, float, float, str, str, str]] = []
     ann_events: List[Tuple[int, int, float, float, str]] = []
     for e in trace.events:
         name = str(e.get("name", ""))
@@ -230,25 +246,46 @@ def attribute(trace: TraceData,
         pid, tid = e.get("pid"), e.get("tid")
         ts, dur = float(e.get("ts", 0.0)), float(e.get("dur", 0.0))
         on_device = trace.process_names.get(pid, "").startswith("/device")
+        # marker hint: the HLO metadata path (named_scope) rides in the
+        # event name on some backends and in tf_op/long_name args on others
+        hint = " ".join((name, str(args.get("tf_op", "")),
+                         str(args.get("long_name", ""))))
         if "hlo_op" in args or "hlo_module" in args:
             dev_events.append((pid, tid, ts, dur, name,
-                               str(args.get("hlo_module", ""))))
+                               str(args.get("hlo_module", "")), hint))
         elif _is_annotation(name):
             ann_events.append((pid, tid, ts, dur, name))
         elif on_device and not name.startswith(("$", "Thread")) \
                 and "::" not in name:
-            dev_events.append((pid, tid, ts, dur, name, ""))
+            dev_events.append((pid, tid, ts, dur, name, "", hint))
 
     attr = Attribution()
     if not dev_events and not ann_events:
         return attr
 
     # -- device tracks: busy/idle + category + module attribution --
-    by_track: Dict[Tuple[int, int], List[Tuple[float, float, str, str]]] = {}
-    for pid, tid, ts, dur, name, mod in dev_events:
-        by_track.setdefault((pid, tid), []).append((ts, dur, name, mod))
+    by_track: Dict[Tuple[int, int],
+                   List[Tuple[float, float, str, str]]] = {}
+    # unmarked collective-permutes per track: candidates for the
+    # tp/overlap_step annotation-coverage rebilling below
+    bare_permutes: Dict[Tuple[int, int], List[Tuple[float, float]]] = {}
     cats: Dict[str, float] = {}
     mods: Dict[str, float] = {}
+    for pid, tid, ts, dur, name, mod, hint in dev_events:
+        by_track.setdefault((pid, tid), []).append((ts, dur, name, mod))
+        cat = op_category(name)
+        if cat in ("permute", "p2p", "broadcast"):
+            for marker, key in _PERMUTE_MARKERS:
+                if marker in hint:
+                    cat = key
+                    break
+            else:
+                if cat == "permute":
+                    bare_permutes.setdefault((pid, tid), []).append(
+                        (ts, ts + dur))
+        cats[cat] = cats.get(cat, 0.0) + dur / 1000.0
+        if mod:
+            mods[mod] = mods.get(mod, 0.0) + dur / 1000.0
     if by_track:
         w0 = min(ts for evs in by_track.values() for ts, _, _, _ in evs)
         w1 = max(ts + d for evs in by_track.values() for ts, d, _, _ in evs)
@@ -257,23 +294,20 @@ def attribute(trace: TraceData,
             busy = _merged_busy_ms([(ts, ts + d) for ts, d, _, _ in evs])
             attr.device_busy_ms += busy
             attr.bubble_ms += max(attr.wall_ms - busy, 0.0)
-            for ts, d, name, mod in evs:
-                cats[op_category(name)] = cats.get(
-                    op_category(name), 0.0) + d / 1000.0
-                if mod:
-                    mods[mod] = mods.get(mod, 0.0) + d / 1000.0
         attr.tracks = len(by_track)
         attr.per_device_busy_ms = attr.device_busy_ms / attr.tracks
         attr.bubble_ms /= attr.tracks
         denom = attr.per_device_busy_ms + attr.bubble_ms
         attr.bubble_frac = attr.bubble_ms / denom if denom > 0 else 0.0
-        attr.categories_ms = {k: v / attr.tracks for k, v in cats.items()}
         attr.per_module_ms = {k: v / attr.tracks for k, v in mods.items()}
 
     # -- annotations: nesting paths (host spans) + device-track attribution
     ann_by_track: Dict[Tuple[int, int], List[Tuple[float, float, str]]] = {}
     for pid, tid, ts, dur, name in ann_events:
         ann_by_track.setdefault((pid, tid), []).append((ts, dur, name))
+    # device-propagated tp/overlap_step windows per track: a bare
+    # collective-permute inside one is a tp ring hop, not a stage transfer
+    tp_windows: Dict[Tuple[int, int], List[Tuple[float, float]]] = {}
     # steps are counted PER TRACK and the max taken: on TPU the step
     # annotation propagates onto every device track too, so a global sum
     # would count (1 + num device tracks) per real step
@@ -299,6 +333,9 @@ def attribute(trace: TraceData,
                 attr.per_layer_ms[int(m.group(1))] = attr.per_layer_ms.get(
                     int(m.group(1)), 0.0) + dur / 1000.0
             if on_device and (pid, tid) in by_track:
+                if name == _TP_SPAN:
+                    tp_windows.setdefault((pid, tid), []).append(
+                        (ts, ts + dur))
                 # TPU device track: sum the device-op time the annotation
                 # interval covers (the propagated-name attribution)
                 covered = [(max(ts, ots), min(ts + dur, ots + od))
@@ -307,6 +344,44 @@ def attribute(trace: TraceData,
                 attr.device_annotation_ms[name] = \
                     attr.device_annotation_ms.get(name, 0.0) + \
                     _merged_busy_ms([c for c in covered if c[1] > c[0]])
+    # rebill unmarked permute time covered by a tp/overlap_step window.
+    # The span wraps the WHOLE train step (cli/train_dist.py), so this is
+    # only sound when the tp ring hops are the sole collective-permutes in
+    # the program — the HOST engine's case (its pp transfers are
+    # device_puts, so the plan heuristic would mis-bill the rings to pp).
+    # Under the COMPILED engine the pp stage rotations are in-program
+    # ppermutes inside the same window: there the named_scope markers
+    # above are the only sound disambiguator, and if they failed to
+    # propagate, rebilling every bare permute to tp would mis-bill the
+    # stage rotations — strictly worse than the plan heuristic. The
+    # pp/compiled_step span (a TraceAnnotation, present even when HLO
+    # metadata is stripped) is the evidence the compiled engine ran, and
+    # it disables the window pass.
+    compiled_pp_ran = any(name == "pp/compiled_step"
+                          for _, _, _, _, name in ann_events)
+    moved_us = 0.0
+    for key, perms in ({} if compiled_pp_ran
+                       else bare_permutes).items():
+        wins = sorted(tp_windows.get(key) or [])
+        if not wins:
+            continue
+        merged: List[Tuple[float, float]] = [wins[0]]
+        for ws, we in wins[1:]:  # overlapping windows must not double-bill
+            if ws <= merged[-1][1]:
+                merged[-1] = (merged[-1][0], max(merged[-1][1], we))
+            else:
+                merged.append((ws, we))
+        for ps, pe in perms:
+            moved_us += sum(max(0.0, min(pe, we) - max(ps, ws))
+                            for ws, we in merged)
+    if moved_us:
+        moved = moved_us / 1000.0
+        cats["permute"] = max(cats.get("permute", 0.0) - moved, 0.0)
+        cats["permute_tp"] = cats.get("permute_tp", 0.0) + moved
+        if not cats["permute"]:
+            cats.pop("permute", None)
+    if attr.tracks:
+        attr.categories_ms = {k: v / attr.tracks for k, v in cats.items()}
     for name in step_spans:  # first marker that fired wins
         if step_counts.get(name):
             attr.steps = max(step_counts[name].values())
@@ -472,8 +547,17 @@ def measured_components(attr: Attribution, hpc: Any) -> Dict[str, float]:
     plan as the disambiguator: ag/rs -> tp (Megatron-SP activations; ZeRO-3
     parameter gathers land here too — documented), a2a -> sp (Ulysses),
     allreduce -> dp when the plan has a dp/ZeRO shard group else tp (plain
-    TP without SP all-reduces activations), permute/p2p -> pp when the
-    plan is pipelined, else cp (ring attention), else tp (ring overlap)."""
+    TP without SP all-reduces activations).
+
+    Permutes are split by SOURCE first: ``attribute`` bills marked hops
+    (named_scope metadata — ``tp_ring`` / ``cp_ring`` / ``pp_rotate`` —
+    or, host-engine runs only, coverage by a device-propagated
+    ``tp/overlap_step`` span) into ``permute_tp`` / ``permute_cp`` /
+    ``permute_pp``, which map straight onto their components. Only the
+    UNMARKED remainder falls back to the plan-level heuristic (pp when
+    pipelined, else cp, else tp) — so a compiled program mixing tp-ring,
+    cp-ring and stage-rotation permutes no longer mis-bills the ring hops
+    as pipeline time."""
     cat = attr.categories_ms
     any_sdp = any(
         max(s.dp_size * s.cp_size * (s.tp_size if s.sp else 1), 1) > 1
@@ -486,8 +570,11 @@ def measured_components(attr: Attribution, hpc: Any) -> Dict[str, float]:
         if ms:
             out[comp] = out.get(comp, 0.0) + ms
 
-    add("tp", cat.get("allgather", 0.0) + cat.get("reducescatter", 0.0))
+    add("tp", cat.get("allgather", 0.0) + cat.get("reducescatter", 0.0)
+        + cat.get("permute_tp", 0.0))
     add("sp", cat.get("alltoall", 0.0))
+    add("cp", cat.get("permute_cp", 0.0))
+    add("pp", cat.get("permute_pp", 0.0))
     add("dp" if any_sdp else "tp", cat.get("allreduce", 0.0))
     add(permute_to, cat.get("permute", 0.0) + cat.get("p2p", 0.0)
         + cat.get("broadcast", 0.0))

@@ -44,9 +44,45 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from hetu_galvatron_tpu.runtime.mesh import axes_size as _axis_prod
 
+# HLO-metadata marker for the ring ppermutes (jax.named_scope): trace
+# attribution (observability/trace_analysis.py) uses it to bill tp-ring
+# collective-permute time to the tp component instead of pp/cp when the
+# rings run inside the compiled pipeline's single program
+TP_RING_SCOPE = "tp_ring"
+
 
 def _ring_perm(tp: int):
     return [(i, (i + 1) % tp) for i in range(tp)]
+
+
+def _with_stage(spec: P, stage_axis: Optional[str]) -> P:
+    """Prepend the compiled pipeline's stage axis to a kernel spec: the
+    caller's operands carry a leading ``[pp, ...]`` stage dim (one stage per
+    ``pp`` mesh row), which the kernel treats as a local size-1 lane."""
+    return P(stage_axis, *spec) if stage_axis else spec
+
+
+def staged_lane(fn: Callable, stage: bool) -> Callable:
+    """Adapt a local shard_map body to the optional leading stage lane of
+    the compiled 1F1B engine: inside the (full-manual) shard_map each
+    operand arrives as ``[1, ...]`` — one stage's slice — so the body runs
+    on the squeezed view and the lane dim is restored on the way out. The
+    squeeze/expand pair is linear, so the custom VJPs underneath transpose
+    through it unchanged. Shared by every stage-capable kernel factory
+    (ring matmuls here, ring attention, Ulysses, flash)."""
+    if not stage:
+        return fn
+
+    def wrapped(*args):
+        out = fn(*(a[0] for a in args))
+        if isinstance(out, tuple):
+            return tuple(o[None] for o in out)
+        return out[None]
+
+    return wrapped
+
+
+_staged = staged_lane  # module-internal alias used by the builders below
 
 
 # ---------------------------------------------------------------------------
@@ -135,24 +171,33 @@ def _ring_ag_grads(dy, w, h, axes, tp):
 
 
 def make_ag_matmul(mesh: Mesh, dp_axes: Tuple[str, ...],
-                   tp_axes: Tuple[str, ...]) -> Callable:
+                   tp_axes: Tuple[str, ...],
+                   stage_axis: Optional[str] = None) -> Callable:
     """Column-parallel overlapped matmul: callable(x, w) with GLOBAL arrays
     x [B, S, H] (batch over dp, sequence over tp) and w [H, F] (columns over
     tp), returning fp32 [B, S, F] (features over tp) — the drop-in
     replacement for ``all-gather(seq) -> einsum`` in apply_attention /
-    apply_mlp."""
+    apply_mlp.
+
+    ``stage_axis`` (the compiled 1F1B engine): operands and result carry a
+    leading ``[pp, ...]`` stacked stage dim sharded on that mesh axis —
+    x [pp, B, S, H], w [pp, H, F] — and each pp mesh row rings only its own
+    stage's slice. This is how the kernels run INSIDE the fused pipeline
+    program: one full-manual shard_map spanning the whole mesh, no nesting."""
     tp = _axis_prod(mesh, tp_axes)
     axes = tuple(tp_axes)
 
     @jax.custom_vjp
     def local(x, w):
-        return _ring_ag_matmul(x, w, axes, tp)
+        with jax.named_scope(TP_RING_SCOPE):
+            return _ring_ag_matmul(x, w, axes, tp)
 
     def fwd(x, w):
         # save the ring-gathered activation (it passes through anyway):
         # dw then needs no collectives at all, matching GSPMD's
         # save-the-gather backward
-        y, x_full = _ring_ag_matmul(x, w, axes, tp, with_gathered=True)
+        with jax.named_scope(TP_RING_SCOPE):
+            y, x_full = _ring_ag_matmul(x, w, axes, tp, with_gathered=True)
         return y, (x_full, w)
 
     def bwd(res, dy):
@@ -160,21 +205,24 @@ def make_ag_matmul(mesh: Mesh, dp_axes: Tuple[str, ...],
         # dx = reduce-scatter(dy @ w^T) over sequence — the rs ring with
         # the transposed weight; dw is collective-free off the saved gather
         # (the gather keeps x's dtype, so the casts below stay primal-exact)
-        dx = _ring_matmul_rs(dy, w.T, axes, tp).astype(x_full.dtype)
+        with jax.named_scope(TP_RING_SCOPE):
+            dx = _ring_matmul_rs(dy, w.T, axes, tp).astype(x_full.dtype)
         dw = jnp.einsum("bsh,bsf->hf", x_full, dy,
                         preferred_element_type=jnp.float32).astype(w.dtype)
         return dx, dw
 
     local.defvjp(fwd, bwd)
-    x_spec = P(dp_axes or None, axes, None)
-    w_spec = P(None, axes)
-    y_spec = P(dp_axes or None, None, axes)
-    return shard_map(local, mesh, in_specs=(x_spec, w_spec),
+    x_spec = _with_stage(P(dp_axes or None, axes, None), stage_axis)
+    w_spec = _with_stage(P(None, axes), stage_axis)
+    y_spec = _with_stage(P(dp_axes or None, None, axes), stage_axis)
+    return shard_map(_staged(local, stage_axis is not None), mesh,
+                     in_specs=(x_spec, w_spec),
                      out_specs=y_spec, check_rep=False)
 
 
 def make_ag_matmul_pair(mesh: Mesh, dp_axes: Tuple[str, ...],
-                        tp_axes: Tuple[str, ...]) -> Callable:
+                        tp_axes: Tuple[str, ...],
+                        stage_axis: Optional[str] = None) -> Callable:
     """Gated-MLP fc1: callable(x, w_gate, w_up) -> (gate, up), both fp32
     [B, S, F] with features over tp, from ONE ring rotation (each held
     chunk multiplies both weight halves). Splitting the FUSED [H, 2F]
@@ -217,11 +265,13 @@ def make_ag_matmul_pair(mesh: Mesh, dp_axes: Tuple[str, ...],
 
     @jax.custom_vjp
     def local(x, wg, wu):
-        g, u, _ = _pair_body(x, wg, wu)
+        with jax.named_scope(TP_RING_SCOPE):
+            g, u, _ = _pair_body(x, wg, wu)
         return g, u
 
     def fwd(x, wg, wu):
-        g, u, x_full = _pair_body(x, wg, wu, with_gathered=True)
+        with jax.named_scope(TP_RING_SCOPE):
+            g, u, x_full = _pair_body(x, wg, wu, with_gathered=True)
         return (g, u), (x_full, wg, wu)
 
     def bwd(res, dys):
@@ -229,23 +279,24 @@ def make_ag_matmul_pair(mesh: Mesh, dp_axes: Tuple[str, ...],
         dg, du = dys
         # dx: ONE rs ring whose per-chunk partial sums both halves'
         # products; dw halves are collective-free off the saved gather
-        r = jax.lax.axis_index(axes)
-        B, S, _ = dg.shape
-        C = S // tp
-        perm = _ring_perm(tp)
-        acc = None
-        for t in range(tp):
-            c = (r - 1 - t) % tp
-            g_c = jax.lax.dynamic_slice(dg, (0, c * C, 0),
-                                        (B, C, dg.shape[2]))
-            u_c = jax.lax.dynamic_slice(du, (0, c * C, 0),
-                                        (B, C, du.shape[2]))
-            part = (jnp.einsum("bcf,hf->bch", g_c, wg,
-                               preferred_element_type=jnp.float32)
-                    + jnp.einsum("bcf,hf->bch", u_c, wu,
-                                 preferred_element_type=jnp.float32))
-            acc = part if acc is None else (
-                jax.lax.ppermute(acc, axes, perm) + part)
+        with jax.named_scope(TP_RING_SCOPE):
+            r = jax.lax.axis_index(axes)
+            B, S, _ = dg.shape
+            C = S // tp
+            perm = _ring_perm(tp)
+            acc = None
+            for t in range(tp):
+                c = (r - 1 - t) % tp
+                g_c = jax.lax.dynamic_slice(dg, (0, c * C, 0),
+                                            (B, C, dg.shape[2]))
+                u_c = jax.lax.dynamic_slice(du, (0, c * C, 0),
+                                            (B, C, du.shape[2]))
+                part = (jnp.einsum("bcf,hf->bch", g_c, wg,
+                                   preferred_element_type=jnp.float32)
+                        + jnp.einsum("bcf,hf->bch", u_c, wu,
+                                     preferred_element_type=jnp.float32))
+                acc = part if acc is None else (
+                    jax.lax.ppermute(acc, axes, perm) + part)
         dx = acc.astype(x_full.dtype)
         dwg = jnp.einsum("bsh,bsf->hf", x_full, dg,
                          preferred_element_type=jnp.float32).astype(wg.dtype)
@@ -254,41 +305,48 @@ def make_ag_matmul_pair(mesh: Mesh, dp_axes: Tuple[str, ...],
         return dx, dwg, dwu
 
     local.defvjp(fwd, bwd)
-    x_spec = P(dp_axes or None, axes, None)
-    w_spec = P(None, axes)
-    y_spec = P(dp_axes or None, None, axes)
-    return shard_map(local, mesh, in_specs=(x_spec, w_spec, w_spec),
+    x_spec = _with_stage(P(dp_axes or None, axes, None), stage_axis)
+    w_spec = _with_stage(P(None, axes), stage_axis)
+    y_spec = _with_stage(P(dp_axes or None, None, axes), stage_axis)
+    return shard_map(_staged(local, stage_axis is not None), mesh,
+                     in_specs=(x_spec, w_spec, w_spec),
                      out_specs=(y_spec, y_spec), check_rep=False)
 
 
 def make_matmul_rs(mesh: Mesh, dp_axes: Tuple[str, ...],
-                   tp_axes: Tuple[str, ...]) -> Callable:
+                   tp_axes: Tuple[str, ...],
+                   stage_axis: Optional[str] = None) -> Callable:
     """Row-parallel overlapped matmul: callable(h, w) with GLOBAL arrays
     h [B, S, F] (features over tp) and w [F, H] (rows over tp), returning
     fp32 [B, S, H] (sequence over tp) — replacing
-    ``einsum -> reduce-scatter(seq)``."""
+    ``einsum -> reduce-scatter(seq)``. ``stage_axis``: see
+    :func:`make_ag_matmul`."""
     tp = _axis_prod(mesh, tp_axes)
     axes = tuple(tp_axes)
 
     @jax.custom_vjp
     def local(h, w):
-        return _ring_matmul_rs(h, w, axes, tp)
+        with jax.named_scope(TP_RING_SCOPE):
+            return _ring_matmul_rs(h, w, axes, tp)
 
     def fwd(h, w):
-        return _ring_matmul_rs(h, w, axes, tp), (h, w)
+        with jax.named_scope(TP_RING_SCOPE):
+            return _ring_matmul_rs(h, w, axes, tp), (h, w)
 
     def bwd(res, dy):
         h, w = res
         # one fused ring rotation of dy yields both dh = all-gather(dy) @
         # w^T and dw = h^T @ all-gather(dy)
-        dh, dw = _ring_ag_grads(dy, w, h, axes, tp)
+        with jax.named_scope(TP_RING_SCOPE):
+            dh, dw = _ring_ag_grads(dy, w, h, axes, tp)
         return dh.astype(h.dtype), dw.astype(w.dtype)
 
     local.defvjp(fwd, bwd)
-    h_spec = P(dp_axes or None, None, axes)
-    w_spec = P(axes, None)
-    y_spec = P(dp_axes or None, axes, None)
-    return shard_map(local, mesh, in_specs=(h_spec, w_spec),
+    h_spec = _with_stage(P(dp_axes or None, None, axes), stage_axis)
+    w_spec = _with_stage(P(axes, None), stage_axis)
+    y_spec = _with_stage(P(dp_axes or None, axes, None), stage_axis)
+    return shard_map(_staged(local, stage_axis is not None), mesh,
+                     in_specs=(h_spec, w_spec),
                      out_specs=y_spec, check_rep=False)
 
 
@@ -357,14 +415,17 @@ def plan_overlap_reasons(cfg: Any, hpc: Any) -> list:
 
 
 def make_layer_matmuls(mesh: Mesh, dp_axes: Tuple[str, ...],
-                       tp_axes: Tuple[str, ...]) -> Dict[str, Callable]:
+                       tp_axes: Tuple[str, ...],
+                       stage_axis: Optional[str] = None
+                       ) -> Dict[str, Callable]:
     """The projection matmuls of one decoder layer as overlapped
     ring-decomposed fns (``matmul_fns`` for modules.apply_decoder_layer):
     column-parallel qkv/fc1 share one ag_matmul, row-parallel out/fc2 share
     one matmul_rs (the builders are shape-polymorphic), and gated MLPs use
     the shard-aligned ``fc1_pair`` instead of splitting the fused product
-    globally."""
-    ag = make_ag_matmul(mesh, dp_axes, tp_axes)
-    rs = make_matmul_rs(mesh, dp_axes, tp_axes)
-    pair = make_ag_matmul_pair(mesh, dp_axes, tp_axes)
+    globally. ``stage_axis`` builds the pp-stacked variants the compiled
+    pipeline engine calls on ``[pp, ...]`` operands."""
+    ag = make_ag_matmul(mesh, dp_axes, tp_axes, stage_axis)
+    rs = make_matmul_rs(mesh, dp_axes, tp_axes, stage_axis)
+    pair = make_ag_matmul_pair(mesh, dp_axes, tp_axes, stage_axis)
     return {"qkv": ag, "out": rs, "fc1": ag, "fc2": rs, "fc1_pair": pair}

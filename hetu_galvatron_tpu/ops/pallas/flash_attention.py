@@ -622,7 +622,8 @@ flash_sdpa.supports_segments = True
 flash_sdpa.supports_dropout = True
 
 
-def make_flash_sdpa(mesh, dp_axes=(), tp_axes=(), *, interpret: bool = False):
+def make_flash_sdpa(mesh, dp_axes=(), tp_axes=(), *, interpret: bool = False,
+                    stage_axis=None):
     """Distributed flash attention: the kernel is a custom call XLA cannot
     auto-partition, so it runs under shard_map — batch sharded over dp,
     heads over tp, sequence local (attention needs the full sequence; cp
@@ -631,7 +632,23 @@ def make_flash_sdpa(mesh, dp_axes=(), tp_axes=(), *, interpret: bool = False):
     sharded operand so packed documents keep flash speed under SPMD.
     ``dropout_rate`` > 0 runs the in-kernel counter-based dropout; each
     shard folds its (dp, tp) mesh coordinates into the seed so masks
-    decorrelate across the sharded batch/head dims."""
+    decorrelate across the sharded batch/head dims.
+
+    ``interpret=True`` (CPU tests / parity drills) also relaxes the block
+    floor: a sequence no tile >= 128 divides runs as one whole-sequence
+    block instead of silently falling back to the XLA core (matching
+    ``flash_sdpa``'s ``or S`` default), so CPU drills exercise the real
+    kernel arithmetic.
+
+    ``stage_axis`` (the compiled 1F1B engine): q/k/v carry a leading
+    ``[pp, ...]`` stacked stage dim sharded on that mesh axis; the
+    shard_map spans the WHOLE mesh (pp included, full-manual) and each pp
+    row runs its own stage's attention — this is how the Pallas kernel
+    nests inside the fused single-program pipeline. ``dropout_rng`` is
+    then a ``[pp]`` key array (one per stage lane, matching the host
+    engine's per-(microbatch, stage) keys)."""
+    from functools import partial as _partial
+
     from jax.sharding import PartitionSpec as P
 
     import jax
@@ -639,6 +656,12 @@ def make_flash_sdpa(mesh, dp_axes=(), tp_axes=(), *, interpret: bool = False):
     spec = P(dp_axes or None, None, tp_axes or None, None)
     seg_spec = P(dp_axes or None, None)
     seed_spec = P()
+    s_dim = 1
+    if stage_axis is not None:
+        spec = P(stage_axis, *spec)
+        seg_spec = P(stage_axis, *seg_spec)
+        seed_spec = P(stage_axis, None)
+        s_dim = 2
 
     def _shard_seed(seed):
         idx = jnp.int32(0)
@@ -646,24 +669,53 @@ def make_flash_sdpa(mesh, dp_axes=(), tp_axes=(), *, interpret: bool = False):
             idx = idx * jnp.int32(mesh.shape[ax]) + jax.lax.axis_index(ax)
         return seed + idx * jnp.int32(-1640531527)  # 2654435761 as int32
 
-    def sdpa(q, k, v, *, causal=True, segment_ids=None,
-             dropout_rate: float = 0.0, dropout_rng=None):
-        S = q.shape[1]
-        bq = fit_block(DEFAULT_BLOCK_Q, S)
-        bk = fit_block(DEFAULT_BLOCK_K, S)
-        # shapes the kernel can't tile (no lane-aligned block divides the
-        # sequence, or cross-attention with different q/kv lengths): XLA core
-        if not bq or not bk or k.shape[1] != S:
-            from hetu_galvatron_tpu.models.modules import xla_sdpa
+    def _xla_fallback(q, k, v, causal, segment_ids, dropout_rate,
+                      dropout_rng):
+        from hetu_galvatron_tpu.models.modules import xla_sdpa
 
+        if stage_axis is None:
             return xla_sdpa(q, k, v, causal=causal, segment_ids=segment_ids,
                             dropout_rate=dropout_rate,
                             dropout_rng=dropout_rng)
+        # stacked operands: the XLA core is weight-free, so a plain vmap
+        # over the stage lane reproduces the per-stage host arithmetic
+        core = _partial(xla_sdpa, causal=causal, dropout_rate=dropout_rate)
+        if dropout_rng is not None:
+            return jax.vmap(lambda a, b, c, s, r: core(
+                a, b, c, segment_ids=s, dropout_rng=r))(
+                q, k, v, segment_ids, dropout_rng) \
+                if segment_ids is not None else jax.vmap(
+                    lambda a, b, c, r: core(a, b, c, dropout_rng=r))(
+                    q, k, v, dropout_rng)
+        if segment_ids is not None:
+            return jax.vmap(lambda a, b, c, s: core(a, b, c,
+                                                    segment_ids=s))(
+                q, k, v, segment_ids)
+        return jax.vmap(lambda a, b, c: core(a, b, c))(q, k, v)
+
+    def sdpa(q, k, v, *, causal=True, segment_ids=None,
+             dropout_rate: float = 0.0, dropout_rng=None):
+        S = q.shape[s_dim]
+        bq = fit_block(DEFAULT_BLOCK_Q, S)
+        bk = fit_block(DEFAULT_BLOCK_K, S)
+        if interpret:
+            # interpret mode has no lane-alignment constraint: run the
+            # whole sequence as one block rather than losing the kernel
+            bq, bk = bq or S, bk or S
+        # shapes the kernel can't tile (no lane-aligned block divides the
+        # sequence, or cross-attention with different q/kv lengths): XLA core
+        if not bq or not bk or k.shape[s_dim] != S:
+            return _xla_fallback(q, k, v, causal, segment_ids, dropout_rate,
+                                 dropout_rng)
         seed = None
         if dropout_rate > 0.0:
             if dropout_rng is None:
                 raise ValueError("flash dropout_rate > 0 needs dropout_rng")
-            seed = seed_from_key(dropout_rng)
+            if stage_axis is not None:
+                # one independent counter stream per stage lane
+                seed = jax.vmap(seed_from_key)(dropout_rng)
+            else:
+                seed = seed_from_key(dropout_rng)
 
         # one shard_map over a dynamic operand list; the optional operands
         # are rebuilt into keywords inside (custom_vjp args stay positional)
@@ -684,6 +736,12 @@ def make_flash_sdpa(mesh, dp_axes=(), tp_axes=(), *, interpret: bool = False):
                                    bq, bk, dropout_rate)
 
         from jax.experimental.shard_map import shard_map
+
+        from hetu_galvatron_tpu.ops.overlap import staged_lane
+
+        # each pp row holds its stage's [1, ...] lane (the shared
+        # compiled-engine adapter squeezes it around the kernel)
+        local = staged_lane(local, stage_axis is not None)
 
         fn = shard_map(local, mesh=mesh, in_specs=tuple(in_specs),
                        out_specs=spec, check_rep=False)

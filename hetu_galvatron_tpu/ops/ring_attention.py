@@ -18,7 +18,7 @@ from __future__ import annotations
 
 import math
 from functools import partial
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -353,6 +353,7 @@ def make_ring_sdpa(
     use_flash: bool = False,
     interpret: bool = False,
     data_zigzagged: bool = False,
+    stage_axis: Optional[str] = None,
 ):
     """sdpa_fn for modules.apply_attention: reshards q/k/v so the sequence
     lives on the cp axes, runs the ring kernel under shard_map, and hands the
@@ -375,7 +376,12 @@ def make_ring_sdpa(
     ``data_zigzagged=True`` (with ``zigzag=True``) declares the inputs
     ALREADY in zigzag order — the dataloader applied the layout
     (runtime/dataloader.py zigzag_cp_batches) — so the entry/exit
-    permutes are skipped entirely: zero reshard cost per call."""
+    permutes are skipped entirely: zero reshard cost per call.
+
+    ``stage_axis`` (the compiled 1F1B engine): q/k/v carry a leading
+    ``[pp, ...]`` stacked stage dim sharded on that mesh axis — the
+    shard_map spans the whole mesh (full-manual, pp included) and each pp
+    row rings only its own stage's blocks over the cp axes."""
     if not cp_axes:
         raise ValueError("ring attention needs at least one cp axis")
     if data_zigzagged and not zigzag:
@@ -383,12 +389,16 @@ def make_ring_sdpa(
                          "must mask by zigzag global positions)")
     axis = cp_axes if len(cp_axes) > 1 else cp_axes[0]
     spec = P(dp_axes or None, cp_axes, tp_axes or None, None)
+    s_dim = 1
+    if stage_axis is not None:
+        spec = P(stage_axis, *spec)
+        s_dim = 2
     cp = 1
     for a in cp_axes:
         cp *= mesh.shape[a]
 
     def sdpa(q, k, v, *, causal=True, segment_ids=None):
-        S = q.shape[1]
+        S = q.shape[s_dim]
         if S % cp:
             raise ValueError(f"sequence {S} not divisible by cp {cp}")
         if zigzag and S % (2 * cp):
@@ -408,24 +418,41 @@ def make_ring_sdpa(
             # need unequal-length q/k segment operands (future work)
             local = partial(_ring_attention_local, axis=axis, cp=cp,
                             causal=causal, zigzag=zigzag)
-        seg_spec = P(spec[0], cp_axes)
+        from hetu_galvatron_tpu.ops.overlap import staged_lane
+
+        # each pp row holds its stage's [1, ...] lane: squeeze it, run the
+        # ring, restore it on the way out (the shared compiled-engine
+        # adapter)
+        inner = staged_lane(local, stage_axis is not None)
+        local_scoped = lambda *a, _f=inner: _cp_scoped(_f, *a)
+        seg_spec = P(spec[0], cp_axes) if stage_axis is None \
+            else P(stage_axis, spec[1], cp_axes)
         in_specs = (spec, spec, spec) + ((seg_spec,) if has_seg else ())
         from jax.experimental.shard_map import shard_map
 
         fn = shard_map(
-            local,
+            local_scoped,
             mesh=mesh, in_specs=in_specs, out_specs=spec,
             check_rep=False)
         relayout = zigzag and not data_zigzagged
         if relayout:
-            q, k, v = (zigzag_layout(t, cp) for t in (q, k, v))
+            q, k, v = (zigzag_layout(t, cp, axis=s_dim) for t in (q, k, v))
             if has_seg:
-                segment_ids = zigzag_layout(segment_ids, cp)
+                segment_ids = zigzag_layout(segment_ids, cp, axis=s_dim)
         out = fn(q, k, v, *((segment_ids,) if has_seg else ()))
-        return zigzag_unlayout(out, cp) if relayout else out
+        return zigzag_unlayout(out, cp, axis=s_dim) if relayout else out
 
     sdpa.supports_segments = True
     return sdpa
+
+
+def _cp_scoped(fn, *args):
+    """Run a ring body under the ``cp_ring`` HLO-metadata scope so trace
+    attribution can bill its collective-permutes to the cp component even
+    when they share one program with pp stage rotations
+    (observability/trace_analysis.py)."""
+    with jax.named_scope("cp_ring"):
+        return fn(*args)
 
 
 def zigzag_layout(x: jax.Array, cp: int, axis: int = 1) -> jax.Array:

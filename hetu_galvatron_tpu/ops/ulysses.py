@@ -44,13 +44,19 @@ def make_ulysses_sdpa(
     sp_axes: Tuple[str, ...],
     dp_axes: Tuple[str, ...] = (),
     local_sdpa: Optional[Callable] = None,
+    stage_axis: Optional[str] = None,
 ) -> Callable:
     """sdpa_fn for modules.apply_attention on a Ulysses layer.
 
     Falls back to the XLA core (GSPMD-inferred collectives) when the q or kv
     head count does not divide by the sp degree — the head-scatter a2a needs
     whole heads per device (the reference asserts the same divisibility,
-    attention_impl.py:235)."""
+    attention_impl.py:235).
+
+    ``stage_axis`` (the compiled 1F1B engine): q/k/v carry a leading
+    ``[pp, ...]`` stacked stage dim sharded on that mesh axis; the
+    shard_map spans the whole mesh (full-manual) and each pp row runs its
+    own stage's a2a sandwich over the sp axes."""
     if not sp_axes:
         raise ValueError("ulysses attention needs at least one sp axis")
     axis = sp_axes if len(sp_axes) > 1 else sp_axes[0]
@@ -58,6 +64,10 @@ def make_ulysses_sdpa(
     for a in sp_axes:
         sp *= mesh.shape[a]
     spec = P(dp_axes or None, sp_axes, None, None)
+    s_dim, h_dim = 1, 2
+    if stage_axis is not None:
+        spec = P(stage_axis, *spec)
+        s_dim, h_dim = 2, 3
     core = local_sdpa or xla_sdpa
 
     warned = []
@@ -65,27 +75,34 @@ def make_ulysses_sdpa(
     def sdpa(q, k, v, *, causal=True):
         import jax.numpy as jnp
 
-        N, K = q.shape[2], k.shape[2]
+        N, K = q.shape[h_dim], k.shape[h_dim]
         # decide the path on the ORIGINAL shapes: replication must only
         # happen when the a2a path is actually taken (the fallback core
         # needs the true GQA head ratio)
         K_eff = sp if (K % sp and sp % K == 0) else K
-        if N % sp or K_eff % sp or N % K_eff or q.shape[1] % sp:
+        if N % sp or K_eff % sp or N % K_eff or q.shape[s_dim] % sp:
+            if stage_axis is not None:
+                return jax.vmap(lambda a, b, c: xla_sdpa(
+                    a, b, c, causal=causal))(q, k, v)
             return xla_sdpa(q, k, v, causal=causal)
         if K_eff != K:
             # GQA with fewer kv heads than the sp degree: replicate kv heads
             # up to sp so the head scatter stays whole-headed (reference
             # repeat_interleave, attention_impl.py:278-417)
             rep = sp // K
-            k = jnp.repeat(k, rep, axis=2)
-            v = jnp.repeat(v, rep, axis=2)
+            k = jnp.repeat(k, rep, axis=h_dim)
+            v = jnp.repeat(v, rep, axis=h_dim)
 
         def run(inner):
             from jax.experimental.shard_map import shard_map
 
+            from hetu_galvatron_tpu.ops.overlap import staged_lane
+
+            local = partial(_ulysses_local, axis=axis, causal=causal,
+                            local_sdpa=inner)
+            body = staged_lane(local, stage_axis is not None)
             return shard_map(
-                partial(_ulysses_local, axis=axis, causal=causal,
-                        local_sdpa=inner),
+                body,
                 mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
                 check_rep=False)(q, k, v)
         if core is not xla_sdpa:

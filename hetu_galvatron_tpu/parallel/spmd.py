@@ -117,6 +117,7 @@ def attention_overrides(
     use_flash: Optional[bool] = None,
     with_cross: bool = False,
     cp_zigzag: bool = False,
+    flash_interpret: bool = False,
 ) -> Dict[int, Dict[str, Any]]:
     """Per-layer attention-impl dispatch (reference attention.py:664-720):
     cp > 1 layers swap in the ring-attention kernel over their cp axes;
@@ -134,7 +135,13 @@ def attention_overrides(
     kernel needs equal q/kv sequence lengths and the a2a sandwich assumes
     self-attention geometry; GSPMD inserts the collectives instead), while
     flash layers reuse the flash kernel, which handles causal=False and
-    falls back internally on mismatched lengths."""
+    falls back internally on mismatched lengths.
+
+    ``flash_interpret=True`` runs the Pallas kernels in interpret mode —
+    CPU parity drills forcing ``use_flash=True`` on the virtual mesh (the
+    compiled-vs-host kernel drills run the SAME kernel on both sides)."""
+    from functools import partial as _partial
+
     from hetu_galvatron_tpu.models.modules import xla_sdpa
     from hetu_galvatron_tpu.ops.ring_attention import make_ring_sdpa
     from hetu_galvatron_tpu.ops.ulysses import make_ulysses_sdpa
@@ -148,7 +155,7 @@ def attention_overrides(
             out[i] = {"sdpa_fn": make_ring_sdpa(
                 mesh, sh.cp_axes, dp_axes=sh.dp_axes, tp_axes=sh.tp_axes,
                 use_flash=use_flash, zigzag=cp_zigzag,
-                data_zigzagged=cp_zigzag)}
+                data_zigzagged=cp_zigzag, interpret=flash_interpret)}
             if with_cross:
                 out[i]["cross_sdpa_fn"] = xla_sdpa
         elif sh.ulysses and sh.tp_axes:
@@ -158,7 +165,8 @@ def attention_overrides(
                     flash_sdpa,
                 )
 
-                local = flash_sdpa
+                local = (_partial(flash_sdpa, interpret=True)
+                         if flash_interpret else flash_sdpa)
             out[i] = {"sdpa_fn": make_ulysses_sdpa(
                 mesh, sh.tp_axes, dp_axes=sh.dp_axes, local_sdpa=local)}
             if with_cross:
@@ -169,7 +177,8 @@ def attention_overrides(
             )
 
             out[i] = {"sdpa_fn": make_flash_sdpa(
-                mesh, dp_axes=sh.dp_axes, tp_axes=sh.tp_axes)}
+                mesh, dp_axes=sh.dp_axes, tp_axes=sh.tp_axes,
+                interpret=flash_interpret)}
     return out
 
 

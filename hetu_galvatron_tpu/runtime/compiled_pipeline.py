@@ -22,11 +22,26 @@ Layout and mechanics:
 * **Lockstep tick scan** — a `lax.scan` over ``T = m + 2(pp-1)`` schedule
   ticks (m microbatches). At tick t, stage s runs the FORWARD of microbatch
   ``i = t - s`` (when ``0 <= i < m``) and the BACKWARD of microbatch
-  ``j = t - 2(pp-1) + s``; both units execute as ONE vmapped computation
-  over the stacked stage axis, which GSPMD partitions along ``pp`` — every
+  ``j = t - 2(pp-1) + s``; both units execute as ONE stacked computation
+  over the leading stage axis, which GSPMD partitions along ``pp`` — every
   mesh row computes only its own stage. Bubble ticks are masked by zeroing
   the backward cotangent seeds (zero cotangent in => exactly-zero grads out,
   by linearity of the vjp) and by `where`-gating the loss/grad accumulators.
+* **De-vmapped stage axis (shard_map kernels inside)** — the per-stage
+  layer computation is NOT a vmap over stage lanes (it was, through round
+  11): stage-stacked weights enter ordinary traced einsums with an explicit
+  leading ``p`` batch dim (``"pbsh,phf->pbsf"``), weight-free segments
+  (norms, rope, residuals, the XLA attention core, per-lane dropout keys)
+  ride plain `jax.vmap` over the lane axis, and the shard_map kernels —
+  ``ops/overlap.py`` ring ag/rs matmuls (``tp_overlap=True``), the Pallas
+  flash kernel, Ulysses a2a and cp/zigzag ring attention — are built with
+  ``stage_axis="pp"``: ONE full-manual shard_map spanning the whole mesh
+  whose specs carry the stage lane, exactly like the ``ppermute`` stage
+  rotations always did. No nesting, no vmapped shard_map — the two flagship
+  perf features (single-program 1F1B + overlapped/kernel collectives)
+  compose in one donated jit. (Partial-auto shard_map — manual over ``pp``
+  only — hard-crashes the XLA partitioner on this jax pin; the stacked
+  full-manual form is the shape that works.)
 * **collective-permute stage transfers** — activations rotate ``s -> s+1``
   and cotangents ``s -> s-1`` with `lax.ppermute` over the ``pp`` axis
   (``mesh.make_pp_rotation``), the compiled analogue of the reference's
@@ -54,10 +69,11 @@ Layout and mechanics:
 Eligibility (everything else falls back to the host engine, which stays the
 general path): causal-LM / bert families (no t5 pair carry), vpp=1, uniform
 ``pp_division`` and a uniform per-layer strategy (stacking needs one shard
-layout), no MoE, no context parallelism / packed-document fields. Attention
-runs the XLA core inside the program (the Pallas flash / ring kernels are
-shard_map programs that cannot nest under the stacked vmap); the
-`tools/pipeline_dispatch_bench.py` A/B leg measures what that trade buys.
+layout), no MoE, no packed-document fields. Context parallelism (plain and
+zigzag), Megatron-SP tp with the overlapped ring matmuls, Ulysses, and the
+Pallas flash kernel all run INSIDE the program via the stage-stacked
+shard_map wrappers; `tools/pipeline_dispatch_bench.py --kernels` and
+`tools/tp_overlap_bench.py --schedule-impl compiled` measure the composition.
 """
 
 from __future__ import annotations
@@ -81,6 +97,7 @@ from hetu_galvatron_tpu.observability.trace_analysis import (
 from hetu_galvatron_tpu.observability.tracing import span
 from hetu_galvatron_tpu.runtime.hybrid_config import HybridParallelConfig
 from hetu_galvatron_tpu.runtime.mesh import (
+    axes_size,
     build_mesh,
     lower_strategy,
     lower_vocab_strategy,
@@ -150,10 +167,10 @@ class CompiledPipelineEngine:
                     f"{hpc.pp_division} (stage stacking needs uniformity)")
         if any(s != hpc.layers[0] for s in hpc.layers):
             return "heterogeneous per-layer strategies"
-        if hpc.layers[0].cp_size > 1 or hpc.vocab.vcp > 1:
-            return "context parallelism (ring attention is a shard_map kernel)"
-        if getattr(hpc, "cp_zigzag", False):
-            return "zigzag cp data layout"
+        # cp / zigzag-cp plans are EXPRESSIBLE since the stage axis was
+        # de-vmapped: the ring-attention kernel runs inside the program as a
+        # stage-stacked full-manual shard_map (stage_axis="pp"), like the
+        # overlapped-TP ring matmuls and the flash kernel
         if data is not None and (getattr(data, "reset_position_ids", False)
                                  or getattr(data, "reset_attention_mask",
                                             False)):
@@ -170,7 +187,17 @@ class CompiledPipelineEngine:
         compute_dtype=jnp.bfloat16,
         dcn_slices: int = 1,
         donate: bool = True,
+        tp_overlap: bool = False,
+        use_flash: Optional[bool] = None,
+        flash_interpret: bool = False,
     ):
+        """``tp_overlap`` swaps the (uniform) layer's projection matmuls for
+        the stage-stacked ring ag/rs kernels (ops/overlap.py) when the layer
+        is eligible; ``self.overlap_reason`` carries the reason otherwise.
+        ``use_flash`` mirrors the host engine's attention dispatch: None =
+        the platform default (Pallas flash on TPU when cfg.use_flash_attn),
+        an explicit bool forces it; ``flash_interpret`` runs the Pallas
+        kernels in interpret mode (CPU parity drills)."""
         reason = self.unsupported_reason(cfg, hpc)
         if reason is not None:
             raise ValueError(f"compiled pipeline schedule unsupported: "
@@ -195,10 +222,82 @@ class CompiledPipelineEngine:
         self.tx = _compiled_optimizer(train)
         self._use_dropout = (cfg.hidden_dropout > 0.0
                              or cfg.attention_dropout > 0.0)
+        self._use_flash = use_flash
+        self._sdpa = self._build_attention_core(flash_interpret)
+        # overlapped-TP ring matmuls inside the program (the same per-layer
+        # eligibility the SPMD/host paths apply; the plan is uniform, so one
+        # decision covers every decoder layer)
+        self.tp_overlap = False
+        self.overlap_reason: Optional[str] = None
+        self._matmul_fns: Dict[str, Any] = {}
+        if tp_overlap:
+            from hetu_galvatron_tpu.ops.overlap import (
+                layer_overlap_reason,
+                make_layer_matmuls,
+            )
+
+            tp_axes = self.layer_sh.weight_tp_axes
+            reason = layer_overlap_reason(
+                cfg, self.layer_sh, axes_size(self.mesh, tp_axes))
+            if reason is None:
+                self._matmul_fns = make_layer_matmuls(
+                    self.mesh, self.layer_sh.dp_axes, tp_axes,
+                    stage_axis="pp")
+                self.tp_overlap = True
+            else:
+                self.overlap_reason = reason
         # jit caches keyed by microbatch count (a batch-size ramp compiles
         # one program per distinct count; a fixed plan compiles exactly once)
         self._step_jits: Dict[int, Any] = {}
         self._eval_jits: Dict[int, Any] = {}
+
+    def _build_attention_core(self, flash_interpret: bool):
+        """The stage-stacked attention core for the (uniform) layer
+        strategy — mirrors ``parallel/spmd.attention_overrides``: cp layers
+        get ring attention over their cp axes, Ulysses layers the
+        head-scatter a2a sandwich, flash-eligible layers the Pallas kernel;
+        None means the vmapped XLA core (GSPMD inserts the collectives).
+        Every kernel is built with ``stage_axis='pp'`` so it runs on the
+        ``[pp, ...]``-stacked activations as one full-manual shard_map."""
+        sh = self.layer_sh
+        cfg = self.cfg
+        use_flash = self._use_flash
+        if use_flash is None:
+            use_flash = bool(cfg.use_flash_attn) and all(
+                d.platform == "tpu" for d in self.mesh.devices.flat[:1])
+        if sh.cp_axes:
+            from hetu_galvatron_tpu.ops.ring_attention import make_ring_sdpa
+
+            zig = bool(getattr(self.hpc, "cp_zigzag", False))
+            return make_ring_sdpa(
+                self.mesh, sh.cp_axes, dp_axes=sh.dp_axes,
+                tp_axes=sh.tp_axes, use_flash=use_flash, zigzag=zig,
+                data_zigzagged=zig, interpret=flash_interpret,
+                stage_axis="pp")
+        if sh.ulysses and sh.tp_axes:
+            from hetu_galvatron_tpu.ops.ulysses import make_ulysses_sdpa
+
+            local = None
+            if use_flash:
+                from hetu_galvatron_tpu.ops.pallas.flash_attention import (
+                    flash_sdpa,
+                )
+
+                local = (partial(flash_sdpa, interpret=True)
+                         if flash_interpret else flash_sdpa)
+            return make_ulysses_sdpa(self.mesh, sh.tp_axes,
+                                     dp_axes=sh.dp_axes, local_sdpa=local,
+                                     stage_axis="pp")
+        if use_flash:
+            from hetu_galvatron_tpu.ops.pallas.flash_attention import (
+                make_flash_sdpa,
+            )
+
+            return make_flash_sdpa(self.mesh, dp_axes=sh.dp_axes,
+                                   tp_axes=sh.tp_axes,
+                                   interpret=flash_interpret,
+                                   stage_axis="pp")
+        return None
 
     # ------------------------------------------------------------------
     # params / optimizer state (stacked layout)
@@ -283,66 +382,243 @@ class CompiledPipelineEngine:
         return init(sp)
 
     # ------------------------------------------------------------------
-    # lane programs (vmapped over the stacked stage axis)
+    # stacked stage programs (explicit leading [pp] stage axis — NOT a
+    # vmap, so the shard_map kernels run inside; weight-free segments ride
+    # plain vmaps over the lane axis, which trace identically to the old
+    # per-lane form)
     # ------------------------------------------------------------------
 
-    def _lane_rng(self, step_rng, mb, lane):
-        """Per-(microbatch, stage) dropout key — same derivation as the host
-        engine's ``_mb_rng`` so a compiled run replays identical masks."""
-        if step_rng is None:
+    def _lane_keys(self, step_rng, mbs):
+        """[pp] per-(microbatch, stage) dropout keys — same derivation as
+        the host engine's ``_mb_rng`` (and the old vmapped core), so a
+        compiled run replays identical masks. None when dropout is off."""
+        if step_rng is None or not self._use_dropout:
             return None
-        return jax.random.fold_in(jax.random.fold_in(step_rng, mb), lane)
+        lanes = jnp.arange(self.pp)
+        return jax.vmap(lambda mb, lane: jax.random.fold_in(
+            jax.random.fold_in(step_rng, mb), lane))(mbs, lanes)
 
-    def _apply_stage_layers(self, stage_w, x, lane_rng):
-        """The Lps decoder layers of one lane (per-layer remat honored)."""
+    def _st_dropout(self, x, rate, rngs):
+        """Per-lane inverted dropout on a ``[pp, ...]`` stacked value:
+        vmapped over the lane keys, bit-identical to the host engine's
+        per-stage masks under the partitionable threefry rng."""
+        if rngs is None or rate <= 0.0:
+            return x
+        return jax.vmap(lambda xl, r: M.dropout(xl, rate, r))(x, rngs)
+
+    def _st_norm(self, p, x):
+        """Stacked per-layer norm: params carry the leading ``[pp]`` stage
+        axis; per-lane apply_norm under vmap keeps the fp32 arithmetic
+        bit-identical to the host engine's per-stage call."""
+        if not p:
+            return x
+        return jax.vmap(lambda pl, xl: M.apply_norm(pl, xl, self.cfg))(p, x)
+
+    def _stacked_attention(self, p, x, rope, attn_rngs, causal):
+        """modules.apply_attention on a ``[pp, B, S, H]`` stacked stream
+        with ``[pp, ...]`` stacked weights: the projections run as explicit
+        leading-axis einsums — or the stage-stacked ring kernels when
+        ``tp_overlap`` is on — and the attention core is the stage-stacked
+        kernel from ``_build_attention_core`` (vmapped XLA core when None).
+        Mirrors the module's dtype casts and dropout dispatch rules."""
+        cfg = self.cfg
+        cd = self.compute_dtype
+        mm = self._matmul_fns
+        pp_, B, S, _ = x.shape
+        hd = cfg.head_dim
+        nq, nkv = cfg.num_attention_heads, cfg.kv_heads
+        w = p["wqkv"].astype(cd)
+        if "qkv" in mm:
+            qkv = mm["qkv"](x.astype(cd), w)
+        else:
+            qkv = jnp.einsum("pbsh,phf->pbsf", x.astype(cd), w,
+                             preferred_element_type=jnp.float32)
+        if "bqkv" in p:
+            qkv = qkv + p["bqkv"][:, None, None, :]
+        qkv = qkv.astype(cd)
+        q, k, v = jnp.split(qkv, [nq * hd, (nq + nkv) * hd], axis=-1)
+        q = q.reshape(pp_, B, S, nq, hd)
+        k = k.reshape(pp_, B, S, nkv, hd)
+        v = v.reshape(pp_, B, S, nkv, hd)
+        if rope is not None:
+            cos, sin = rope
+            q = M.apply_rope(q, cos, sin)
+            k = M.apply_rope(k, cos, sin)
+        core = self._sdpa
+        use_drop = attn_rngs is not None and cfg.attention_dropout > 0.0
+        if use_drop:
+            if core is None:
+                out = jax.vmap(lambda qq, kk, vv, rr: M.xla_sdpa(
+                    qq, kk, vv, causal=causal,
+                    dropout_rate=cfg.attention_dropout,
+                    dropout_rng=rr))(q, k, v, attn_rngs)
+            elif getattr(core, "supports_dropout", False):
+                out = core(q, k, v, causal=causal,
+                           dropout_rate=cfg.attention_dropout,
+                           dropout_rng=attn_rngs)
+            else:
+                # same refusal as modules.apply_attention: silently swapping
+                # a ring/Ulysses kernel for the score-materializing XLA core
+                # would be an OOM/perf cliff on the plans it exists for
+                raise NotImplementedError(
+                    "attention_dropout > 0 is only supported with the XLA "
+                    "attention core and the Pallas flash kernel; the "
+                    "installed ring/Ulysses kernel has no dropout variant. "
+                    "Avoid cp/ulysses layers or set "
+                    "model.attention_dropout=0; hidden_dropout works with "
+                    "every kernel")
+        elif core is None:
+            out = jax.vmap(lambda qq, kk, vv: M.xla_sdpa(
+                qq, kk, vv, causal=causal))(q, k, v)
+        else:
+            out = core(q, k, v, causal=causal)
+        out = out.reshape(pp_, B, S, nq * hd)
+        wo = p["wo"].astype(cd)
+        if "out" in mm:
+            y = mm["out"](out, wo)
+        else:
+            y = jnp.einsum("pbsf,pfh->pbsh", out, wo,
+                           preferred_element_type=jnp.float32)
+        if "bo" in p:
+            y = y + p["bo"][:, None, None, :]
+        return y.astype(cd)
+
+    def _stacked_mlp(self, p, x):
+        """modules.apply_mlp with stacked weights (gated/plain, bias adds,
+        and the fc1_pair overlapped form all mirrored)."""
+        cfg = self.cfg
+        cd = self.compute_dtype
+        mm = self._matmul_fns
+        act = M._ACTS[cfg.hidden_act]
+        win = p["win"].astype(cd)
+        gated = cfg.hidden_act in ("swiglu", "geglu")
+        if gated and "fc1_pair" in mm:
+            F = p["wout"].shape[1]
+            gate, up = mm["fc1_pair"](x.astype(cd), win[..., :F],
+                                      win[..., F:])
+            if "bin" in p:
+                gate = gate + p["bin"][:, None, None, :F]
+                up = up + p["bin"][:, None, None, F:]
+            hproj = act(gate.astype(cd)) * up.astype(cd)
+        else:
+            if "fc1" in mm:
+                hproj = mm["fc1"](x.astype(cd), win)
+            else:
+                hproj = jnp.einsum("pbsh,phf->pbsf", x.astype(cd), win,
+                                   preferred_element_type=jnp.float32)
+            if "bin" in p:
+                hproj = hproj + p["bin"][:, None, None, :]
+            hproj = hproj.astype(cd)
+            if gated:
+                gate, up = jnp.split(hproj, 2, axis=-1)
+                hproj = act(gate) * up
+            else:
+                hproj = act(hproj)
+        wout = p["wout"].astype(cd)
+        if "fc2" in mm:
+            y = mm["fc2"](hproj, wout)
+        else:
+            y = jnp.einsum("pbsf,pfh->pbsh", hproj, wout,
+                           preferred_element_type=jnp.float32)
+        if "bout" in p:
+            y = y + p["bout"][:, None, None, :]
+        return y.astype(cd)
+
+    def _stacked_decoder_layer(self, p, x, rope, layer_keys, causal):
+        """modules.apply_decoder_layer on the stacked stream: pre-norm or
+        post-norm (bert) residual block with per-lane dropout keys split
+        exactly like the module does."""
+        cfg = self.cfg
+        r_attn = r_res1 = r_res2 = None
+        if layer_keys is not None:
+            r3 = jax.vmap(lambda kk: jax.random.split(kk, 3))(layer_keys)
+            r_attn, r_res1, r_res2 = r3[:, 0], r3[:, 1], r3[:, 2]
+        drop = lambda y, rr: self._st_dropout(y, cfg.hidden_dropout, rr)
+        if cfg.post_norm:
+            x = self._st_norm(
+                p["ln1"],
+                x + drop(self._stacked_attention(p["attn"], x, rope, r_attn,
+                                                 causal), r_res1))
+            return self._st_norm(
+                p["ln2"],
+                x + drop(self._stacked_mlp(p["mlp"], x), r_res2))
+        h = self._st_norm(p["ln1"], x)
+        x = x + drop(self._stacked_attention(p["attn"], h, rope, r_attn,
+                                             causal), r_res1)
+        h = self._st_norm(p["ln2"], x)
+        x = x + drop(self._stacked_mlp(p["mlp"], h), r_res2)
+        return x
+
+    def _stacked_layers(self, stages_w, x, lane_keys):
+        """The Lps decoder-layer slots on the stacked stream (per-layer
+        remat honored, same checkpoint policy as the host engine)."""
         cfg = self.cfg
         rope = None
         if cfg.position_embedding_type == "rope":
-            cos, sin = M.rope_cos_sin(x.shape[1], cfg.head_dim,
+            cos, sin = M.rope_cos_sin(x.shape[2], cfg.head_dim,
                                       cfg.rope_theta,
                                       scaling=cfg.rope_scaling)
             rope = (cos, sin)
-        for j, lp in enumerate(stage_w):
-            fn = partial(M.apply_decoder_layer, cfg=cfg, rope=rope,
-                         compute_dtype=self.compute_dtype,
-                         dropout_rng=M.fold_dropout_rng(lane_rng, cfg, j))
+        causal = cfg.model_type != "bert"
+        for j, lp in enumerate(stages_w):
+            keys = None
+            if lane_keys is not None:
+                keys = jax.vmap(
+                    lambda kk, _j=j: jax.random.fold_in(kk, _j))(lane_keys)
+            fn = partial(self._stacked_decoder_layer, rope=rope,
+                         layer_keys=keys, causal=causal)
             if self.layer_sh.checkpoint:
                 fn = M.remat(fn, cfg)
             x = fn(lp, x)
         return x
 
-    def _lane_entry(self, embed_p, x_in, tokens, lane, lane_rng):
+    def _stacked_entry(self, embed_p, x_in, tokens, lane_keys):
         """Stage input: lane 0 embeds the tick's tokens, others take the
-        rotated activation. The embedding itself is lane-invariant (tokens
-        and table are broadcast into the vmap), so vmap batches it OUT of
-        the per-lane work — only the select is per-lane."""
-        emb = M.apply_embedding(
-            embed_p, tokens, self.cfg, compute_dtype=self.compute_dtype,
-            dropout_rng=M.fold_dropout_rng(
-                lane_rng, self.cfg, M.DROPOUT_STREAM_EMBED))
-        return jnp.where(lane == 0, emb, x_in)
+        rotated activation. The embedding is lane-invariant (computed once
+        and broadcast) unless dropout is on, in which case each lane embeds
+        with its own key — matching the old vmapped trace exactly."""
+        cfg = self.cfg
+        if lane_keys is None:
+            emb = M.apply_embedding(
+                embed_p, tokens, cfg,
+                compute_dtype=self.compute_dtype)[None]
+        else:
+            ek = jax.vmap(lambda kk: jax.random.fold_in(
+                kk, M.DROPOUT_STREAM_EMBED))(lane_keys)
+            emb = jax.vmap(lambda kk: M.apply_embedding(
+                embed_p, tokens, cfg, compute_dtype=self.compute_dtype,
+                dropout_rng=kk))(ek)
+        lane0 = (jnp.arange(self.pp) == 0)[:, None, None, None]
+        return jnp.where(lane0, emb, x_in)
 
-    def _lane_fwd(self, stage_w, embed_p, x_in, tokens, lane, mb, step_rng):
-        lane_rng = self._lane_rng(step_rng, mb, lane)
-        x = self._lane_entry(embed_p, x_in, tokens, lane, lane_rng)
-        return self._apply_stage_layers(stage_w, x, lane_rng)
+    def _stacked_fwd(self, stages_w, embed_p, x_in, tokens, mbs, step_rng):
+        lane_keys = self._lane_keys(step_rng, mbs)
+        x = self._stacked_entry(embed_p, x_in, tokens, lane_keys)
+        return self._stacked_layers(stages_w, x, lane_keys)
 
-    def _lane_full(self, stage_w, shared, x_in, tokens, labels, mask, lane,
-                   mb, step_rng):
-        """Stage forward INCLUDING the head: returns (y_out, loss). Used by
-        backward ticks (the vjp recomputes the stage from its stored input,
-        per-stage remat) and by eval. Only the last lane's loss ever
-        receives a non-zero cotangent / enters the loss accumulator."""
-        lane_rng = self._lane_rng(step_rng, mb, lane)
-        x = self._lane_entry(shared["embed"], x_in, tokens, lane, lane_rng)
-        y = self._apply_stage_layers(stage_w, x, lane_rng)
-        h = M.apply_norm(shared["prenorm"], y, self.cfg)
+    def _stacked_full(self, stages_w, shared, x_in, tokens, labels, mask,
+                      mbs, step_rng):
+        """Stage forward INCLUDING the head: returns (y_out, [pp] losses).
+        Used by backward ticks (the vjp recomputes the stage from its
+        stored input, per-stage remat) and by eval. Only the last lane's
+        loss ever receives a non-zero cotangent / enters the loss
+        accumulator; the vocab weights are replicated across pp, so the
+        head segment is a plain per-lane vmap."""
+        cfg = self.cfg
+        lane_keys = self._lane_keys(step_rng, mbs)
+        x = self._stacked_entry(shared["embed"], x_in, tokens, lane_keys)
+        y = self._stacked_layers(stages_w, x, lane_keys)
+        h = M.apply_norm(shared["prenorm"], y, cfg)
         wte = (shared["embed"]["wte"]
-               if self.cfg.tie_word_embeddings else None)
-        logits = M.apply_lm_head(shared["head"], h, self.cfg, wte=wte,
-                                 compute_dtype=self.compute_dtype)
-        loss = M.cross_entropy_loss(logits, labels, mask)
-        return y, loss
+               if cfg.tie_word_embeddings else None)
+        head = shared["head"]
+
+        def lane_loss(hh):
+            logits = M.apply_lm_head(head, hh, cfg, wte=wte,
+                                     compute_dtype=self.compute_dtype)
+            return M.cross_entropy_loss(logits, labels, mask)
+
+        return y, jax.vmap(lane_loss)(h)
 
     # ------------------------------------------------------------------
     # the fused step
@@ -382,18 +658,11 @@ class CompiledPipelineEngine:
             make_embed_use_constraint(axes_embed, self.vocab_sh, mesh)
             if axes_embed is not None else (lambda e: e))
 
-        def vfwd(stages_w, embed_p, x_stack, tokens, mbs, step_rng):
-            f = jax.vmap(self._lane_fwd,
-                         in_axes=(0, None, 0, None, 0, 0, None))
-            return f(stages_w, embed_p, x_stack, tokens, jnp.asarray(lanes),
-                     mbs, step_rng)
-
-        def vfull(stages_w, shared, x_stack, tokens, labels, mask, mbs,
-                  step_rng):
-            f = jax.vmap(self._lane_full,
-                         in_axes=(0, None, 0, None, None, None, 0, 0, None))
-            return f(stages_w, shared, x_stack, tokens, labels, mask,
-                     jnp.asarray(lanes), mbs, step_rng)
+        # de-vmapped stage programs: ordinary traced code over the stacked
+        # [pp, ...] stream — which is what lets the shard_map kernels
+        # (ring matmuls / flash / ulysses / cp) run inside the scan
+        vfwd = self._stacked_fwd
+        vfull = self._stacked_full
 
         def step(sp, opt, batch, step_rng):
             tokens = batch["tokens"]            # [m, B, S] int32
@@ -510,10 +779,8 @@ class CompiledPipelineEngine:
         lanes = np.arange(pp)
 
         def vfull(stages_w, shared, x_stack, tokens, labels, mask, mbs):
-            f = jax.vmap(self._lane_full,
-                         in_axes=(0, None, 0, None, None, None, 0, 0, None))
-            return f(stages_w, shared, x_stack, tokens, labels, mask,
-                     jnp.asarray(lanes), mbs, None)
+            return self._stacked_full(stages_w, shared, x_stack, tokens,
+                                      labels, mask, mbs, None)
 
         def eval_step(sp, batch):
             tokens, labels = batch["tokens"], batch["labels"]

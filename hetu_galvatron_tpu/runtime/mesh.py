@@ -193,7 +193,12 @@ def make_pp_rotation(mesh: Mesh, spec: P, shift: int):
     perm = [(i, (i + shift) % pp) for i in range(pp)]
 
     def body(blk):
-        return jax.lax.ppermute(blk, "pp", perm)
+        # named_scope lands in the HLO metadata so trace attribution can
+        # tell stage-rotation permutes from tp-ring / cp-ring permutes when
+        # all three coexist in one compiled program
+        # (observability/trace_analysis.py)
+        with jax.named_scope("pp_rotate"):
+            return jax.lax.ppermute(blk, "pp", perm)
 
     return shard_map(body, mesh, in_specs=spec, out_specs=spec,
                      check_rep=False)

@@ -116,6 +116,8 @@ class PipelineEngine:
         compute_dtype=jnp.bfloat16,
         dcn_slices: int = 1,
         tp_overlap: bool = False,
+        use_flash: Optional[bool] = None,
+        flash_interpret: bool = False,
     ):
         self.cfg = cfg
         self.hpc = hpc
@@ -125,6 +127,11 @@ class PipelineEngine:
         # (ops/overlap.py); eligible layers only — same dispatch as the
         # SPMD path's tp_overlap_overrides, per stage submesh
         self.tp_overlap = tp_overlap
+        # attention-impl override knobs for parity drills: use_flash=None
+        # keeps the cfg/platform default; flash_interpret runs the Pallas
+        # kernels in interpret mode (CPU meshes)
+        self._use_flash = use_flash
+        self._flash_interpret = flash_interpret
         self.pp = hpc.pp_deg
         if self.pp < 2:
             # pp=1 routes through the SPMD path (cli/train_dist.py). The
@@ -394,8 +401,10 @@ class PipelineEngine:
 
         overrides = attention_overrides(
             st.shardings, st.mesh,
-            use_flash=None if cfg.use_flash_attn else False,
-            cp_zigzag=getattr(self.hpc, "cp_zigzag", False))
+            use_flash=(self._use_flash if self._use_flash is not None
+                       else (None if cfg.use_flash_attn else False)),
+            cp_zigzag=getattr(self.hpc, "cp_zigzag", False),
+            flash_interpret=self._flash_interpret)
         if self.tp_overlap:
             from hetu_galvatron_tpu.parallel.spmd import tp_overlap_overrides
 

@@ -30,6 +30,12 @@ def _run_bench(tmp_path, extra_env, timeout=600):
         "BENCH_ITERS": "1",
         "BENCH_JOURNAL": str(tmp_path / "journal.jsonl"),
         "BENCH_TIMEOUT": "300",
+        # the compiled_overlap leg (default-on) runs the dispatch bench's
+        # own ~2-minute reference workload — these tests exercise the
+        # orchestration lifecycle, not that leg (covered by
+        # test_pipeline_dispatch_bench), and it would crowd the 300s
+        # watchdog budget
+        "BENCH_COMPILED_OVERLAP": "0",
     })
     env.update(extra_env)
     proc = subprocess.run(

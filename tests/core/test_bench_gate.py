@@ -72,11 +72,22 @@ def test_regressed_leg_fails_direction_aware():
 
 
 def test_missing_leg_is_a_regression_and_new_leg_is_not():
+    # VANISHED: the same-device history proves the leg used to be
+    # measured — its absence from the candidate is a failure
     cand = {"device": "cpu", "legs": {"mfu_pct": 5.0,
                                       "tokens_per_sec": 100.0}}
-    rows, ok = bench_gate.compare(_baseline(), cand, threshold=0.10)
+    hist = [{"device": "cpu", "value": 5.0, "compiled_vs_host": 0.7}]
+    rows, ok = bench_gate.compare(_baseline(), cand, threshold=0.10,
+                                  history=hist)
     assert not ok
     assert any(r["status"].startswith("MISSING") for r in rows)
+    # PENDING: a baseline leg no same-device run ever produced (a freshly
+    # committed entry) must NOT fail the gate — it renders as pending
+    # until the first bench round measures it
+    rows, ok = bench_gate.compare(_baseline(), cand, threshold=0.10)
+    assert ok
+    status = {r["leg"]: r["status"] for r in rows}
+    assert status["compiled_vs_host"].startswith("pending")
     # a leg only the candidate has is informational, not a failure
     base = _baseline(legs={"mfu_pct": 5.0})
     cand = {"device": "cpu", "legs": {"mfu_pct": 5.0, "flash_speedup": 2.0}}

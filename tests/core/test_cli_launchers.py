@@ -69,6 +69,26 @@ def test_train_dist_cli_pipeline_compiled(capsys):
     assert "training done" in res.out
 
 
+def test_train_dist_cli_compiled_with_tp_overlap(capsys):
+    """The unified path at the launcher level: tp_overlap.enable under
+    pipeline.schedule_impl=compiled keeps the rings (no feature disable —
+    the round-11 behavior) and logs them riding inside the fused program."""
+    from hetu_galvatron_tpu.cli.train_dist import main
+
+    rc = main([os.path.join(ZOO, "llama2-7b.yaml")] + TINY_OVERRIDES +
+              ["parallel.pp_deg=2", "parallel.chunks=2",
+               "parallel.global_tp_deg=2",
+               "parallel.pipeline_type=pipedream_flush",
+               "pipeline.schedule_impl=compiled", "tp_overlap.enable=1",
+               "model.num_key_value_heads=2", "model.ffn_hidden_size=64"])
+    res = capsys.readouterr()
+    assert rc == 0
+    assert "pipeline schedule: compiled" in res.out + res.err
+    assert "overlapped-TP rings inside" in res.out + res.err
+    assert "unsupported under" not in res.out + res.err
+    assert "training done" in res.out
+
+
 def test_train_dist_cli_compiled_falls_back(capsys):
     """A plan the compiled schedule cannot express (gpipe) logs its reason
     and trains through the host engine."""

@@ -186,8 +186,12 @@ def test_unsupported_plans_report_reasons():
     _, mhpc = _hpc(cfg=moe)
     assert "MoE" in CompiledPipelineEngine.unsupported_reason(moe, mhpc)
 
+    # cp / zigzag-cp plans are EXPRESSIBLE since the stage axis was
+    # de-vmapped (the ring kernel runs inside as a stage-stacked shard_map)
     _, cp = _hpc(global_cp_deg=2, global_tp_deg=1)
-    assert "context" in CompiledPipelineEngine.unsupported_reason(CFG, cp)
+    assert CompiledPipelineEngine.unsupported_reason(CFG, cp) is None
+    _, zz = _hpc(global_cp_deg=2, global_tp_deg=1, cp_zigzag=True)
+    assert CompiledPipelineEngine.unsupported_reason(CFG, zz) is None
 
     class _Packed:
         reset_position_ids = True
@@ -237,6 +241,122 @@ def test_pp_rotation_is_collective_permute(cpu_devices):
     # a rotation really lowers to a collective-permute, not a reshard
     txt = fwd.lower(xd).compile().as_text()
     assert "collective-permute" in txt, "rotation did not lower to ppermute"
+
+
+def _searched_pp2_tp2_dp2_plan(tmp_path):
+    """A pp2 x tp2 x dp2 plan in the searched-config interchange format
+    (what search_engine.save_results writes): the unified-engine drill runs
+    the plan the SEARCH would hand the launcher, not a hand-built hpc."""
+    import json
+
+    from hetu_galvatron_tpu.utils.strategy import (
+        EmbeddingLMHeadStrategy,
+        LayerStrategy,
+        strategy_list2config,
+    )
+
+    layers = [LayerStrategy(pp_deg=2, tp_size=2, dp_size=2)
+              for _ in range(CFG.num_hidden_layers)]
+    cfg = strategy_list2config(
+        layers, global_bsz=16, chunks=4, pipeline_type="pipedream_flush",
+        default_dp_type="ddp", vocab=EmbeddingLMHeadStrategy(vtp=2),
+        pp_division=[2, 2])
+    path = tmp_path / "galvatron_config_unified_drill.json"
+    path.write_text(json.dumps(cfg))
+    return str(path)
+
+
+def test_compiled_kernels_acceptance_drill(tmp_path, cpu_devices):
+    """ROUND-12 ACCEPTANCE: a searched tp2 x dp2 x pp2 plan with the
+    overlapped-TP ring matmuls AND the Pallas flash kernel (interpret mode
+    on the CPU mesh) runs through the COMPILED engine — no host fallback —
+    with the bit-identical 3-step trajectory and final params as the host
+    engine running the same kernels, exactly one compile, and zero
+    steady-state recompiles. This is the composition the de-vmapped stage
+    axis exists for: shard_map kernels inside the fused 1F1B program."""
+    from hetu_galvatron_tpu.models.builder import init_causal_lm
+
+    args = CoreArgs(model=CFG.model_dump(), train=TRAIN.model_dump())
+    args.parallel.config_mode = "json"
+    args.parallel.galvatron_config_path = _searched_pp2_tp2_dp2_plan(
+        tmp_path)
+    hpc = get_hybrid_parallel_config(args, 8)
+    # the searched plan is expressible — no fallback reason
+    assert CompiledPipelineEngine.unsupported_reason(CFG, hpc) is None
+    kern = dict(tp_overlap=True, use_flash=True, flash_interpret=True)
+    host = PipelineEngine(CFG, hpc, args.train, devices=cpu_devices,
+                          compute_dtype=jnp.float32, **kern)
+    comp = CompiledPipelineEngine(CFG, hpc, args.train, devices=cpu_devices,
+                                  compute_dtype=jnp.float32, **kern)
+    # the rings really are live inside the compiled program
+    assert comp.tp_overlap and comp.overlap_reason is None
+    assert comp._matmul_fns and comp._sdpa is not None
+    params, axes = init_causal_lm(jax.random.key(0), CFG)
+    hsp = host.split_params(params, axes)
+    hso = host.init_opt(hsp, axes)
+    csp = comp.split_params(params, axes)
+    cso = comp.init_opt(csp, axes)
+    for step in range(3):
+        batch = _batch(seed=step)
+        hsp, hso, hm = host.train_step(hsp, hso, batch)
+        csp, cso, cm = comp.train_step(csp, cso, batch)
+        assert abs(float(cm["loss"]) - hm["loss"]) < 2e-5, step
+        assert abs(float(cm["grad_norm"]) - hm["grad_norm"]) < 1e-4, step
+    hp, cp = host.merge_params(hsp), comp.merge_params(csp)
+    for (path, a), (_, b) in zip(jax.tree_util.tree_leaves_with_path(hp),
+                                 jax.tree_util.tree_leaves_with_path(cp)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=5e-4, atol=5e-5,
+            err_msg=f"param {jax.tree_util.keystr(path)}")
+    # one program, zero steady-state recompiles, no steady host transfers
+    assert comp.compile_count() == 1
+    dev_batch = comp.put_batch(_batch(seed=9), hpc.chunks)
+    with jax.transfer_guard("disallow"):
+        csp, cso, m = comp.train_step(csp, cso, dev_batch)
+    jax.block_until_ready(m["loss"])
+    assert comp.compile_count() == 1, "steady state recompiled"
+
+
+def test_compiled_cp_plan_matches_host(cpu_devices):
+    """cp plans no longer fall back: a cp2 x dp2 x pp2 plan runs the ring
+    attention kernel INSIDE the fused program (stage-stacked shard_map)
+    with host-engine parity. vocab_cp=2 rides along — the round-11 guard
+    rejected `vocab.vcp > 1` too, and the replicated-across-pp vocab rows
+    must keep their cp sharding parity now that the guard is gone."""
+    host, comp, params, axes, _ = _engines(
+        cpu_devices, global_cp_deg=2, global_tp_deg=1, chunks=2,
+        global_train_batch_size=8, vocab_cp=2)
+    hsp = host.split_params(params, axes)
+    hso = host.init_opt(hsp, axes)
+    csp = comp.split_params(params, axes)
+    cso = comp.init_opt(csp, axes)
+    batch = _batch(bsz=8)
+    hsp, hso, hm = host.train_step(hsp, hso, batch)
+    csp, cso, cm = comp.train_step(csp, cso, batch)
+    assert abs(float(cm["loss"]) - hm["loss"]) < 2e-5
+    hp, cp = host.merge_params(hsp), comp.merge_params(csp)
+    for (path, a), (_, b) in zip(jax.tree_util.tree_leaves_with_path(hp),
+                                 jax.tree_util.tree_leaves_with_path(cp)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=5e-4, atol=5e-5,
+            err_msg=f"param {jax.tree_util.keystr(path)}")
+
+
+@pytest.mark.slow
+def test_compiled_zigzag_cp_plan_matches_host(cpu_devices):
+    """Zigzag-cp composes with the compiled schedule too (the balanced
+    causal layout's entry/exit permutes run inside the program)."""
+    host, comp, params, axes, _ = _engines(
+        cpu_devices, global_cp_deg=2, global_tp_deg=1, chunks=2,
+        global_train_batch_size=8, cp_zigzag=True)
+    hsp = host.split_params(params, axes)
+    hso = host.init_opt(hsp, axes)
+    csp = comp.split_params(params, axes)
+    cso = comp.init_opt(csp, axes)
+    batch = _batch(bsz=8)
+    hsp, hso, hm = host.train_step(hsp, hso, batch)
+    csp, cso, cm = comp.train_step(csp, cso, batch)
+    assert abs(float(cm["loss"]) - hm["loss"]) < 2e-5
 
 
 def test_compiled_ramp_caches_one_program_per_chunk_count(cpu_devices):

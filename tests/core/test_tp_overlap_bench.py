@@ -30,6 +30,22 @@ def test_tp_overlap_bench_runs_and_is_consistent():
 
 
 @pytest.mark.slow
+@pytest.mark.pipeline
+def test_tp_overlap_bench_compiled_mode_measures_inside_the_engine():
+    """ROUND-12: --schedule-impl compiled runs the same rings-vs-GSPMD A/B
+    INSIDE the compiled 1F1B engine (pp2 plans, the rings as stage-stacked
+    shard_maps) — the ratio must hold <= 1.0 there too, with zero
+    steady-state recompiles."""
+    out = _bench(iters=3, tps=(2,), hidden=64, seq=64,
+                 schedule_impl="compiled")
+    assert out["schedule_impl"] == "compiled"
+    leg = out["legs"]["tp2"]
+    assert leg["gspmd_step_ms"] > 0 and leg["overlap_step_ms"] > 0
+    assert out["overlap_vs_gspmd"] <= 1.0, out
+    assert out["overlap_recompiles"] == 0
+
+
+@pytest.mark.slow
 def test_tp_overlap_does_not_regress_gspmd_on_cpu_mesh():
     """Acceptance: at the default (amortizing) shapes, the interleaved
     pooled-median ratio across tp2 and tp4 stays <= 1.0 and the overlap

@@ -200,6 +200,71 @@ def test_attribute_device_track_annotation_coverage():
     assert attr.device_annotation_ms["train/step"] == pytest.approx(0.35)
 
 
+def test_attribute_two_source_permute_disambiguation():
+    """One compiled program mixing tp-ring hops with pp stage rotations
+    (the unified 1F1B engine): permutes stamped with named_scope metadata
+    (``pp_rotate`` / ``tp_ring`` / ``cp_ring`` in the tf_op path) bill to
+    their own sub-category, an unmarked permute covered by a
+    device-propagated ``tp/overlap_step`` span rebills to tp, and only the
+    remainder stays on the plan-level heuristic."""
+    events = [
+        # stage rotation: named_scope metadata rides in tf_op
+        _ev(5, 1, 0, 100, "collective-permute.1",
+            tf_op="pp_rotate/ppermute"),
+        # tp ring hop, marker in long_name instead
+        _ev(5, 1, 150, 100, "collective-permute.2",
+            long_name="jit(step)/tp_ring/ppermute"),
+        # cp ring hop
+        _ev(5, 1, 300, 50, "collective-permute.5",
+            tf_op="cp_ring/ppermute"),
+        # unmarked permute fully inside a tp/overlap_step device window
+        _ev(5, 1, 400, 100, "collective-permute.3"),
+        _ev(5, 1, 380, 140, "tp/overlap_step"),
+        # unmarked permute outside every window -> plan heuristic
+        _ev(5, 1, 600, 100, "collective-permute.4"),
+        _ev(5, 1, 750, 100, "fusion.1"),
+    ]
+    attr = attribute(SimpleNamespace(
+        events=events, process_names={5: "/device:TPU:0"},
+        thread_names={}, path=""))
+    assert attr.categories_ms["permute_pp"] == pytest.approx(0.1)
+    assert attr.categories_ms["permute_tp"] == pytest.approx(0.2)
+    assert attr.categories_ms["permute_cp"] == pytest.approx(0.05)
+    assert attr.categories_ms["permute"] == pytest.approx(0.1)
+    # a pipelined tp plan: ring hops land on tp, rotations + the unmarked
+    # remainder on pp — the mis-billing the round-11 heuristic had
+    from hetu_galvatron_tpu.utils.strategy import LayerStrategy
+
+    hpc = SimpleNamespace(layers=[LayerStrategy(pp_deg=2, tp_size=2,
+                                                dp_size=2)], pp_deg=2)
+    m = measured_components(attr, hpc)
+    assert m["tp"] == pytest.approx(0.2)
+    assert m["cp"] == pytest.approx(0.05)
+    assert m["pp"] == pytest.approx(0.1 + 0.1)
+
+
+def test_attribute_window_rebilling_disabled_under_compiled_pipeline():
+    """The tp/overlap_step span wraps the whole train step, so when the
+    COMPILED engine ran (its pp stage rotations are in-program ppermutes
+    inside the same window) an unmarked permute must NOT be rebilled to tp
+    by window coverage — the pp/compiled_step annotation is the evidence
+    that disables the pass; only named_scope markers disambiguate there."""
+    events = [
+        # unmarked permute (a stage rotation whose HLO metadata was
+        # stripped) fully inside a step-wide tp/overlap_step window
+        _ev(5, 1, 400, 100, "collective-permute.3"),
+        _ev(5, 1, 0, 1000, "tp/overlap_step"),
+        _ev(5, 1, 0, 1000, "pp/compiled_step"),
+        _ev(5, 1, 750, 100, "fusion.1"),
+    ]
+    attr = attribute(SimpleNamespace(
+        events=events, process_names={5: "/device:TPU:0"},
+        thread_names={}, path=""))
+    # stays a bare permute -> the plan heuristic (pp when pipelined)
+    assert attr.categories_ms.get("permute") == pytest.approx(0.1)
+    assert "permute_tp" not in attr.categories_ms
+
+
 # ---------------------------------------------------------------------------
 # XLA program cost accounting
 # ---------------------------------------------------------------------------

@@ -82,6 +82,59 @@ def test_compiled_waiver_only_for_expressible_plans():
         pytest.approx(cost([2, 2], mixed) + term)
     # the expressible shape keeps the waiver
     assert cost([2, 2], uniform, dispatch_us=d) == cost([2, 2], uniform)
+    # cp plans are expressible since the engine de-vmapped its stage axis
+    # (the ring-attention kernel runs inside the fused program): no dispatch
+    def cp_cost(dispatch_us):
+        ctx = _ctx(dispatch_us, "compiled")
+        ctx.comm_coe_dict = dict(ctx.comm_coe_dict, **{"2_0": 0.05})
+        cp_plan = [SearchStrategy(pp=2, tp=1, cp=2, dp=2)] * 4
+        return pipeline_time_cost([4], [ctx], cp_plan, [2, 2], 4, 16, 2,
+                                  [0.0, 0.0])
+
+    assert cp_cost(d) == pytest.approx(cp_cost(0.0))
+
+
+def test_plan_flip_needs_product_of_waiver_and_overlap():
+    """The composition pin: a deep-pp tp plan beats the pp=1 alternative
+    ONLY when the dispatch waiver (compiled schedule) and the tp_overlap
+    discount apply TOGETHER — either effect alone leaves it losing. This is
+    the search-side contract of running the ring kernels inside the
+    compiled 1F1B program."""
+    layers, chunks, gbsz = 4, 4, 16
+
+    def cost(s, pp, *, schedule_impl, overlap, dispatch_us):
+        ctx = CostContext(
+            parameter_size=48.0, seq_length=1024, hidden_size=4096,
+            layer_num=layers, mixed_precision=True,
+            pipeline_type="pipedream_flush",
+            forward_computation_time=3.0,
+            # dp=8 gradient all-reduce priced expensive (the pressure that
+            # makes deep pp attractive at all); tp pair fitted mid-range
+            comm_coe_dict={"8_1": 1.05, "8_0": 1.05, "4_1": 0.1,
+                           "4_0": 0.1, "2_1": 0.05, "2_0": 0.05,
+                           "1_1": 0.0},
+            p2p_comm_coe_dict={2: 0.0001},
+            tp_alpha_beta={"2_1": (0.3, 5.0), "2_0": (0.3, 5.0)},
+            tp_overlap=overlap, schedule_impl=schedule_impl,
+            dispatch_us=dispatch_us)
+        partition = [layers // pp] * pp
+        return pipeline_time_cost([layers], [ctx], [s] * layers, partition,
+                                  chunks, gbsz, pp, [0.0] * pp)
+
+    deep = SearchStrategy(pp=2, tp=2, dp=2)
+    flat = SearchStrategy(pp=1, tp=1, dp=8)
+    d = 650.0  # us per stage-jit call
+
+    def delta(schedule_impl, overlap):
+        return (cost(deep, 2, schedule_impl=schedule_impl, overlap=overlap,
+                     dispatch_us=d)
+                - cost(flat, 1, schedule_impl=schedule_impl,
+                       overlap=overlap, dispatch_us=d))
+
+    assert delta("host", False) > 0        # baseline: deep pp loses
+    assert delta("host", True) > 0         # overlap alone: still loses
+    assert delta("compiled", False) > 0    # waiver alone: still loses
+    assert delta("compiled", True) < 0     # the product flips the plan
 
 
 def test_pp_choice_flips_when_dispatch_is_cranked():

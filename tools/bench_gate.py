@@ -15,7 +15,10 @@ human rereading them. This gate closes that loop:
 * a leg past the threshold fails the gate (rc 1) with a readable per-leg
   delta report; legs measured on a different device than the baseline are
   skipped with a warning (a CPU-fallback bench must not "regress" a TPU
-  baseline, nor green-light it),
+  baseline, nor green-light it); a baseline leg the candidate lacks fails
+  only when same-device history shows it was measured before (vanished),
+  and renders as "pending" when it is simply newer than the history (a
+  freshly committed entry),
 * the history's per-leg min/max rides along as a noise-context column.
 
 Wiring: ``tools/tpu_measure_all.py`` runs the gate after its bench step;
@@ -55,6 +58,10 @@ LEGS: Tuple[Tuple[str, str, bool], ...] = (
     ("fused_ce_speedup", "fused_ce_speedup", True),
     ("tp_overlap_vs_gspmd", "tp_overlap_vs_gspmd", False),
     ("compiled_vs_host", "compiled_vs_host", False),
+    # the unified path: compiled 1F1B with the shard_map kernels (ring tp
+    # matmuls + flash) inside, vs the host engine with the same kernels
+    # (tools/pipeline_dispatch_bench.py --kernels). A ratio, regresses UP.
+    ("compiled_overlap", "compiled_overlap_vs_host", False),
 )
 
 
@@ -126,10 +133,19 @@ def compare(baseline: Dict[str, Any], candidate: Dict[str, Any],
         elif b is None:
             row["status"] = "new (no baseline; run --update-baseline)"
         elif c is None:
-            # a leg silently vanishing IS a regression signal: the bench
-            # stopped measuring something the baseline promises
-            row["status"] = "MISSING from candidate"
-            ok = False
+            if hist:
+                # a leg silently VANISHING is a regression signal: the
+                # bench measured it before (same-device history) and
+                # stopped — something the baseline promises went dark
+                row["status"] = "MISSING from candidate"
+                ok = False
+            else:
+                # a baseline leg NO same-device run ever produced is
+                # merely newer than the history (a freshly committed
+                # entry, e.g. compiled_overlap): render it pending, not
+                # failed, or the gate is permanently red from the commit
+                # that introduces a leg until the next bench round
+                row["status"] = "pending (no measured history yet)"
         else:
             delta = (c - b) / b
             row["delta"] = delta
@@ -186,13 +202,13 @@ def smoke() -> int:
     render end-to-end without any bench history."""
     base = {"device": "TPU v5 lite",
             "legs": {"mfu_pct": 40.0, "tokens_per_sec": 100000.0,
-                     "compiled_vs_host": 0.7}}
+                     "compiled_vs_host": 0.7, "compiled_overlap": 0.75}}
     same = {"device": "TPU v5 lite",
             "legs": {"mfu_pct": 39.2, "tokens_per_sec": 98000.0,
-                     "compiled_vs_host": 0.72}}
+                     "compiled_vs_host": 0.72, "compiled_overlap": 0.77}}
     bad = {"device": "TPU v5 lite",
            "legs": {"mfu_pct": 40.1, "tokens_per_sec": 80000.0,
-                    "compiled_vs_host": 0.95}}
+                    "compiled_vs_host": 0.95, "compiled_overlap": 1.2}}
     other_dev = {"device": "cpu", "legs": {"mfu_pct": 5.0}}
 
     rows, ok_same = compare(base, same, threshold=0.10)
@@ -208,7 +224,8 @@ def smoke() -> int:
     render_report(rows, ok_dev, candidate_name="<other device>",
                   baseline_name="<synthetic baseline>", out=buf)
     healthy = (ok_same and not ok_bad
-               and regressed == {"tokens_per_sec", "compiled_vs_host"}
+               and regressed == {"tokens_per_sec", "compiled_vs_host",
+                                 "compiled_overlap"}
                and ok_dev
                and all(r["status"].startswith("skipped") for r in rows)
                and "NO VERDICT" in buf.getvalue())

@@ -24,10 +24,18 @@ fuses the whole 1F1B step into ONE program. This tool measures both sides:
   minimum recovers the dispatch overhead it eliminates), plus
   ``compiled_recompiles`` — the jit-cache growth across the timed
   steady-state loop, which must be 0.
+* ``--kernels`` — the UNIFIED-path leg (round 12): the same A/B on a
+  tp2 x dp2 x pp2 plan with the shard_map kernels live on BOTH sides —
+  overlapped-TP ring ag/rs matmuls (``tp_overlap=True``) plus the Pallas
+  flash kernel (interpret mode on CPU, real Mosaic on ``--tpu``). Since the
+  compiled engine de-vmapped its stage axis, the kernels run INSIDE the
+  fused program; ``compiled_overlap_vs_host`` <= 1.0 is the proof that the
+  dispatch saving survives with kernels enabled (the composition the
+  tools/bench_gate.py ``compiled_overlap`` leg gates).
 
 Prints one JSON line. Run (virtual CPU mesh):
     JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
-        python tools/pipeline_dispatch_bench.py
+        python tools/pipeline_dispatch_bench.py [--kernels]
 On a real chip (tools/tpu_measure_all.py step): add ``--tpu`` to keep the
 default platform and let the pp2 plan land on 8 real devices.
 """
@@ -192,5 +200,123 @@ def run(pp: int = 2, chunks: int = 4, iters: int = 30,
     return out
 
 
+def run_kernels(pp: int = 2, chunks: int = 0, iters: int = 20,
+                on_tpu: bool = False) -> dict:
+    """The unified-path A/B: host vs compiled 1F1B on a tp2 x dp2 x pp2
+    plan with the overlapped-TP ring matmuls AND the flash kernel active on
+    both engines (interpret mode on the CPU mesh — same arithmetic, real
+    Mosaic on TPU). This is the composition the de-vmapped stage axis
+    exists for: the kernels run inside the fused single program, so the
+    ratio prices dispatch elimination WITH the kernels, not instead of
+    them."""
+    import jax
+    if not on_tpu:
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from hetu_galvatron_tpu.core.args_schema import CoreArgs
+    from hetu_galvatron_tpu.models.builder import init_causal_lm
+    from hetu_galvatron_tpu.runtime.compiled_pipeline import (
+        CompiledPipelineEngine,
+    )
+    from hetu_galvatron_tpu.runtime.dataloader import make_batch
+    from hetu_galvatron_tpu.runtime.hybrid_config import (
+        get_hybrid_parallel_config,
+    )
+    from hetu_galvatron_tpu.runtime.pipeline import PipelineEngine
+
+    devices = jax.devices()[:8] if on_tpu else jax.devices("cpu")[:8]
+    if len(devices) < 8:
+        return {"metric": "pipeline_kernels_ab", "skipped":
+                f"need 8 devices for the tp2xdp2xpp{pp} plan, have "
+                f"{len(devices)}"}
+    # wide enough that the ring chunks and flash blocks are non-degenerate
+    # on TPU; on the CPU mesh the same shapes keep interpret mode tractable.
+    # chunks: on the SHARED-HOST cpu mesh every lockstep bubble tick costs
+    # real compute (no idle device to hide it on), so the ratio is bounded
+    # below by ~T/m = 1 + 2(pp-1)/m — m=16 amortizes the bubble enough
+    # that the dispatch saving shows through (measured 0.86 vs 1.24 at
+    # m=4); on TPU lanes are physically parallel and m=8 suffices
+    hidden, seq = (256, 256) if on_tpu else (32, 8)
+    if not chunks:
+        chunks = 8 if on_tpu else 16
+    args = CoreArgs.model_validate({
+        "model": {
+            "hidden_size": hidden, "num_hidden_layers": 2 * pp,
+            "num_attention_heads": max(hidden // 16, 2), "vocab_size": 64,
+            "seq_length": seq, "max_position_embeddings": 2 * seq,
+            "hidden_act": "swiglu", "normalization": "rmsnorm",
+            "position_embedding_type": "rope", "tie_word_embeddings": False,
+            "add_bias_linear": False, "add_qkv_bias": False,
+            "make_vocab_size_divisible_by": 1, "ffn_hidden_size": 2 * hidden,
+            "use_flash_attn": True,
+        },
+        "parallel": {"pp_deg": pp, "chunks": chunks, "global_tp_deg": 2,
+                     "pipeline_type": "pipedream_flush",
+                     "global_train_batch_size": 4 * chunks},
+    })
+    hpc = get_hybrid_parallel_config(args, 8)
+    kern = dict(tp_overlap=True, use_flash=True,
+                flash_interpret=not on_tpu)
+    eng = PipelineEngine(args.model, hpc, args.train, devices=devices,
+                         compute_dtype=jnp.float32, **kern)
+    ceng = CompiledPipelineEngine(args.model, hpc, args.train,
+                                  devices=devices,
+                                  compute_dtype=jnp.float32, **kern)
+    if not ceng.tp_overlap:
+        return {"metric": "pipeline_kernels_ab", "skipped":
+                f"tp_overlap ineligible: {ceng.overlap_reason}"}
+    params, axes = init_causal_lm(jax.random.key(0), args.model)
+    sp = eng.split_params(params, axes)
+    so = eng.init_opt(sp, axes)
+    csp = ceng.split_params(params, axes)
+    cso = ceng.init_opt(csp, axes)
+    data = np.random.RandomState(0).randint(
+        0, args.model.padded_vocab_size,
+        (hpc.global_bsz, args.model.seq_length + 1))
+    batch = make_batch(data)
+
+    # compile + warm both legs outside the timed window; the losses must
+    # agree (the kernels are exact, not approximations)
+    sp, so, hm = eng.train_step(sp, so, batch)
+    csp, cso, cm = ceng.train_step(csp, cso, batch)
+    if abs(float(cm["loss"]) - float(hm["loss"])) > 1e-4:
+        raise AssertionError(
+            f"kernel legs diverged: compiled {float(cm['loss'])} vs host "
+            f"{float(hm['loss'])}")
+    n_compiles = ceng.compile_count()
+    host_times, comp_times = [], []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        sp, so, hm = eng.train_step(sp, so, batch)
+        host_times.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        csp, cso, cm = ceng.train_step(csp, cso, batch)
+        jax.block_until_ready(cm["loss"])
+        comp_times.append(time.perf_counter() - t0)
+    host_ms = float(np.median(host_times)) * 1e3
+    comp_ms = float(np.median(comp_times)) * 1e3
+    ratio = round(comp_ms / max(host_ms, 1e-9), 3)
+    return {
+        "metric": "pipeline_kernels_ab",
+        "platform": "tpu" if on_tpu else "cpu",
+        "pp": pp, "chunks": chunks, "tp": 2, "dp": 2,
+        "hidden": hidden, "seq": seq, "iters": iters,
+        "host_step_ms": round(host_ms, 2),
+        "compiled_step_ms": round(comp_ms, 2),
+        "compiled_vs_host": ratio,
+        "compiled_overlap_vs_host": ratio,  # the bench_gate leg key
+        "compiled_recompiles": int(ceng.compile_count() - n_compiles),
+        "flash_interpret": not on_tpu,
+        "note": ("tp2 x dp2 x pp2 with ring ag/rs matmuls + flash on BOTH "
+                 "engines; <= 1.0 means the fused program keeps its "
+                 "dispatch win with the shard_map kernels running inside "
+                 "it (the de-vmapped stage axis)."),
+    }
+
+
 if __name__ == "__main__":
-    print(json.dumps(run(on_tpu="--tpu" in sys.argv)))
+    _kern = "--kernels" in sys.argv
+    _tpu = "--tpu" in sys.argv
+    print(json.dumps(run_kernels(on_tpu=_tpu) if _kern else run(on_tpu=_tpu)))

@@ -17,10 +17,16 @@ summarized by medians:
   discount) prices that hardware effect for the search.
 * ``overlap_recompiles`` — jit-cache growth of the overlap step across the
   timed steady state, which must be 0 (the ring path must not retrace).
+* ``--schedule-impl compiled`` (round 12) — the same rings-vs-GSPMD A/B
+  measured INSIDE the compiled single-program 1F1B engine on pp2 x tp x dp
+  plans (tp2 x dp2 and tp4 x dp1): the de-vmapped stage axis lets the ring
+  kernels run as stage-stacked shard_maps in the fused program, and this
+  leg prices exactly that composition. Default ``--schedule-impl spmd`` is
+  the original pp=1 GSPMD-step A/B.
 
 Prints one JSON line. Run (virtual CPU mesh):
     JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
-        python tools/tp_overlap_bench.py
+        python tools/tp_overlap_bench.py [--schedule-impl compiled]
 On a real chip (tools/tpu_measure_all.py step): add ``--tpu``.
 """
 
@@ -79,8 +85,44 @@ def _build_step(args, devices, tp_overlap):
     return step, sp, so, batch_shd
 
 
+def _build_compiled_step(args, devices, tp_overlap):
+    """One CompiledPipelineEngine train-step closure for the compiled-mode
+    A/B: the rings (or GSPMD collectives) run INSIDE the fused 1F1B
+    program. Returns (step, recompile_probe) where step(batch) runs one
+    full optimizer step."""
+    import jax
+    import jax.numpy as jnp
+
+    from hetu_galvatron_tpu.models.builder import init_causal_lm
+    from hetu_galvatron_tpu.runtime.compiled_pipeline import (
+        CompiledPipelineEngine,
+    )
+    from hetu_galvatron_tpu.runtime.hybrid_config import (
+        get_hybrid_parallel_config,
+    )
+
+    hpc = get_hybrid_parallel_config(args, 8)
+    eng = CompiledPipelineEngine(args.model, hpc, args.train,
+                                 devices=devices,
+                                 compute_dtype=jnp.bfloat16,
+                                 tp_overlap=tp_overlap)
+    if tp_overlap and not eng.tp_overlap:
+        raise RuntimeError(f"overlap ineligible: {eng.overlap_reason}")
+    params, axes = init_causal_lm(jax.random.key(0), args.model)
+    state = {"sp": eng.split_params(params, axes)}
+    state["so"] = eng.init_opt(state["sp"], axes)
+
+    def step(batch):
+        state["sp"], state["so"], m = eng.train_step(
+            state["sp"], state["so"], batch)
+        return m
+
+    return step, eng.compile_count
+
+
 def run(iters: int = 12, on_tpu: bool = False, tps=(2, 4),
-        hidden: int = 256, seq: int = 256) -> dict:
+        hidden: int = 256, seq: int = 256,
+        schedule_impl: str = "spmd") -> dict:
     import jax
     if not on_tpu:
         jax.config.update("jax_platforms", "cpu")
@@ -90,6 +132,7 @@ def run(iters: int = 12, on_tpu: bool = False, tps=(2, 4),
     from hetu_galvatron_tpu.core.args_schema import CoreArgs
     from hetu_galvatron_tpu.runtime.dataloader import make_batch
 
+    compiled = schedule_impl == "compiled"
     devices = jax.devices()[:8] if on_tpu else jax.devices("cpu")[:8]
     if len(devices) < 8:
         return {"metric": "tp_overlap_ab", "skipped":
@@ -102,6 +145,12 @@ def run(iters: int = 12, on_tpu: bool = False, tps=(2, 4),
         # shapes big enough that the per-chunk matmuls amortize dispatch
         # (at toy widths the ring's extra op count dominates on CPU and the
         # ratio says nothing about the decomposition itself)
+        parallel = {"global_tp_deg": tp, "global_train_batch_size": 8}
+        if compiled:
+            # the fused 1F1B program hosts the rings as stage-stacked
+            # shard_maps: pp2 with the remaining degree as dp
+            parallel.update(pp_deg=2, chunks=2,
+                            pipeline_type="pipedream_flush")
         args = CoreArgs.model_validate({
             "model": {
                 "hidden_size": hidden, "num_hidden_layers": 2,
@@ -115,40 +164,56 @@ def run(iters: int = 12, on_tpu: bool = False, tps=(2, 4),
                 "ffn_hidden_size": 4 * hidden,
                 "use_flash_attn": False,
             },
-            "parallel": {"global_tp_deg": tp,
-                         "global_train_batch_size": 8},
+            "parallel": parallel,
         })
         data = np.random.RandomState(0).randint(
             0, args.model.padded_vocab_size, (8, seq + 1))
-        batch = jax.tree.map(jnp.asarray, make_batch(data))
+        if compiled:
+            host_batch = make_batch(data)
+            g_run, g_probe = _build_compiled_step(args, devices, False)
+            o_run, o_probe = _build_compiled_step(args, devices, True)
+            g_step = lambda: g_run(host_batch)
+            o_step = lambda: o_run(host_batch)
+        else:
+            batch = jax.tree.map(jnp.asarray, make_batch(data))
+            g_fn, g_sp, g_so, g_shd = _build_step(args, devices, False)
+            o_fn, o_sp, o_so, o_shd = _build_step(args, devices, True)
+            gb = jax.device_put(batch, g_shd)
+            ob = jax.device_put(batch, o_shd)
 
-        g_step, g_sp, g_so, g_shd = _build_step(args, devices, False)
-        o_step, o_sp, o_so, o_shd = _build_step(args, devices, True)
-        gb = jax.device_put(batch, g_shd)
-        ob = jax.device_put(batch, o_shd)
+            def g_step(_s=[g_sp, g_so]):
+                _s[0], _s[1], m = g_fn(_s[0], _s[1], gb)
+                return m
+
+            def o_step(_s=[o_sp, o_so]):
+                _s[0], _s[1], m = o_fn(_s[0], _s[1], ob)
+                return m
+
+            g_probe = g_fn._cache_size
+            o_probe = o_fn._cache_size
         # compile + warm both legs outside the timed window
         for _ in range(2):
-            g_sp, g_so, gm = g_step(g_sp, g_so, gb)
-            o_sp, o_so, om = o_step(o_sp, o_so, ob)
+            gm = g_step()
+            om = o_step()
         if abs(float(gm["loss"]) - float(om["loss"])) > 1e-2:
             raise AssertionError(
                 f"overlap leg diverged from gspmd: {float(om['loss'])} vs "
                 f"{float(gm['loss'])}")
-        n_compiles = o_step._cache_size()
+        n_compiles = o_probe()
 
         g_times, o_times = [], []
         for _ in range(iters):
             t0 = time.perf_counter()
-            g_sp, g_so, gm = g_step(g_sp, g_so, gb)
+            gm = g_step()
             jax.block_until_ready(gm["loss"])
             g_times.append(time.perf_counter() - t0)
             t0 = time.perf_counter()
-            o_sp, o_so, om = o_step(o_sp, o_so, ob)
+            om = o_step()
             jax.block_until_ready(om["loss"])
             o_times.append(time.perf_counter() - t0)
         g_ms = float(np.median(g_times)) * 1e3
         o_ms = float(np.median(o_times)) * 1e3
-        recompiles = o_step._cache_size() - n_compiles
+        recompiles = o_probe() - n_compiles
         total_recompiles += recompiles
         pooled_ratios += [o / g for o, g in zip(o_times, g_times)]
         legs[f"tp{tp}"] = {
@@ -161,6 +226,7 @@ def run(iters: int = 12, on_tpu: bool = False, tps=(2, 4),
     return {
         "metric": "tp_overlap_ab",
         "platform": "tpu" if on_tpu else "cpu",
+        "schedule_impl": schedule_impl,
         "iters": iters,
         "legs": legs,
         # headline: median of the POOLED per-iteration interleaved ratios
@@ -177,4 +243,9 @@ def run(iters: int = 12, on_tpu: bool = False, tps=(2, 4),
 
 
 if __name__ == "__main__":
-    print(json.dumps(run(on_tpu="--tpu" in sys.argv)))
+    impl = "spmd"
+    if "--schedule-impl" in sys.argv:
+        impl = sys.argv[sys.argv.index("--schedule-impl") + 1]
+    if impl not in ("spmd", "compiled"):
+        sys.exit(f"unknown --schedule-impl {impl!r} (spmd | compiled)")
+    print(json.dumps(run(on_tpu="--tpu" in sys.argv, schedule_impl=impl)))

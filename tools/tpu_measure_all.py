@@ -134,6 +134,10 @@ def build_bench_candidate():
     tp = _last_json_line(os.path.join(LOG_DIR, "tp_overlap.log"))
     if tp and isinstance(tp.get("overlap_vs_gspmd"), (int, float)):
         base.setdefault("tp_overlap_vs_gspmd", tp["overlap_vs_gspmd"])
+    co = _last_json_line(os.path.join(LOG_DIR, "compiled_overlap.log"))
+    if co and isinstance(co.get("compiled_overlap_vs_host"), (int, float)):
+        base.setdefault("compiled_overlap_vs_host",
+                        co["compiled_overlap_vs_host"])
     path = os.path.join(LOG_DIR, "bench_candidate.json")
     with open(path, "w") as f:
         json.dump({"parsed": base}, f, indent=2)
@@ -207,6 +211,12 @@ def main() -> int:
         ("tp_overlap", [py, os.path.join(ROOT, "tools",
                                          "tp_overlap_bench.py"),
                         "--tpu"], 1800, None),
+        # unified path: host vs compiled 1F1B with the shard_map kernels
+        # (ring tp matmuls + flash) live on BOTH engines — the product of
+        # the dispatch saving and the overlap hiding, in one ratio
+        ("compiled_overlap", [py, os.path.join(ROOT, "tools",
+                                               "pipeline_dispatch_bench.py"),
+                              "--kernels", "--tpu"], 1800, None),
         ("bench", [py, os.path.join(ROOT, "bench.py")], 1100, None),
     ]
     for name, argv, deadline, env_extra in steps:
