@@ -28,6 +28,7 @@ from hetu_galvatron_tpu.utils.strategy import (  # noqa: F401
     DPType,
     EmbeddingLMHeadStrategy,
     LayerStrategy,
+    PlanFormatError,
     config2strategy,
     strategy_list2config,
 )

@@ -1,0 +1,285 @@
+"""Every plan-eligibility predicate, in one pure module.
+
+Before this module existed, the predicates deciding which engine/kernel a
+plan gets were duplicated across six files — ``runtime/compiled_pipeline.py``
+(``unsupported_reason``), ``cli/train_dist.py`` (fallback logging),
+``parallel/spmd.py`` (``tp_overlap_overrides``), ``ops/overlap.py``
+(``layer_overlap_reason``), ``core/cost_model/cost.py``
+(``compiled_expressible`` / ``tp_overlap_expressible``) and the structural
+checks in ``runtime/hybrid_config.py`` — with nothing stopping the cost
+model's gates from silently drifting away from what the runtime actually
+accepts (the drift class PR 7's plan-flip tests could only spot-check).
+All of those now CALL the functions here; the parity test
+(``tests/analysis/test_eligibility_parity.py``) sweeps generated plans
+through both sides to pin the contract.
+
+Discipline: everything here is pure python over plain values (no jax, no
+mesh, no devices) so the plan doctor (``analysis/plan_doctor.py``) can
+evaluate a plan on a machine with no accelerator at all. Reason strings are
+part of the contract — the launcher logs them and the plan doctor prints
+them — so adapters must not rephrase.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence
+
+# ---------------------------------------------------------------------------
+# compiled single-program 1F1B schedule expressibility
+# ---------------------------------------------------------------------------
+
+
+def compiled_schedule_unsupported_reason(
+    *,
+    pp_deg: int,
+    pipeline_type: str,
+    vpp_deg: int = 1,
+    model_type: str = "gpt",
+    num_experts: int = 0,
+    pp_division: Sequence[int] = (),
+    uniform_strategies: bool = True,
+    packed_docs: bool = False,
+) -> Optional[str]:
+    """None when the compiled 1F1B schedule can express a plan with these
+    properties; otherwise the human-readable reason every caller logs.
+
+    This is the CANONICAL predicate: the runtime engine
+    (``CompiledPipelineEngine.unsupported_reason``), the launcher's
+    fallback log, and the cost model's dispatch-waiver gate
+    (:func:`search_compiled_expressible`) all evaluate it — the search must
+    never price the compiled schedule into a plan the runtime will then
+    reject at startup (or vice versa).
+    """
+    if pp_deg < 2:
+        return "pp_deg < 2 routes through the SPMD path"
+    if pipeline_type != "pipedream_flush":
+        return "compiled schedule implements 1F1B (pipedream_flush) only"
+    if vpp_deg > 1:
+        return "interleaved virtual stages (vpp > 1)"
+    if model_type == "t5":
+        return "encoder-decoder (a, b) pair carry"
+    if num_experts:
+        return "MoE layers alternate tree structures across the stack"
+    if len(set(pp_division)) > 1:
+        return (f"heterogeneous per-stage layer counts "
+                f"{list(pp_division)} (stage stacking needs uniformity)")
+    if not uniform_strategies:
+        return "heterogeneous per-layer strategies"
+    if packed_docs:
+        return "packed-document position/segment fields"
+    return None
+
+
+def compiled_unsupported_reason(cfg: Any, hpc: Any,
+                                data: Any = None) -> Optional[str]:
+    """Runtime adapter: (ModelArgs, HybridParallelConfig, DataArgs) ->
+    reason. cp / zigzag-cp plans are expressible since the engine
+    de-vmapped its stage axis (the ring kernel runs inside the fused
+    program as a stage-stacked full-manual shard_map)."""
+    return compiled_schedule_unsupported_reason(
+        pp_deg=hpc.pp_deg,
+        pipeline_type=hpc.pipeline_type,
+        vpp_deg=getattr(hpc, "vpp_deg", 1),
+        model_type=cfg.model_type,
+        num_experts=cfg.num_experts,
+        pp_division=hpc.pp_division,
+        uniform_strategies=all(s == hpc.layers[0] for s in hpc.layers),
+        packed_docs=data is not None and (
+            getattr(data, "reset_position_ids", False)
+            or getattr(data, "reset_attention_mask", False)),
+    )
+
+
+def search_compiled_expressible(
+    schedule_impl: str,
+    pipeline_type: str,
+    partition: Sequence[int],
+    strategy_list: Sequence[Any],
+) -> bool:
+    """Cost-model adapter: can the dispatch-overhead waiver apply to this
+    candidate (``cost_model.cost.pipeline_time_cost``)? The search works in
+    degrees (SearchStrategy), not model configs, so the model-level gates
+    (t5 / MoE / packed docs) are resolved by the caller's layertype setup;
+    here the structural gates must agree with the runtime exactly."""
+    if schedule_impl != "compiled":
+        return False
+    return compiled_schedule_unsupported_reason(
+        pp_deg=max(len(partition), 2),  # pp>1 is the caller's precondition
+        pipeline_type=pipeline_type,
+        pp_division=partition,
+        uniform_strategies=all(s == strategy_list[0] for s in strategy_list),
+    ) is None
+
+
+# ---------------------------------------------------------------------------
+# overlapped-TP (ring ag/rs matmul) per-layer eligibility
+# ---------------------------------------------------------------------------
+
+# shared fallback-reason strings: the launcher's plan-level logging, the
+# actual dispatch (parallel/spmd.py tp_overlap_overrides) and the plan
+# doctor must all report the SAME reasons
+T5_REASON = "t5 encoder-decoder layers keep the GSPMD projection path"
+MOE_REASON = ("MoE layer: expert matmuls route through the ep/etp "
+              "dispatcher, not the dense projections")
+
+
+def overlap_unsupported_reason(
+    cfg: Any,
+    *,
+    ulysses: bool,
+    has_cp: bool,
+    tp: int,
+    seq_len: Optional[int] = None,
+) -> Optional[str]:
+    """Why one layer cannot run the decomposed ring-overlap matmuls
+    (None = eligible). ``cfg`` supplies the concrete widths (seq_length,
+    head_dim, heads, ffn_dim, hidden_act); the parallel degrees come in as
+    plain values so both the mesh-lowered runtime and the degree-only
+    search/doctor views evaluate the same predicate."""
+    if ulysses:
+        return ("ulysses layer: the tp axes carry sequence (all-to-all "
+                "attention), not weight shards")
+    if tp <= 1:
+        return "tp == 1 (no tensor-parallel collectives to overlap)"
+    if has_cp:
+        return ("cp layer: the boundary activation is sequence-sharded "
+                "over cp, not tp (ring attention owns the sequence axis)")
+    seq = seq_len if seq_len is not None else cfg.seq_length
+    if seq % tp:
+        return (f"tp {tp} does not divide the sequence length {seq} into "
+                "ring chunks")
+    hd, nq, nkv = cfg.head_dim, cfg.num_attention_heads, cfg.kv_heads
+    if ((nq + 2 * nkv) * hd) % tp or (nq * hd) % tp:
+        return f"tp {tp} does not divide the qkv/out projection widths"
+    f = cfg.ffn_dim
+    gated = cfg.hidden_act in ("swiglu", "geglu")
+    if f % tp or (gated and (2 * f) % tp):
+        return f"tp {tp} does not divide the MLP width {f}"
+    return None
+
+
+def layer_overlap_reason(cfg: Any, sharding: Any, tp: int,
+                         seq_len: Optional[int] = None) -> Optional[str]:
+    """Mesh-lowered adapter (the historical ``ops/overlap.py`` entry
+    point): reads ulysses/cp off a :class:`~hetu_galvatron_tpu.runtime.
+    mesh.LayerSharding`-shaped object."""
+    return overlap_unsupported_reason(
+        cfg,
+        ulysses=bool(getattr(sharding, "ulysses", False)),
+        has_cp=bool(getattr(sharding, "cp_axes", ())),
+        tp=tp,
+        seq_len=seq_len,
+    )
+
+
+def plan_overlap_reasons(cfg: Any, hpc: Any) -> List:
+    """Per-layer eligibility from the PLAN alone (``hpc.layers``
+    LayerStrategy rows; no mesh needed) — what
+    ``parallel.spmd.tp_overlap_overrides`` will dispatch. Returns
+    [(layer index, reason-or-None)]; reason None = the layer runs
+    overlapped."""
+    from hetu_galvatron_tpu.models.moe import is_moe_layer
+
+    out = []
+    for i, s in enumerate(hpc.layers):
+        if cfg.model_type == "t5":
+            out.append((i, T5_REASON))
+            continue
+        if is_moe_layer(cfg, i):
+            out.append((i, MOE_REASON))
+            continue
+        out.append((i, overlap_unsupported_reason(
+            cfg, ulysses=s.sp, has_cp=s.cp_size > 1, tp=s.tp_size)))
+    return out
+
+
+def search_tp_overlap_expressible(tp: int, cp: int, enabled: bool) -> bool:
+    """Cost-model adapter (``cost_model.cost.tp_overlap_expressible``):
+    can this candidate layer earn the ring-overlap discount? Megatron TP
+    only (Ulysses has tp == 1 here) and no cp — the degree-level half of
+    :func:`overlap_unsupported_reason` (the search works in degrees, not
+    concrete widths, so the divisibility checks are resolved at plan-doctor
+    / runtime time)."""
+    return enabled and tp > 1 and cp == 1
+
+
+# ---------------------------------------------------------------------------
+# plan structure (divisibility / stage sums / axis products)
+# ---------------------------------------------------------------------------
+
+
+def pp_world_reason(world_size: int, pp_deg: int) -> Optional[str]:
+    if pp_deg >= 1 and world_size % pp_deg:
+        return f"world {world_size} % pp {pp_deg} != 0"
+    return None
+
+
+def stage_degree_reason(world_size: int, pp_deg: int, tp: int,
+                        cp: int) -> Optional[str]:
+    stage = world_size // max(pp_deg, 1)
+    if stage % (tp * cp):
+        return f"stage world {stage} not divisible by tp{tp}*cp{cp}"
+    return None
+
+
+def vpp_layers_reason(pp_deg: int, vpp_deg: int,
+                      n_layers: int) -> Optional[str]:
+    if pp_deg * vpp_deg > n_layers:
+        return (f"pp_deg {pp_deg} * virtual_pp_deg {vpp_deg} exceeds the "
+                f"layer count {n_layers}")
+    return None
+
+
+def pp_division_sum_reason(pp_division: Sequence[int],
+                           n_layers: int) -> Optional[str]:
+    if sum(pp_division) != n_layers:
+        return f"pp_division {list(pp_division)} != layer count {n_layers}"
+    return None
+
+
+def pp_division_len_reason(pp_division: Sequence[int], pp_deg: int,
+                           vpp_deg: int) -> Optional[str]:
+    if len(pp_division) != pp_deg * vpp_deg:
+        return (f"pp_division has {len(pp_division)} entries, expected "
+                f"pp_deg {pp_deg} * vpp_deg {vpp_deg} = {pp_deg * vpp_deg}")
+    return None
+
+
+def batch_grain_reason(global_bsz: int, world_size: int, pp_deg: int,
+                       layers: Sequence[Any], vocab: Any) -> Optional[str]:
+    """The batch must divide by the largest dp group any layer carves out
+    (world // pp // min_tp // min_cp)."""
+    min_tp = min(min(s.tp_size for s in layers), vocab.vtp)
+    min_cp = min(min(s.cp_size for s in layers), vocab.vcp)
+    grain = world_size // max(pp_deg, 1) // min_tp // min_cp
+    if global_bsz % max(grain, 1):
+        return (f"global_bsz {global_bsz} must be a multiple of "
+                f"world//pp//min_tp//min_cp = {grain}")
+    return None
+
+
+def plan_structure_reasons(
+    *,
+    layers: Sequence[Any],
+    vocab: Any,
+    pp_deg: int,
+    vpp_deg: int,
+    pp_division: Sequence[int],
+    n_layers: int,
+    world_size: int,
+    global_bsz: int,
+) -> List[str]:
+    """Every structural problem with a resolved plan, in the order
+    ``runtime/hybrid_config.py`` raises them (it raises on the FIRST;
+    the plan doctor reports them all)."""
+    out: List[str] = []
+    for r in (
+        pp_world_reason(world_size, pp_deg),
+        vpp_layers_reason(pp_deg, vpp_deg, n_layers),
+        pp_division_sum_reason(pp_division, n_layers),
+        pp_division_len_reason(pp_division, pp_deg, vpp_deg),
+        batch_grain_reason(global_bsz, world_size, pp_deg, layers, vocab),
+    ):
+        if r is not None:
+            out.append(r)
+    return out

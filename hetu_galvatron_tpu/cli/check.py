@@ -1,0 +1,259 @@
+"""Static checks CLI: ``python -m hetu_galvatron_tpu.cli.check``.
+
+Run the three-pass static analysis suite (``analysis/``) on CPU — no TPU,
+no training step — BEFORE burning accelerator time:
+
+* ``--plan plan.json [--model cfg.yaml] [--world N]`` — Pass 1, the plan
+  doctor: per-layer engine/kernel report with actionable errors for
+  malformed plans.
+* ``--census`` — Pass 2: trace the compiled 1F1B step for the committed
+  acceptance plan plus the serving prefill/decode programs, census their
+  collectives, verify named_scope marker coverage and the exact-count
+  cross-check against the plan arithmetic
+  (``telemetry.plan_collective_counts``).
+* ``--lint [--update-baseline]`` — Pass 3: the AST lint with the
+  committed baseline (``analysis/lint_baseline.json``); the gate is zero
+  NEW findings.
+* ``--all`` — every pass: the plan doctor over the committed example
+  plans, the census smoke, and the lint gate. This is the CI step
+  (``__graft_entry__.dryrun_multichip`` runs it and tier-1 asserts it
+  green).
+
+Exit code 0 = clean, 1 = findings/errors, 2 = usage.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+import sys
+from typing import List, Optional
+
+EXAMPLE_PLAN_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "profiles", "example_plans")
+ACCEPTANCE_PLAN = os.path.join(
+    EXAMPLE_PLAN_DIR, "galvatron_config_acceptance_tp2dp2pp2.json")
+
+
+def _force_cpu_devices(n: int = 8) -> None:
+    """Static analysis must run on CPU with no accelerator: force the
+    virtual host platform BEFORE jax initializes (a no-op when the test
+    harness already did). APPEND to any pre-existing XLA_FLAGS — a host
+    exporting e.g. --xla_dump_to must not silently lose the device-count
+    flag (the tools/pipeline_dispatch_bench.py pattern)."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (flags + " " if flags else "") + \
+            f"--xla_force_host_platform_device_count={n}"
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def _example_model():
+    """The tiny 4-layer model the committed example plans were written
+    for (the dryrun/test shape: every kernel family exercisable on the
+    8-device virtual mesh)."""
+    from hetu_galvatron_tpu.core.args_schema import CoreArgs
+
+    return CoreArgs.model_validate({
+        "model": {
+            "hidden_size": 64, "num_hidden_layers": 4,
+            "num_attention_heads": 4, "vocab_size": 256,
+            "seq_length": 16, "max_position_embeddings": 32,
+            "hidden_act": "swiglu", "normalization": "rmsnorm",
+            "position_embedding_type": "rope", "tie_word_embeddings": False,
+            "add_bias_linear": False, "add_qkv_bias": False,
+            "make_vocab_size_divisible_by": 1, "ffn_hidden_size": 128,
+        },
+    })
+
+
+def _load_model(model_path: Optional[str]):
+    """--model: a train_dist-style YAML, or None for the example model."""
+    if model_path is None:
+        return _example_model().model
+    from hetu_galvatron_tpu.core.arguments import args_from_cli
+    from hetu_galvatron_tpu.utils.hf_config_adapter import (
+        resolve_model_config,
+    )
+
+    args = args_from_cli([model_path], mode="train_dist")
+    return resolve_model_config(args).model
+
+
+def run_doctor(plan: str, model_path: Optional[str], world: Optional[int],
+               *, schedule_impl: str = "compiled",
+               tp_overlap: bool = True) -> int:
+    from hetu_galvatron_tpu.analysis.plan_doctor import diagnose_plan
+
+    cfg = _load_model(model_path)
+    report = diagnose_plan(plan, cfg, world, schedule_impl=schedule_impl,
+                           tp_overlap=tp_overlap)
+    report.render()
+    return 0 if report.ok else 1
+
+
+def run_census(verbose: bool = True) -> int:
+    """Census smoke on the acceptance plan (compiled 1F1B step, exact
+    count cross-check) + the serving prefill/decode programs."""
+    _force_cpu_devices()
+    from hetu_galvatron_tpu.analysis.census import (
+        census_compiled_step,
+        census_serving_programs,
+        check_census,
+    )
+    from hetu_galvatron_tpu.core.args_schema import ServingArgs
+    from hetu_galvatron_tpu.observability.telemetry import (
+        plan_collective_counts,
+    )
+    from hetu_galvatron_tpu.runtime.hybrid_config import (
+        get_hybrid_parallel_config,
+    )
+
+    args = _example_model()
+    args.parallel.config_mode = "json"
+    args.parallel.galvatron_config_path = ACCEPTANCE_PLAN
+    hpc = get_hybrid_parallel_config(args, 8)
+    problems: List[str] = []
+
+    c = census_compiled_step(args.model, hpc, args.train, tp_overlap=True)
+    predicted = plan_collective_counts(hpc, args.model, tp_overlap=True)
+    if verbose:
+        print(f"census: compiled 1F1B step "
+              f"[{hpc.describe()}] -> {c.counts} "
+              f"(markers {c.permutes_by_marker})")
+        print(f"census: plan arithmetic predicts {predicted}")
+    if not c.donated_args:
+        problems.append("compiled step: no donated arguments — the fused "
+                        "optimizer step must donate (params, opt) or live "
+                        "memory doubles")
+    problems += check_census(c, predicted, program="compiled_step")
+    for n in c.notes:
+        print(f"census note: {n}")
+
+    # serving prefill + decode: single-device tiny engine; the check is
+    # marker coverage + no host callbacks in the token-latency path
+    serving = ServingArgs(max_batch_size=2, kv_block_size=8,
+                          max_seq_len=32, num_kv_blocks=10)
+    for name, sc in census_serving_programs(
+            args.model, serving=serving).items():
+        if verbose:
+            print(f"census: serving {name} -> {sc.counts or '{}'}")
+        problems += check_census(sc, program=f"serving {name}")
+
+    for p in problems:
+        print(f"CENSUS FAILURE: {p}")
+    print(f"census: {'OK' if not problems else 'FAILED'}")
+    return 0 if not problems else 1
+
+
+def run_lint(update_baseline: bool = False, verbose: bool = True) -> int:
+    from hetu_galvatron_tpu.analysis.lint import (
+        lint_package,
+        load_baseline,
+        new_findings,
+        save_baseline,
+        stale_baseline,
+    )
+
+    findings = lint_package()
+    baseline = load_baseline()
+    if update_baseline:
+        save_baseline(findings, keep=baseline)
+        print(f"lint: baseline rewritten with {len(findings)} finding(s); "
+              "fill in any 'TODO: justify or fix' entries")
+        return 0
+    new = new_findings(findings, baseline)
+    stale = stale_baseline(findings, baseline)
+    if verbose:
+        print(f"lint: {len(findings)} finding(s), "
+              f"{len(findings) - len(new)} baselined, {len(new)} new")
+    for f in new:
+        print(f"LINT: {f}")
+    if stale:
+        # stale entries FAIL the gate too (same contract as the tier-1
+        # test): the baseline must only ever describe live findings
+        print(f"lint: {len(stale)} baselined finding(s) no longer occur — "
+              "prune them with --update-baseline:")
+        for k in stale[:10]:
+            print(f"  stale: {k}")
+    if new:
+        verdict = ("FAILED (new findings — fix them or baseline with a "
+                   "justification via --update-baseline)")
+    elif stale:
+        verdict = "FAILED (stale baseline — prune with --update-baseline)"
+    else:
+        verdict = "OK"
+    print(f"lint: {verdict}")
+    return 0 if not new and not stale else 1
+
+
+def run_all() -> int:
+    """The CI gate: plan doctor over every committed example plan, the
+    census smoke, the lint baseline gate."""
+    _force_cpu_devices()
+    rc = 0
+    for plan in sorted(glob.glob(os.path.join(EXAMPLE_PLAN_DIR, "*.json"))):
+        rc |= run_doctor(plan, None, 8)
+        print()
+    rc |= run_census()
+    print()
+    rc |= run_lint()
+    print()
+    print(f"check --all: {'OK' if rc == 0 else 'FAILED'}")
+    return rc
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m hetu_galvatron_tpu.cli.check",
+        description="static analysis suite: plan doctor, jaxpr collective "
+                    "census, AST lint")
+    p.add_argument("--plan", help="plan JSON to diagnose (Pass 1)")
+    p.add_argument("--model", help="train_dist-style YAML config for the "
+                   "model the plan targets (default: the tiny example "
+                   "model the committed plans were written for)")
+    p.add_argument("--world", type=int, default=None,
+                   help="world size to validate the plan against "
+                   "(default: the smallest world the plan fits)")
+    p.add_argument("--schedule-impl", choices=("compiled", "host"),
+                   default="compiled", help="launcher schedule impl the "
+                   "doctor should predict for (default compiled)")
+    p.add_argument("--no-tp-overlap", action="store_true",
+                   help="doctor: assume tp_overlap.enable is off")
+    p.add_argument("--census", action="store_true",
+                   help="run the jaxpr collective census (Pass 2)")
+    p.add_argument("--lint", action="store_true",
+                   help="run the AST lint against the baseline (Pass 3)")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="rewrite the lint baseline from current findings, "
+                   "preserving existing justifications")
+    p.add_argument("--all", action="store_true",
+                   help="every pass on the committed examples (the CI "
+                   "step)")
+    a = p.parse_args(argv)
+
+    if a.all:
+        return run_all()
+    rc = None
+    if a.plan:
+        _force_cpu_devices()
+        rc = run_doctor(a.plan, a.model, a.world,
+                        schedule_impl=a.schedule_impl,
+                        tp_overlap=not a.no_tp_overlap)
+    if a.census:
+        rc = (rc or 0) | run_census()
+    if a.lint or a.update_baseline:
+        rc = (rc or 0) | run_lint(update_baseline=a.update_baseline)
+    if rc is None:
+        p.print_help()
+        return 2
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -98,7 +98,9 @@ def train(args) -> Dict[str, Any]:
     tp_overlap_on = args.tp_overlap.enable
     overlapped_layers: list = []
     if tp_overlap_on:
-        from hetu_galvatron_tpu.ops.overlap import plan_overlap_reasons
+        from hetu_galvatron_tpu.analysis.eligibility import (
+            plan_overlap_reasons,
+        )
 
         reasons = plan_overlap_reasons(cfg, hpc)
         overlapped_layers = [i for i, r in reasons if r is None]

@@ -28,6 +28,10 @@ from typing import (
 
 import numpy as np
 
+from hetu_galvatron_tpu.analysis.eligibility import (
+    search_compiled_expressible,
+    search_tp_overlap_expressible,
+)
 from hetu_galvatron_tpu.utils.strategy import DPType
 
 if TYPE_CHECKING:  # typing only — a runtime import would be circular
@@ -136,13 +140,15 @@ def _zero_ratios(chunks: int, mixed_precision: bool, async_grad_reduce: bool):
 
 def tp_overlap_expressible(s: "SearchStrategy", ctx: CostContext) -> bool:
     """Can this layer run the decomposed ring-overlap matmuls
-    (ops/overlap.layer_overlap_reason, the shape checks aside — the search
-    works in degrees, not concrete widths)? Megatron TP only (Ulysses has
-    s.tp == 1 here) and no cp. Since the compiled 1F1B engine de-vmapped
-    its stage axis (round 12), the rings run INSIDE the fused program too —
-    pp > 1 under ``schedule_impl="compiled"`` keeps the discount, so the
-    overlap hiding and the dispatch waiver COMPOSE on deep-pp plans."""
-    return ctx.tp_overlap and s.tp > 1 and s.cp == 1
+    (eligibility.overlap_unsupported_reason, the shape checks aside — the
+    search works in degrees, not concrete widths)? Megatron TP only
+    (Ulysses has s.tp == 1 here) and no cp. Since the compiled 1F1B engine
+    de-vmapped its stage axis (round 12), the rings run INSIDE the fused
+    program too — pp > 1 under ``schedule_impl="compiled"`` keeps the
+    discount, so the overlap hiding and the dispatch waiver COMPOSE on
+    deep-pp plans. The predicate is shared with the runtime dispatch via
+    ``analysis/eligibility.py`` (the parity test pins it)."""
+    return search_tp_overlap_expressible(s.tp, s.cp, ctx.tp_overlap)
 
 
 def _overlap_window(comm: float, comp: float, coe: float) -> float:
@@ -696,11 +702,8 @@ def pipeline_time_cost(
     # test_dispatch_cost.py pins a plan flip that needs both).
     ctx0 = contexts[0]
     if pp_size > 1 and ctx0.dispatch_us:
-        compiled_expressible = (
-            ctx0.schedule_impl == "compiled"
-            and ctx0.pipeline_type == "pipedream_flush"
-            and len(set(partition)) == 1
-            and all(s == strategy_list[0] for s in strategy_list))
-        if not compiled_expressible:
+        if not search_compiled_expressible(
+                ctx0.schedule_impl, ctx0.pipeline_type, partition,
+                strategy_list):
             result += ctx0.dispatch_us * 1e-6 * 2 * pp_size * chunks
     return result

@@ -671,5 +671,11 @@ class SearchEngine:
             name += "_[%s_off]" % "_".join(off)
         path = os.path.join(a.output_config_path or "configs",
                             name + ".json")
-        write_json(cfg, path)
+        # validating writer (utils/strategy.py): the plan must round-trip
+        # through config2strategy + per-layer LayerStrategy.validate at the
+        # searcher's world size BEFORE it lands on disk — a serialization
+        # bug surfaces here, not on the TPU fleet at load time
+        from hetu_galvatron_tpu.utils.strategy import save_strategy_config
+
+        save_strategy_config(path, cfg, world_size=self.world_size)
         return path

@@ -310,6 +310,62 @@ def plan_comm_volume(
     return out
 
 
+def plan_collective_counts(
+    hpc,
+    model,
+    *,
+    num_microbatches: Optional[int] = None,
+    tp_overlap: bool = True,
+) -> Dict[str, int]:
+    """Predicted EXECUTED explicit-collective counts for the compiled
+    single-program 1F1B step — the count-side companion of
+    :func:`plan_comm_volume` (which predicts megabytes), consumed by the
+    static jaxpr census (``analysis/census.py``).
+
+    Only the EXPLICIT collectives are predicted: the shard_map kernels'
+    ``lax.ppermute`` rings. GSPMD-inserted collectives (dp gradient
+    all-reduce, ZeRO gathers) appear at partition time, not in the jaxpr.
+    Counts are per one traced step program, INCLUDING the masked bubble
+    ticks the lockstep schedule executes (T = m + 2(pp-1) ticks): volumes
+    in :func:`plan_comm_volume` scale with the m real microbatches, so the
+    count-derived tp volume equals the MB prediction times T/m.
+
+    Arithmetic (mirrors ops/overlap.py + runtime/compiled_pipeline.py):
+    per decoder-layer slot and tick, the forward unit runs 4 rings (qkv,
+    out-proj, fc1 — the gated pair counts as ONE rotation — and fc2); the
+    backward unit recomputes the stage forward from its stored input
+    (``jax.vjp``) and runs the 4 transposed rings, so 8 rings, plus
+    another 4-ring forward recompute under per-layer remat. Each ring is
+    ``tp - 1`` ppermute hops. The stage rotations add 2 ppermutes per tick
+    (activations forward, cotangents backward).
+
+    Raises ValueError for plan shapes the prediction does not model
+    (non-uniform strategies, Ulysses/cp layers — the census still counts
+    those programs, there is just no exact-count prediction to pin them
+    to).
+    """
+    s = hpc.layers[0]
+    if any(l != s for l in hpc.layers):
+        raise ValueError("collective-count prediction needs a uniform "
+                         "per-layer strategy (the compiled engine's gate)")
+    if s.sp or s.cp_size > 1:
+        raise ValueError("collective-count prediction models Megatron-TP "
+                         "plans only (no Ulysses / cp ring layers)")
+    m = max(num_microbatches if num_microbatches is not None
+            else hpc.chunks, 1)
+    pp = max(hpc.pp_deg, 1)
+    T = m + 2 * (pp - 1)
+    lps = hpc.pp_division[0] if hpc.pp_division else len(hpc.layers)
+    out: Dict[str, int] = {}
+    if pp > 1:
+        out["ppermute_pp"] = 2 * T
+    tp = s.tp_size
+    if tp_overlap and tp > 1:
+        rings_per_tick = 4 + 8 + (4 if s.checkpoint else 0)
+        out["ppermute_tp"] = T * lps * rings_per_tick * (tp - 1)
+    return out
+
+
 def plan_tp_overlap_hidden_frac(hpc, model, overlapped: Sequence[int],
                                 mixed_precision: bool = True) -> float:
     """Predicted fraction of the plan's TP collective traffic hidden under

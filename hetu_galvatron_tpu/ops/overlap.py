@@ -354,64 +354,16 @@ def make_matmul_rs(mesh: Mesh, dp_axes: Tuple[str, ...],
 # per-layer eligibility + dispatch
 # ---------------------------------------------------------------------------
 
-
-# shared fallback-reason strings: the launcher's plan-level logging
-# (plan_overlap_reasons) and the actual dispatch (parallel/spmd.py
-# tp_overlap_overrides) must report the SAME reasons
-T5_REASON = "t5 encoder-decoder layers keep the GSPMD projection path"
-MOE_REASON = ("MoE layer: expert matmuls route through the ep/etp "
-              "dispatcher, not the dense projections")
-
-
-def layer_overlap_reason(cfg: Any, sharding: Any, tp: int,
-                         seq_len: Optional[int] = None) -> Optional[str]:
-    """Why this layer cannot run the decomposed overlap path (None =
-    eligible). Mirrors ``CompiledPipelineEngine.unsupported_reason`` style:
-    the caller logs the reason and falls back to GSPMD."""
-    if getattr(sharding, "ulysses", False):
-        return ("ulysses layer: the tp axes carry sequence (all-to-all "
-                "attention), not weight shards")
-    if tp <= 1:
-        return "tp == 1 (no tensor-parallel collectives to overlap)"
-    if getattr(sharding, "cp_axes", ()):
-        return ("cp layer: the boundary activation is sequence-sharded "
-                "over cp, not tp (ring attention owns the sequence axis)")
-    seq = seq_len if seq_len is not None else cfg.seq_length
-    if seq % tp:
-        return (f"tp {tp} does not divide the sequence length {seq} into "
-                "ring chunks")
-    hd, nq, nkv = cfg.head_dim, cfg.num_attention_heads, cfg.kv_heads
-    if ((nq + 2 * nkv) * hd) % tp or (nq * hd) % tp:
-        return f"tp {tp} does not divide the qkv/out projection widths"
-    f = cfg.ffn_dim
-    gated = cfg.hidden_act in ("swiglu", "geglu")
-    if f % tp or (gated and (2 * f) % tp):
-        return f"tp {tp} does not divide the MLP width {f}"
-    return None
-
-
-def plan_overlap_reasons(cfg: Any, hpc: Any) -> list:
-    """Per-layer eligibility from the PLAN alone (``hpc.layers``
-    LayerStrategy rows; no mesh needed) — the launcher's logging/telemetry
-    view of what :func:`~hetu_galvatron_tpu.parallel.spmd.
-    tp_overlap_overrides` will dispatch. Returns [(layer index,
-    reason-or-None)]; reason None = the layer runs overlapped."""
-    from types import SimpleNamespace
-
-    from hetu_galvatron_tpu.models.moe import is_moe_layer
-
-    out = []
-    for i, s in enumerate(hpc.layers):
-        if cfg.model_type == "t5":
-            out.append((i, T5_REASON))
-            continue
-        if is_moe_layer(cfg, i):
-            out.append((i, MOE_REASON))
-            continue
-        shim = SimpleNamespace(ulysses=s.sp,
-                               cp_axes=("cp",) if s.cp_size > 1 else ())
-        out.append((i, layer_overlap_reason(cfg, shim, s.tp_size)))
-    return out
+# The eligibility predicates and fallback-reason strings live in
+# analysis/eligibility.py (shared with the launcher's logging, the cost
+# model's discount gate and the plan doctor); re-exported here because this
+# module is their historical home and the kernel dispatch reads them.
+from hetu_galvatron_tpu.analysis.eligibility import (  # noqa: E402,F401
+    MOE_REASON,
+    T5_REASON,
+    layer_overlap_reason,
+    plan_overlap_reasons,
+)
 
 
 def make_layer_matmuls(mesh: Mesh, dp_axes: Tuple[str, ...],

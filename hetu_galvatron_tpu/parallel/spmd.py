@@ -195,13 +195,13 @@ def tp_overlap_overrides(
     everything else stays on GSPMD. Returns (overrides, fallbacks) where
     ``fallbacks`` lists (layer index, unsupported_reason) for layers the
     caller asked to overlap but could not — the launcher logs them."""
-    from hetu_galvatron_tpu.models.moe import is_moe_layer
-    from hetu_galvatron_tpu.ops.overlap import (
+    from hetu_galvatron_tpu.analysis.eligibility import (
         MOE_REASON,
         T5_REASON,
         layer_overlap_reason,
-        make_layer_matmuls,
     )
+    from hetu_galvatron_tpu.models.moe import is_moe_layer
+    from hetu_galvatron_tpu.ops.overlap import make_layer_matmuls
     from hetu_galvatron_tpu.runtime.mesh import axes_size
 
     moe_of = is_moe_layer_fn or is_moe_layer

@@ -151,31 +151,18 @@ class CompiledPipelineEngine:
                            data: Any = None) -> Optional[str]:
         """None when the compiled schedule can express this plan; otherwise
         a human-readable reason the launcher logs before falling back to the
-        host engine."""
-        if hpc.pp_deg < 2:
-            return "pp_deg < 2 routes through the SPMD path"
-        if hpc.pipeline_type != "pipedream_flush":
-            return "compiled schedule implements 1F1B (pipedream_flush) only"
-        if getattr(hpc, "vpp_deg", 1) > 1:
-            return "interleaved virtual stages (vpp > 1)"
-        if cfg.model_type == "t5":
-            return "encoder-decoder (a, b) pair carry"
-        if cfg.num_experts:
-            return "MoE layers alternate tree structures across the stack"
-        if len(set(hpc.pp_division)) != 1:
-            return (f"heterogeneous per-stage layer counts "
-                    f"{hpc.pp_division} (stage stacking needs uniformity)")
-        if any(s != hpc.layers[0] for s in hpc.layers):
-            return "heterogeneous per-layer strategies"
-        # cp / zigzag-cp plans are EXPRESSIBLE since the stage axis was
-        # de-vmapped: the ring-attention kernel runs inside the program as a
-        # stage-stacked full-manual shard_map (stage_axis="pp"), like the
-        # overlapped-TP ring matmuls and the flash kernel
-        if data is not None and (getattr(data, "reset_position_ids", False)
-                                 or getattr(data, "reset_attention_mask",
-                                            False)):
-            return "packed-document position/segment fields"
-        return None
+        host engine. The predicate itself lives in
+        ``analysis/eligibility.py`` — shared with the cost model's
+        dispatch-waiver gate and the plan doctor, so the three can never
+        drift. (cp / zigzag-cp plans are expressible since the stage axis
+        was de-vmapped: the ring-attention kernel runs inside the program
+        as a stage-stacked full-manual shard_map, like the overlapped-TP
+        ring matmuls and the flash kernel.)"""
+        from hetu_galvatron_tpu.analysis.eligibility import (
+            compiled_unsupported_reason,
+        )
+
+        return compiled_unsupported_reason(cfg, hpc, data)
 
     def __init__(
         self,
@@ -906,6 +893,30 @@ class CompiledPipelineEngine:
         if m not in self._eval_jits:
             self._eval_jits[m] = self._build_eval(m)
         return {"loss": float(self._eval_jits[m](sp, batch))}
+
+    def step_jaxpr(self, sp: Params, opt: Any, batch: Dict[str, np.ndarray],
+                   num_microbatches: Optional[int] = None):
+        """ClosedJaxpr of the fused step program — the static-analysis hook
+        (``analysis/census.py``). Tracing never executes and never consumes
+        donated buffers, so this is safe before (or instead of) any real
+        step; the traced fn is cached in the step-jit cache, so a later
+        ``train_step`` at the same microbatch count reuses it."""
+        m = self._resolve_m(num_microbatches)
+        batch = dict(batch)
+        step_rng = batch.pop("dropout_rng", None)
+        if self._use_dropout and step_rng is None:
+            raise ValueError(
+                "cfg enables dropout but the batch has no 'dropout_rng' "
+                "key; train_loop/cli add it automatically — manual callers "
+                "must pass one per step")
+        if batch["tokens"].ndim == 2:
+            batch = self.put_batch(batch, m)
+        if m not in self._step_jits:
+            self._step_jits[m] = self._build_step(m, self._use_dropout)
+        fn = self._step_jits[m]
+        if self._use_dropout:
+            return jax.make_jaxpr(fn)(sp, opt, batch, step_rng)
+        return jax.make_jaxpr(fn)(sp, opt, batch)
 
     def compile_count(self) -> int:
         """Total compiled executables across the engine's jit caches — the

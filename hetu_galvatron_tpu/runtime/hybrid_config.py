@@ -17,6 +17,7 @@ from typing import List, Optional
 
 import math
 
+from hetu_galvatron_tpu.analysis import eligibility
 from hetu_galvatron_tpu.core.args_schema import CoreArgs
 from hetu_galvatron_tpu.utils.strategy import (
     DPType,
@@ -142,14 +143,15 @@ def get_hybrid_parallel_config(
         pred_layer_ms = extras.get("predicted_layer_compute_ms")
     else:
         pp_deg = par.pp_deg
-        if world_size % pp_deg:
-            raise ValueError(f"world {world_size} % pp {pp_deg} != 0")
+        r = eligibility.pp_world_reason(world_size, pp_deg)
+        if r:
+            raise ValueError(r)
         stage = world_size // pp_deg
         tp = max(par.global_tp_deg, 1)
         cp = max(par.global_cp_deg, 1)
-        if stage % (tp * cp):
-            raise ValueError(
-                f"stage world {stage} not divisible by tp{tp}*cp{cp}")
+        r = eligibility.stage_degree_reason(world_size, pp_deg, tp, cp)
+        if r:
+            raise ValueError(r)
         default_dp = DPType.from_name(par.default_dp_type)
         dp_type = DPType.ZERO3 if par.sdp else default_dp
         base = LayerStrategy(
@@ -173,25 +175,18 @@ def get_hybrid_parallel_config(
         chunks = get_chunks(args, world_size)
         pred_layer_ms = None
 
-    # guard both branches: a JSON plan with pp*vpp > layers would otherwise
-    # slip through as zero-layer chunks from default_pp_division
-    if pp_deg * vpp > n_layers:
-        raise ValueError(
-            f"pp_deg {pp_deg} * virtual_pp_deg {vpp} exceeds the layer "
-            f"count {n_layers}")
-    if sum(pp_division) != n_layers:
-        raise ValueError(f"pp_division {pp_division} != layer count {n_layers}")
-    if len(pp_division) != pp_deg * vpp:
-        raise ValueError(
-            f"pp_division has {len(pp_division)} entries, expected pp_deg "
-            f"{pp_deg} * vpp_deg {vpp} = {pp_deg * vpp}")
-    min_tp = min(min(s.tp_size for s in layers), vocab.vtp)
-    min_cp = min(min(s.cp_size for s in layers), vocab.vcp)
-    grain = world_size // pp_deg // min_tp // min_cp
-    if global_bsz % max(grain, 1):
-        raise ValueError(
-            f"global_bsz {global_bsz} must be a multiple of "
-            f"world//pp//min_tp//min_cp = {grain}")
+    # guard both branches (a JSON plan with pp*vpp > layers would otherwise
+    # slip through as zero-layer chunks from default_pp_division): the
+    # structural predicates are shared with the plan doctor, which reports
+    # ALL of them instead of raising on the first
+    for reason in (
+            eligibility.vpp_layers_reason(pp_deg, vpp, n_layers),
+            eligibility.pp_division_sum_reason(pp_division, n_layers),
+            eligibility.pp_division_len_reason(pp_division, pp_deg, vpp),
+            eligibility.batch_grain_reason(global_bsz, world_size, pp_deg,
+                                           layers, vocab)):
+        if reason is not None:
+            raise ValueError(reason)
     cp_zigzag = bool(getattr(args.parallel, "cp_zigzag", False))
     if cp_zigzag:
         cps = {s.cp_size for s in layers}

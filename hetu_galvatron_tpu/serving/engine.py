@@ -367,6 +367,30 @@ class ServingEngine:
         fns = [self._decode_fn] + list(self._prefill_fns.values())
         return sum(f._cache_size() for f in fns)
 
+    def step_jaxprs(self, bucket: Optional[int] = None) -> Dict[str, Any]:
+        """ClosedJaxprs of the decode and one prefill-bucket program — the
+        static-analysis hook (``analysis/census.py`` censuses them for
+        host callbacks / unmarked collectives in the token-latency path).
+        Tracing only: nothing executes, the donated pools are untouched,
+        and the traced programs land in the normal jit caches."""
+        if bucket is None:
+            bucket = default_buckets(self.kv.block_size, self._table_len)[0]
+        prefill = self._prefill_for(bucket)
+        table = np.zeros((bucket // self.kv.block_size,), np.int32)
+        pre_args = (self.params, self.kv.pools,
+                    jnp.zeros((1, bucket), jnp.int32), jnp.asarray(table),
+                    1, 0.0, 0)
+        state = self.scheduler.decode_state()
+        dec_args = (self.params, self.kv.pools,
+                    jnp.asarray(state["tokens"], jnp.int32),
+                    jnp.asarray(state["pos"], jnp.int32),
+                    jnp.asarray(state["tables"], jnp.int32),
+                    jnp.asarray(state["temps"], jnp.float32),
+                    jnp.asarray(state["seeds"], jnp.int32),
+                    jnp.asarray(state["gen_idx"], jnp.int32))
+        return {f"prefill_{bucket}": jax.make_jaxpr(prefill)(*pre_args),
+                "decode": jax.make_jaxpr(self._decode_fn)(*dec_args)}
+
     def warmup(self, buckets: Optional[List[int]] = None) -> None:
         """Pre-compile the decode program and the given prefill buckets
         (defaults to every power-of-two bucket up to the pool's

@@ -2,6 +2,7 @@ from hetu_galvatron_tpu.utils.strategy import (  # noqa: F401
     DPType,
     LayerStrategy,
     EmbeddingLMHeadStrategy,
+    PlanFormatError,
     strategy_list2config,
     config2strategy,
     form_strategy,

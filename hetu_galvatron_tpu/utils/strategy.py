@@ -25,6 +25,20 @@ from enum import IntEnum
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 
+class PlanFormatError(ValueError):
+    """A strategy/plan JSON is malformed. Carries the offending ``key``
+    (and optionally the file ``path``) so the plan doctor and the runtime
+    can say WHICH field is broken instead of surfacing a raw
+    KeyError/ValueError traceback from deep inside the parser."""
+
+    def __init__(self, message: str, *, key: Optional[str] = None,
+                 path: Optional[str] = None):
+        prefix = f"plan file {path}: " if path else ""
+        super().__init__(f"{prefix}{message}")
+        self.key = key
+        self.path = path
+
+
 class DPType(IntEnum):
     """Data-parallel flavour for one layer.
 
@@ -232,6 +246,29 @@ def strategy_list2config(
     return cfg
 
 
+def _int_field(cfg: Dict[str, Any], key: str, default: Optional[int] = None
+               ) -> int:
+    """A scalar integer field, with a typed error naming the key on
+    absence or a non-integer value."""
+    if key not in cfg:
+        if default is not None:
+            return default
+        raise PlanFormatError(f"missing required key '{key}'", key=key)
+    v = cfg[key]
+    # int() would silently TRUNCATE a fractional float ("pp_deg": 2.5 ->
+    # 2) — exactly the malformed-degree class this parser exists to catch;
+    # integral floats (2.0, a JSON round-trip artifact) stay accepted
+    if isinstance(v, float) and not v.is_integer():
+        raise PlanFormatError(
+            f"key '{key}' must be an integer, got {v!r}", key=key)
+    try:
+        return int(v)
+    except (TypeError, ValueError):
+        raise PlanFormatError(
+            f"key '{key}' must be an integer, got {v!r}",
+            key=key) from None
+
+
 def config2strategy(
     cfg: Dict[str, Any], world_size: Optional[int] = None
 ) -> Tuple[List[LayerStrategy], EmbeddingLMHeadStrategy, Dict[str, Any]]:
@@ -240,14 +277,46 @@ def config2strategy(
     Returns (layer strategies, vocab strategy, extras) where extras carries the
     non-per-layer fields (global_bsz, chunks, pipeline_type, pp_division).
     Missing optional vectors (cp/ep) default to all-ones, matching the
-    reference's tolerance of older config files.
+    reference's tolerance of older config files. Malformed input (missing
+    keys, non-integer degrees, wrong-length vectors) raises
+    :class:`PlanFormatError` naming the offending key — never a raw
+    KeyError from deep inside the parser.
     """
-    pp_deg = int(cfg["pp_deg"])
-    tps = _dec(cfg["tp_sizes_enc"])
+    if not isinstance(cfg, dict):
+        raise PlanFormatError(
+            f"plan must be a JSON object, got {type(cfg).__name__}")
+    pp_deg = _int_field(cfg, "pp_deg")
+    if pp_deg < 1:
+        raise PlanFormatError(f"pp_deg must be >= 1, got {pp_deg}",
+                              key="pp_deg")
+    if "tp_sizes_enc" not in cfg:
+        raise PlanFormatError("missing required key 'tp_sizes_enc' (the "
+                              "per-layer tp vector defines the layer count)",
+                              key="tp_sizes_enc")
+
+    def dec(key: str) -> List[int]:
+        try:
+            return _dec(cfg[key])
+        except (TypeError, ValueError):
+            raise PlanFormatError(
+                f"key '{key}' must be a comma-separated integer vector, "
+                f"got {cfg[key]!r}", key=key) from None
+
+    tps = dec("tp_sizes_enc")
     n = len(tps)
+    if n == 0:
+        raise PlanFormatError("'tp_sizes_enc' encodes zero layers",
+                              key="tp_sizes_enc")
 
     def vec(key: str, default: int) -> List[int]:
-        return _dec(cfg[key]) if key in cfg else [default] * n
+        if key not in cfg:
+            return [default] * n
+        out = dec(key)
+        if len(out) != n:
+            raise PlanFormatError(
+                f"key '{key}' has {len(out)} entries but 'tp_sizes_enc' "
+                f"defines {n} layers", key=key)
+        return out
 
     cons = vec("tp_consecutive_flags", 1)
     dpt = vec("dp_types_enc", 0)
@@ -256,10 +325,16 @@ def config2strategy(
     eps = vec("ep_sizes_enc", 1)
     # reference runtime key is tp_of_ep_sizes_enc; accept the legacy
     # etp_sizes_enc spelling written by early versions of this repo too
-    etps = (_dec(cfg["tp_of_ep_sizes_enc"]) if "tp_of_ep_sizes_enc" in cfg
+    etps = (vec("tp_of_ep_sizes_enc", 1) if "tp_of_ep_sizes_enc" in cfg
             else vec("etp_sizes_enc", 1))
     ckpt = vec("checkpoint", 0)
-    default_dp = DPType.from_name(cfg.get("default_dp_type", "ddp"))
+    try:
+        default_dp = DPType.from_name(cfg.get("default_dp_type", "ddp"))
+    except (KeyError, AttributeError):
+        raise PlanFormatError(
+            f"default_dp_type must be one of ddp/zero2/zero3, got "
+            f"{cfg.get('default_dp_type')!r}",
+            key="default_dp_type") from None
     strategies = []
     for i in range(n):
         dp_type = DPType.ZERO3 if dpt[i] == 1 else default_dp
@@ -288,20 +363,20 @@ def config2strategy(
             s.validate(world_size)
         strategies.append(s)
     vocab = EmbeddingLMHeadStrategy(
-        vtp=int(cfg.get("vtp", 1)),
-        vsp=bool(int(cfg.get("vsp", 0))),
-        vcp=int(cfg.get("vcp", 1)),
-        embed_sdp=bool(int(cfg.get("embed_sdp", 0))),
+        vtp=_int_field(cfg, "vtp", 1),
+        vsp=bool(_int_field(cfg, "vsp", 0)),
+        vcp=_int_field(cfg, "vcp", 1),
+        embed_sdp=bool(_int_field(cfg, "embed_sdp", 0)),
     )
     extras = {
-        "global_bsz": int(cfg.get("global_bsz", 0)),
-        "chunks": int(cfg.get("chunks", 1)),
+        "global_bsz": _int_field(cfg, "global_bsz", 0),
+        "chunks": _int_field(cfg, "chunks", 1),
         "pipeline_type": cfg.get("pipeline_type", "pipedream_flush"),
-        "pp_division": _dec(cfg["pp_division"]) if "pp_division" in cfg else None,
+        "pp_division": dec("pp_division") if "pp_division" in cfg else None,
         "default_dp_type": default_dp.short,
-        "num_encoder_layers": (int(cfg["num_encoder_layers"])
+        "num_encoder_layers": (_int_field(cfg, "num_encoder_layers")
                                if "num_encoder_layers" in cfg else None),
-        "vpp_deg": int(cfg.get("vpp_deg", 1)),
+        "vpp_deg": _int_field(cfg, "vpp_deg", 1),
         # optional per-layer compute prediction (see strategy_list2config);
         # a hand-edited plan whose vector no longer matches the layer count
         # is dropped rather than mis-attributed to the wrong layers
@@ -313,14 +388,39 @@ def config2strategy(
     return strategies, vocab, extras
 
 
-def save_strategy_config(path: str, cfg: Dict[str, Any]) -> None:
+def save_strategy_config(path: str, cfg: Dict[str, Any],
+                         world_size: Optional[int] = None) -> None:
+    """Write a plan dict, VALIDATING it first: the dict must round-trip
+    through :func:`config2strategy` (which runs ``LayerStrategy.validate``
+    on every layer when ``world_size`` is given) — a writer bug surfaces at
+    save time on the machine that searched the plan, not at load time on
+    the TPU fleet."""
+    config2strategy(cfg, world_size=world_size)
+    import os
+
+    d = os.path.dirname(os.path.abspath(path))
+    if d:
+        os.makedirs(d, exist_ok=True)
     with open(path, "w") as f:
         json.dump(cfg, f, indent=4)
 
 
 def load_strategy_config(path: str) -> Dict[str, Any]:
-    with open(path) as f:
-        return json.load(f)
+    """Read a plan JSON with typed errors: unreadable files and non-object
+    JSON raise :class:`PlanFormatError` carrying the path, so launchers and
+    the plan doctor can report the actual problem instead of a traceback."""
+    try:
+        with open(path) as f:
+            cfg = json.load(f)
+    except OSError as e:
+        raise PlanFormatError(f"cannot read plan: {e}", path=path) from None
+    except json.JSONDecodeError as e:
+        raise PlanFormatError(f"invalid JSON: {e}", path=path) from None
+    if not isinstance(cfg, dict):
+        raise PlanFormatError(
+            f"plan must be a JSON object, got {type(cfg).__name__}",
+            path=path)
+    return cfg
 
 
 # ---------------------------------------------------------------------------

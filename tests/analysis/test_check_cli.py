@@ -1,0 +1,79 @@
+"""The CI gate: ``cli/check.py --all`` must be green at HEAD.
+
+The tier-1 test runs the passes in-process (cheap: tracing only); the
+subprocess test pins the CLI contract itself (exit codes, a standalone
+process forcing the CPU platform) and rides the slow tier.
+"""
+
+import subprocess
+import sys
+
+import pytest
+
+from hetu_galvatron_tpu.cli import check as check_cli
+
+pytestmark = [pytest.mark.staticcheck, pytest.mark.core]
+
+
+def test_check_all_is_green_at_head(capsys):
+    """Every pass — plan doctor over the committed example plans, the
+    census with the exact-count cross-check, the lint baseline gate —
+    exits clean at HEAD."""
+    rc = check_cli.run_all()
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "plan doctor: OK" in out
+    assert "census: OK" in out
+    assert "lint: OK" in out
+    assert "check --all: OK" in out
+
+
+def test_check_doctor_flags_a_corrupted_plan(tmp_path, capsys):
+    """A deliberately corrupted committed plan fails Pass 1 with a
+    diagnostic naming the broken key, exit code 1."""
+    import json
+
+    with open(check_cli.ACCEPTANCE_PLAN) as f:
+        plan = json.load(f)
+    plan["cp_sizes_enc"] = "1,1"  # wrong-length vector
+    p = tmp_path / "corrupt.json"
+    p.write_text(json.dumps(plan))
+    rc = check_cli.main(["--plan", str(p), "--world", "8"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "cp_sizes_enc" in out
+    assert "Traceback" not in out
+
+
+def test_check_usage_exit_code():
+    assert check_cli.main([]) == 2
+
+
+def test_stale_baseline_fails_the_lint_gate(monkeypatch, capsys):
+    """A baselined finding that no longer occurs must turn the gate red
+    (same contract as the tier-1 test), not just print a hint."""
+    from hetu_galvatron_tpu.analysis import lint as lint_mod
+
+    real = lint_mod.load_baseline()
+    # run_lint from-imports load_baseline at CALL time, so patching the
+    # module attribute reaches it
+    monkeypatch.setattr(
+        lint_mod, "load_baseline",
+        lambda path=None: {**real, "GAL001:gone.py:f:x#0": "fixed code"})
+    rc = check_cli.run_lint()
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "stale" in out
+
+
+# the subprocess spins up its own jax on a fresh virtual platform (~tens
+# of seconds of import + trace): slow tier
+@pytest.mark.slow
+def test_check_cli_subprocess_all():
+    """The standalone CLI contract (the exact command CI and
+    __graft_entry__.dryrun_multichip run)."""
+    rc = subprocess.run(
+        [sys.executable, "-m", "hetu_galvatron_tpu.cli.check", "--all"],
+        capture_output=True, text=True, timeout=560)
+    assert rc.returncode == 0, f"{rc.stdout}\n{rc.stderr}"
+    assert "check --all: OK" in rc.stdout

@@ -1,0 +1,167 @@
+"""Eligibility parity: the cost model's gates vs the runtime's predicates.
+
+The drift class this pins: the search engine pricing the compiled
+schedule's dispatch waiver (or the tp-overlap discount) into a plan the
+runtime then rejects at startup — or refusing a discount the runtime would
+happily run. Both sides now call ``analysis/eligibility.py``; the sweep
+here guards the ADAPTERS (SearchStrategy degrees vs LayerStrategy plans vs
+ModelArgs widths) against re-diverging.
+"""
+
+import itertools
+from types import SimpleNamespace
+
+import pytest
+
+from hetu_galvatron_tpu.analysis import eligibility
+from hetu_galvatron_tpu.core.args_schema import ModelArgs
+from hetu_galvatron_tpu.core.cost_model.cost import (
+    CostContext,
+    tp_overlap_expressible,
+)
+from hetu_galvatron_tpu.core.search_engine.strategies import SearchStrategy
+from hetu_galvatron_tpu.runtime.compiled_pipeline import (
+    CompiledPipelineEngine,
+)
+from hetu_galvatron_tpu.utils.strategy import LayerStrategy
+
+pytestmark = [pytest.mark.staticcheck, pytest.mark.search_engine]
+
+
+def model(**kw) -> ModelArgs:
+    base = dict(hidden_size=64, num_hidden_layers=4, num_attention_heads=4,
+                vocab_size=256, seq_length=16, max_position_embeddings=32,
+                hidden_act="swiglu", tie_word_embeddings=False,
+                make_vocab_size_divisible_by=1, ffn_hidden_size=128)
+    base.update(kw)
+    return ModelArgs(**base)
+
+
+def hpc_of(layers, pp_division, pipeline_type="pipedream_flush", vpp=1):
+    return SimpleNamespace(
+        layers=layers, pp_deg=layers[0].pp_deg, pp_division=pp_division,
+        pipeline_type=pipeline_type, vpp_deg=vpp)
+
+
+# ---------------------------------------------------------------------------
+# compiled-schedule expressibility: search gate vs runtime reason
+# ---------------------------------------------------------------------------
+
+
+def test_compiled_gate_parity_sweep():
+    """Sweep the structural plan space the SEARCH can see (pipeline type,
+    stage partition, strategy uniformity): the cost model's dispatch
+    waiver must fire IFF the runtime's unsupported_reason is None."""
+    base = LayerStrategy(pp_deg=2, tp_size=2, dp_size=2)
+    other = LayerStrategy(pp_deg=2, tp_size=1, dp_size=4)
+    cfg = model()
+    checked = 0
+    for pipeline_type, partition, uniform in itertools.product(
+            ("pipedream_flush", "gpipe"),
+            ([2, 2], [3, 1], [1, 1, 1, 1][:2]),
+            (True, False)):
+        layers = [base] * 4 if uniform else [base, base, other, other]
+        # runtime side: engine predicate on the resolved plan
+        reason = CompiledPipelineEngine.unsupported_reason(
+            cfg, hpc_of(layers, partition, pipeline_type))
+        # search side: degree-level gate on the same candidate (the search
+        # strategy objects compare by value, like LayerStrategy rows)
+        s_base = SearchStrategy(pp=2, tp=2, dp=2)
+        s_other = SearchStrategy(pp=2, tp=1, dp=4)
+        slist = [s_base] * 4 if uniform else [s_base, s_base,
+                                              s_other, s_other]
+        waiver = eligibility.search_compiled_expressible(
+            "compiled", pipeline_type, partition, slist)
+        assert waiver == (reason is None), (
+            f"drift: pipeline_type={pipeline_type} partition={partition} "
+            f"uniform={uniform}: search waiver {waiver} vs runtime "
+            f"reason {reason!r}")
+        checked += 1
+    assert checked == 12
+
+
+def test_compiled_gate_model_level_reasons_are_runtime_only():
+    """Model-level gates the search cannot see (t5 / MoE / vpp / packed
+    docs) must still refuse on the runtime side — and the SHARED predicate
+    is the one refusing."""
+    layers = [LayerStrategy(pp_deg=2, tp_size=2, dp_size=2)] * 4
+    hpc = hpc_of(layers, [2, 2])
+    assert CompiledPipelineEngine.unsupported_reason(model(), hpc) is None
+    assert "pair carry" in CompiledPipelineEngine.unsupported_reason(
+        model(model_type="t5", num_encoder_layers=2), hpc)
+    assert "MoE" in CompiledPipelineEngine.unsupported_reason(
+        model(num_experts=4, model_type="moe"), hpc)
+    hpc_v = hpc_of(layers, [1, 1, 1, 1], vpp=2)
+    assert "vpp" in CompiledPipelineEngine.unsupported_reason(
+        model(), hpc_v)
+    packed = SimpleNamespace(reset_position_ids=True,
+                             reset_attention_mask=False)
+    assert "packed-document" in CompiledPipelineEngine.unsupported_reason(
+        model(), hpc, data=packed)
+
+
+def test_host_schedule_never_gets_the_waiver():
+    s = [SearchStrategy(pp=2, tp=2, dp=2)] * 4
+    assert not eligibility.search_compiled_expressible(
+        "host", "pipedream_flush", [2, 2], s)
+
+
+# ---------------------------------------------------------------------------
+# tp-overlap eligibility: cost gate vs runtime per-layer dispatch
+# ---------------------------------------------------------------------------
+
+
+def test_tp_overlap_gate_parity_sweep():
+    """On a width-divisible model the degree-level cost gate and the
+    runtime's per-layer reason must agree exactly; on an indivisible
+    model the runtime may refuse MORE (widths are invisible to the
+    search) but never less."""
+    cfg = model()  # every width divides tp in {2, 4}
+    ctx = CostContext(tp_overlap=True)
+    for tp, cp, sp in itertools.product((1, 2, 4), (1, 2), (False, True)):
+        if sp and tp == 1:
+            continue  # Ulysses encodes its degree in tp; tp1+sp is dp-only
+        if sp and cp > 1:
+            continue  # exclusive per LayerStrategy.validate
+        # search view: Ulysses layers arrive as sp=deg, tp=1
+        s = SearchStrategy(pp=1, tp=1 if sp else tp, sp=tp if sp else 1,
+                           cp=cp, dp=8 // (tp * cp))
+        cost_gate = tp_overlap_expressible(s, ctx)
+        # runtime view: plan rows
+        strat = LayerStrategy(pp_deg=1, tp_size=tp, cp_size=cp,
+                              dp_size=8 // (tp * cp), sp=sp)
+        reasons = eligibility.plan_overlap_reasons(
+            cfg, SimpleNamespace(layers=[strat]))
+        runtime_ok = reasons[0][1] is None
+        assert cost_gate == runtime_ok, (
+            f"drift at tp={tp} cp={cp} sp={sp}: cost gate {cost_gate}, "
+            f"runtime reason {reasons[0][1]!r}")
+
+
+def test_tp_overlap_runtime_refuses_indivisible_widths():
+    """Degrees say yes, widths say no: the runtime must refuse with the
+    divisibility reason (the half of the predicate the search cannot
+    evaluate) — one-directional by design."""
+    cfg = model(seq_length=18)  # 18 % 4 != 0
+    s = SearchStrategy(pp=1, tp=4, dp=2)
+    assert tp_overlap_expressible(s, CostContext(tp_overlap=True))
+    reason = eligibility.overlap_unsupported_reason(
+        cfg, ulysses=False, has_cp=False, tp=4)
+    assert reason is not None and "sequence length" in reason
+
+
+def test_disabled_overlap_gates_everything():
+    s = SearchStrategy(pp=1, tp=4, dp=2)
+    assert not tp_overlap_expressible(s, CostContext(tp_overlap=False))
+
+
+def test_reason_strings_are_shared_verbatim():
+    """The launcher logs ops.overlap reasons and the doctor prints
+    eligibility reasons — they must be the SAME objects, not copies that
+    can drift."""
+    import hetu_galvatron_tpu.ops.overlap as ov
+
+    assert ov.layer_overlap_reason is eligibility.layer_overlap_reason
+    assert ov.plan_overlap_reasons is eligibility.plan_overlap_reasons
+    assert ov.T5_REASON is eligibility.T5_REASON
+    assert ov.MOE_REASON is eligibility.MOE_REASON

@@ -1,0 +1,193 @@
+"""Plan doctor (Pass 1): malformed-plan corpus + engine/kernel reports.
+
+The contract under test: ``diagnose_plan`` NEVER raises on a malformed
+plan — every corpus entry yields ``ok=False`` with an actionable
+diagnostic naming the offending key/value — and on valid plans its
+engine/kernel verdict is the runtime's verdict (the shared predicates in
+``analysis/eligibility.py``).
+"""
+
+import io
+import json
+import os
+
+import pytest
+
+from hetu_galvatron_tpu.analysis.plan_doctor import diagnose_plan
+from hetu_galvatron_tpu.core.args_schema import ModelArgs
+
+pytestmark = [pytest.mark.staticcheck, pytest.mark.utils]
+
+
+def tiny_model(**kw) -> ModelArgs:
+    base = dict(hidden_size=64, num_hidden_layers=4, num_attention_heads=4,
+                vocab_size=256, seq_length=16, max_position_embeddings=32,
+                hidden_act="swiglu", normalization="rmsnorm",
+                position_embedding_type="rope", tie_word_embeddings=False,
+                add_bias_linear=False, add_qkv_bias=False,
+                make_vocab_size_divisible_by=1, ffn_hidden_size=128)
+    base.update(kw)
+    return ModelArgs(**base)
+
+
+def good_plan(**kw):
+    plan = {
+        "pp_deg": 2, "tp_sizes_enc": "2,2,2,2",
+        "tp_consecutive_flags": "1,1,1,1", "dp_types_enc": "0,0,0,0",
+        "use_sp": "0,0,0,0", "cp_sizes_enc": "1,1,1,1",
+        "checkpoint": "0,0,0,0", "global_bsz": 4, "chunks": 2,
+        "pp_division": "2,2", "pipeline_type": "pipedream_flush",
+        "default_dp_type": "ddp", "vtp": 2, "vsp": 0, "embed_sdp": 0,
+    }
+    plan.update(kw)
+    return plan
+
+
+# one malformed plan per failure class; every entry must produce a
+# diagnostic CONTAINING the expected substring, and never a traceback
+MALFORMED_CORPUS = [
+    ("missing_pp_deg",
+     {k: v for k, v in good_plan().items() if k != "pp_deg"}, "pp_deg"),
+    ("missing_tp_vector",
+     {k: v for k, v in good_plan().items() if k != "tp_sizes_enc"},
+     "tp_sizes_enc"),
+    ("non_integer_pp_deg", good_plan(pp_deg="two"), "integer"),
+    ("fractional_pp_deg", good_plan(pp_deg=2.5), "integer"),
+    ("non_integer_vector", good_plan(cp_sizes_enc="1,x,1,1"),
+     "cp_sizes_enc"),
+    ("wrong_length_vector", good_plan(dp_types_enc="0,0"), "dp_types_enc"),
+    ("zero_layers", good_plan(tp_sizes_enc=""), "zero layers"),
+    ("negative_pp", good_plan(pp_deg=-2), "pp_deg"),
+    ("non_pow2_tp", good_plan(tp_sizes_enc="3,6,3,3"), "not divisible"),
+    ("bad_dp_type", good_plan(default_dp_type="zero9"), "default_dp_type"),
+    ("tp_exceeds_world", good_plan(tp_sizes_enc="16,16,16,16"),
+     "not divisible"),
+    ("division_sum_mismatch", good_plan(pp_division="3,2"), "pp_division"),
+    ("division_len_mismatch", good_plan(pp_division="1,1,2"),
+     "pp_division"),
+    ("bsz_not_multiple_of_chunks", good_plan(global_bsz=3), "chunks"),
+    ("layer_count_mismatch", good_plan(
+        tp_sizes_enc="2,2", tp_consecutive_flags="1,1",
+        dp_types_enc="0,0", use_sp="0,0", cp_sizes_enc="1,1",
+        checkpoint="0,0", pp_division="1,1"), "model has"),
+    ("non_object_plan", ["not", "a", "plan"], "object"),
+]
+
+
+@pytest.mark.parametrize("name,plan,needle",
+                         [(n, p, s) for n, p, s in MALFORMED_CORPUS])
+def test_malformed_plan_yields_diagnostic_not_traceback(name, plan, needle):
+    report = diagnose_plan(plan, tiny_model(), 8)
+    assert not report.ok, name
+    assert report.errors, name
+    joined = " | ".join(report.errors)
+    assert needle in joined, f"{name}: {joined!r} lacks {needle!r}"
+    # the report must render without raising even when broken
+    report.render(io.StringIO())
+
+
+def test_malformed_json_file_is_diagnosed(tmp_path):
+    p = tmp_path / "broken.json"
+    p.write_text("{ this is not json")
+    report = diagnose_plan(str(p), tiny_model(), 8)
+    assert not report.ok
+    assert any("invalid JSON" in e for e in report.errors)
+    assert str(p) in report.errors[0]
+
+
+def test_missing_file_is_diagnosed(tmp_path):
+    report = diagnose_plan(str(tmp_path / "nope.json"), tiny_model(), 8)
+    assert not report.ok
+    assert any("cannot read plan" in e for e in report.errors)
+
+
+def test_acceptance_plan_gets_compiled_engine_and_rings():
+    from hetu_galvatron_tpu.cli.check import ACCEPTANCE_PLAN
+
+    report = diagnose_plan(ACCEPTANCE_PLAN, tiny_model(), 8)
+    assert report.ok, report.errors
+    assert report.engine == "compiled"
+    assert len(report.layers) == 4
+    assert all(d.projections == "ring_overlap" for d in report.layers)
+    assert [d.stage for d in report.layers] == [0, 0, 1, 1]
+
+
+def test_heterogeneous_division_falls_back_to_host_with_reason():
+    model = tiny_model(num_hidden_layers=5)
+    plan = good_plan(
+        tp_sizes_enc="2,2,2,2,2", tp_consecutive_flags="1,1,1,1,1",
+        dp_types_enc="0,0,0,0,0", use_sp="0,0,0,0,0",
+        cp_sizes_enc="1,1,1,1,1", checkpoint="0,0,0,0,0",
+        pp_division="3,2")
+    report = diagnose_plan(plan, model, 8)
+    assert report.ok, report.errors  # valid plan — just not compiled
+    assert report.engine == "host"
+    assert "heterogeneous per-stage layer counts" in report.engine_reason
+
+
+def test_per_layer_kernel_dispatch_cp_and_ulysses():
+    plan = good_plan(pp_deg=1, tp_sizes_enc="2,2,2,1",
+                     use_sp="0,1,0,0", cp_sizes_enc="1,1,2,1",
+                     pp_division="4", global_bsz=8, chunks=1)
+    report = diagnose_plan(plan, tiny_model(), 8)
+    assert report.ok, report.errors
+    assert report.engine == "spmd"
+    att = [d.attention for d in report.layers]
+    assert att[1] == "ulysses_a2a"
+    assert att[2] == "ring"
+    # per-layer overlap fallbacks carry the canonical reasons
+    assert report.layers[0].projections == "ring_overlap"
+    assert "ulysses" in report.layers[1].overlap_reason
+    assert "cp layer" in report.layers[2].overlap_reason
+    assert "tp == 1" in report.layers[3].overlap_reason
+
+
+def test_world_mismatch_still_renders_the_layer_table():
+    """A format-valid plan against the wrong world fails with the
+    divisibility error but STILL shows the per-layer table (unresolved
+    dp), so the operator sees what the plan wants."""
+    report = diagnose_plan(good_plan(), tiny_model(), 6)  # 6 % (2*2) != 0
+    assert not report.ok
+    assert any("not divisible" in e for e in report.errors)
+    assert len(report.layers) == 4
+    assert any("UNRESOLVED dp" in w for w in report.warnings)
+
+
+def test_integral_float_degrees_are_tolerated():
+    """JSON round-trip artifacts (2.0) parse; fractional floats do not
+    (covered in the corpus above)."""
+    report = diagnose_plan(good_plan(pp_deg=2.0, vtp=2.0), tiny_model(), 8)
+    assert report.ok, report.errors
+
+
+def test_doctor_without_world_assumes_smallest_and_warns():
+    report = diagnose_plan(good_plan(), tiny_model())
+    assert report.world_size == 4  # pp2 * tp2
+    assert any("smallest world" in w for w in report.warnings)
+
+
+def test_plan_format_error_carries_key_and_path(tmp_path):
+    from hetu_galvatron_tpu.utils.strategy import (
+        PlanFormatError,
+        config2strategy,
+        load_strategy_config,
+        save_strategy_config,
+    )
+
+    with pytest.raises(PlanFormatError) as ei:
+        config2strategy(good_plan(ep_sizes_enc="1,1"))
+    assert ei.value.key == "ep_sizes_enc"
+    p = tmp_path / "x.json"
+    p.write_text("[1, 2]")
+    with pytest.raises(PlanFormatError) as ei:
+        load_strategy_config(str(p))
+    assert ei.value.path == str(p)
+    # the validating writer refuses to write a malformed plan...
+    with pytest.raises(PlanFormatError):
+        save_strategy_config(str(tmp_path / "bad.json"),
+                             good_plan(use_sp="1"))
+    assert not os.path.exists(tmp_path / "bad.json")
+    # ...and round-trips a good one
+    save_strategy_config(str(tmp_path / "ok.json"), good_plan(),
+                         world_size=8)
+    assert json.loads((tmp_path / "ok.json").read_text())["pp_deg"] == 2
