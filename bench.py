@@ -26,8 +26,10 @@ Round-4 redesign (the driver bench must ALWAYS land a parseable result):
 Env knobs: BENCH_PLATFORM=cpu forces the CPU path (smoke testing);
 BENCH_BSZ / BENCH_SEQ / BENCH_ITERS override shapes; BENCH_SWEEP=0 disables
 the batch-size sweep; BENCH_AB=0 skips the flash-vs-XLA A/B leg; BENCH_CE=0
-skips the fused-CE leg; BENCH_TIMEOUT caps total wall clock (default 900s);
-BENCH_JOURNAL pins the journal path (default: a fresh temp file).
+skips the fused-CE leg; BENCH_SERVE_PREFIX=0 / BENCH_SPEC_DECODE=0 skip the
+serving A/B legs (prefix-cache TTFT ratio, speculative-decode tokens/sec);
+BENCH_TIMEOUT caps total wall clock (default 900s); BENCH_JOURNAL pins the
+journal path (default: a fresh temp file).
 """
 
 import json
@@ -125,6 +127,28 @@ def run_leg(spec: dict, journal: str) -> int:
                      compiled_overlap_vs_host=out["compiled_overlap_vs_host"],
                      compiled_overlap_recompiles=out["compiled_recompiles"],
                      platform=out["platform"])
+            return 0
+        if spec.get("kind") in ("serve_prefix", "spec_decode"):
+            # serving A/B legs (tools/serve_bench.py): single-device tiny
+            # engines — radix prefix cache hit-vs-cold TTFT ratio, and
+            # speculative-decode vs plain tokens/sec
+            if spec["platform"] == "cpu":
+                os.environ["JAX_PLATFORMS"] = "cpu"
+                os.environ.setdefault(
+                    "XLA_FLAGS", "--xla_force_host_platform_device_count=1")
+            sys.path.insert(0, os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), "tools"))
+            import serve_bench
+
+            on_tpu = spec["platform"] == "tpu"
+            if spec["kind"] == "serve_prefix":
+                out = serve_bench.run_prefix(on_tpu=on_tpu)
+            else:
+                out = serve_bench.run_spec(on_tpu=on_tpu)
+            if "skipped" in out:
+                emit("error", error=out["skipped"])
+            else:
+                emit("ok", **out)
             return 0
         if spec["platform"] == "cpu":
             # tunnel-safe: pin the platform BEFORE jax loads any backend...
@@ -654,6 +678,36 @@ def main() -> int:
             print(f"warning: compiled-overlap A/B leg failed: "
                   f"{res.get('error')}", file=sys.stderr)
 
+    # serving A/B legs (tools/serve_bench.py run_prefix / run_spec): on by
+    # default on both platforms — the CPU ratios are real (TTFT measures
+    # actual prefill compute skipped; tokens/sec the actual verify cost)
+    # and are the committed bench_baseline.json entries.
+    # BENCH_SERVE_PREFIX=0 / BENCH_SPEC_DECODE=0 opt out.
+    serve_ab = {}
+    for kind, env, keys in (
+            ("serve_prefix", "BENCH_SERVE_PREFIX",
+             ("serve_prefix_ttft_ratio", "ttft_cold_ms", "ttft_hit_ms",
+              "prefix_hit_rate", "serve_prefix_recompiles")),
+            ("spec_decode", "BENCH_SPEC_DECODE",
+             ("spec_decode_tokens_ratio", "spec_accept_rate",
+              "spec_decode_recompiles"))):
+        if orch.wedged or os.environ.get(env, "1") == "0":
+            continue
+        state["stage"] = kind.replace("_", "-")
+        res = orch.run({"kind": kind, "platform": platform, "seq": seq,
+                        "bsz": best["bsz"], "iters": iters, "flash": False,
+                        "fused_ce": False}, leg_budget)
+        if res["status"] == "ok":
+            for k in keys:
+                if k in res:
+                    serve_ab[k] = res[k]
+            print(f"bench {kind} A/B: " + " ".join(
+                f"{k}={res[k]}" for k in keys[:1] if k in res),
+                file=sys.stderr)
+        else:
+            print(f"warning: {kind} A/B leg failed: {res.get('error')}",
+                  file=sys.stderr)
+
     out = _assemble(best, tpu_error, flash_error, on_tpu)
     out["fused_ce"] = fused_ce
     if ab:
@@ -664,6 +718,8 @@ def main() -> int:
         out.update(tp_ab)
     if co_ab:
         out.update(co_ab)
+    if serve_ab:
+        out.update(serve_ab)
     if orch.abandoned:
         out["abandoned_children"] = orch.abandoned
     _emit_result(out)

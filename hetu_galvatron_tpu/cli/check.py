@@ -135,10 +135,13 @@ def run_census(verbose: bool = True) -> int:
     for n in c.notes:
         print(f"census note: {n}")
 
-    # serving prefill + decode: single-device tiny engine; the check is
-    # marker coverage + no host callbacks in the token-latency path
+    # serving prefill + decode + prefix-prefill + speculative verify:
+    # single-device tiny engine; the check is marker coverage + no host
+    # callbacks in the token-latency path (prefix_cache/spec_decode on so
+    # the new program families are censused too)
     serving = ServingArgs(max_batch_size=2, kv_block_size=8,
-                          max_seq_len=32, num_kv_blocks=10)
+                          max_seq_len=32, num_kv_blocks=10,
+                          prefix_cache=True, spec_decode=True, spec_k=2)
     for name, sc in census_serving_programs(
             args.model, serving=serving).items():
         if verbose:

@@ -29,6 +29,17 @@ time); ``stream=0`` prints one completion record per request. Serving metrics (T
 latency percentiles, queue depth, KV occupancy, tokens/sec — see README
 "Serving") land in ``metrics`` and render with ``cli/summarize.py``.
 
+Shared-prefix traffic: ``serving.prefix_cache=1`` turns on the radix
+prefix cache (requests whose prompts share cached block-aligned prefixes
+skip that prefill entirely; ``serve/prefix_hit_rate`` lands in the
+metrics). ``serving.spec_decode=1`` adds lossless speculative decoding
+(``serving.spec_k`` drafted tokens per step via n-gram prompt-lookup,
+verified in one batched pass; greedy output is bit-identical, and
+``serve/spec_accept_rate`` reports how often drafts paid off). A small
+draft model (``serving.spec_draft=model``) is an engine-API feature
+(pass ``draft_params``/``draft_cfg`` to ``ServingEngine``); this CLI
+serves the n-gram draft.
+
 With more than one visible device the decode runs under the plan's GSPMD
 shardings exactly like ``cli/generate.py`` (pure-TP submesh unless explicit
 ``parallel.*`` degrees are given); the KV pool's head axis follows the
@@ -187,6 +198,11 @@ def main(argv=None) -> int:
         print(f"metrics: http://{serving.metrics_host}:"
               f"{engine.metrics_port}/metrics", file=sys.stderr)
 
+    if serving.prefix_cache or serving.spec_decode:
+        print(f"serving features: prefix_cache={serving.prefix_cache} "
+              f"spec_decode={serving.spec_decode}"
+              + (f" (k={serving.spec_k}, draft={serving.spec_draft})"
+                 if serving.spec_decode else ""), file=sys.stderr)
     reqs = _read_requests(kv)
     # compile decode + every prefill bucket BEFORE traffic: TTFT must
     # measure serving latency, not jit compilation

@@ -273,6 +273,33 @@ def summarize(path: str, out=None) -> Dict[str, Any]:
             c = get("counter", key)
             if c and (c["value"] or not key.endswith("errors")):
                 w(f"{label:<21} {c['value']:,.0f}")
+        # shared-prefix cache (serving/prefix_cache.py)
+        ph = get("gauge", "serve/prefix_hit_rate")
+        if ph is not None:
+            headline["prefix_hit_rate"] = ph["value"]
+            cached = get("counter", "serve/prefix_cached_tokens")
+            extra = (f" ({cached['value']:,.0f} prompt tokens reused)"
+                     if cached and cached["value"] else "")
+            w(f"prefix hit rate   {ph['value'] * 100:.1f}%{extra}")
+        pb = get("gauge", "serve/prefix_cache_blocks")
+        if pb is not None:
+            w(f"prefix cache blocks   {_fmt(pb['value'])}")
+        # speculative decoding (serving/spec_decode.py): drafted vs
+        # emitted — decode_tokens counts what actually reached clients
+        sa = get("gauge", "serve/spec_accept_rate")
+        if sa is not None:
+            headline["spec_accept_rate"] = sa["value"]
+            drafted = get("counter", "serve/drafted_tokens")
+            accepted = get("counter", "serve/spec_accepted_tokens")
+            emitted = get("counter", "serve/decode_tokens")
+            parts = [f"spec accept rate  {sa['value'] * 100:.1f}%"]
+            if drafted:
+                parts.append(f"({drafted['value']:,.0f} drafted, "
+                             f"{(accepted or {}).get('value', 0):,.0f} "
+                             "accepted"
+                             + (f", {emitted['value']:,.0f} emitted)"
+                                if emitted else ")"))
+            w(" ".join(parts))
         if ttft and ttft.get("count"):
             headline["ttft_p50_ms"] = ttft["p50"]
             w(f"TTFT ms          p50 {_fmt(ttft['p50'])} | p90 "

@@ -358,6 +358,24 @@ class ServingArgs(BaseModel):
     # token cap; 0 = that bound unlimited. The tighter one wins.
     prefill_flops_budget_g: float = 0.0
     max_prefill_tokens: int = 0
+    # shared-prefix radix cache (serving/prefix_cache.py): cached
+    # block-aligned prompt prefixes skip their prefill entirely (block
+    # tables point at refcount-shared pool blocks copy-free); eviction is
+    # LRU over unpinned radix nodes. prefix_cache_max_blocks caps how many
+    # blocks the tree may hold (0 = bounded only by the pool)
+    prefix_cache: bool = False
+    prefix_cache_max_blocks: int = 0
+    # lossless speculative decoding (serving/spec_decode.py): draft
+    # spec_k tokens per lane per step and verify them in one batched
+    # [max_batch_size, spec_k+1] pass — greedy streams stay bit-identical
+    # to plain decode. spec_draft picks the draft provider: "ngram"
+    # (prompt-lookup, free) or "model" (a small draft checkpoint passed
+    # to ServingEngine via draft_params/draft_cfg)
+    spec_decode: bool = False
+    spec_k: int = 4
+    spec_draft: Literal["ngram", "model"] = "ngram"
+    spec_ngram_max: int = 3
+    spec_ngram_min: int = 1
     # sampling defaults (per-request temperature/eos override these);
     # top_k is engine-static (shapes the jitted sampler)
     temperature: float = 0.0

@@ -44,27 +44,35 @@ def _check_supported(cfg: ModelArgs, params: Params) -> None:
 
 
 def _cached_sdpa(q, ck, cv, pos, shift=None):
-    """q [B,1,Nq,D] against the full cache [B,T,Nkv,D]; positions > pos are
-    masked (static T => one compiled shape for the whole decode scan).
-    ``pos`` is a scalar (one shared position, the offline scan) or [B]
-    (per-row positions — the serving engine's paged decode delegates
-    here). ``shift`` [B] (left-padded ragged prompts) additionally masks
-    the leading pad positions < shift[b]."""
-    B, _, nq, D = q.shape
+    """q [B,W,Nq,D] — a window of W consecutive query positions per row
+    (W=1 is the plain decode step) — against the full cache [B,T,Nkv,D];
+    window row j sits at absolute position pos(+j), and key positions
+    beyond it are masked (static T => one compiled shape for the whole
+    decode scan). ``pos`` is a scalar (one shared position, the offline
+    scan) or [B] (per-row positions — the serving engine's paged decode
+    delegates here, as do its W-wide speculative-verify and
+    prefix-suffix-prefill programs via ``kv_cache.paged_sdpa_window``:
+    ONE implementation keeps the multi-row passes bit-identical to W
+    sequential decode steps by construction, not by parallel
+    maintenance). ``shift`` [B] (left-padded ragged prompts) additionally
+    masks the leading pad positions < shift[b]."""
+    B, W, nq, D = q.shape
     T, nkv = ck.shape[1], ck.shape[2]
     G = nq // nkv
-    qg = q.reshape(B, nkv, G, D).astype(jnp.float32)
-    s = jnp.einsum("bkgd,btkd->bkgt", qg, ck.astype(jnp.float32))
+    qg = q.reshape(B, W, nkv, G, D).astype(jnp.float32)
+    s = jnp.einsum("bwkgd,btkd->bwkgt", qg, ck.astype(jnp.float32))
     s = s / jnp.sqrt(jnp.float32(D))
-    t = jnp.arange(T)[None, None, None, :]
+    t = jnp.arange(T)[None, None, None, None, :]
     pos = jnp.asarray(pos)
-    mask = t <= (pos[:, None, None, None] if pos.ndim else pos)
+    base = pos[:, None, None, None, None] if pos.ndim else pos
+    row = jnp.arange(W)[None, :, None, None, None]
+    mask = t <= (base + row)
     if shift is not None:
-        mask = mask & (t >= shift[:, None, None, None])
+        mask = mask & (t >= shift[:, None, None, None, None])
     s = jnp.where(mask, s, jnp.float32(jnp.finfo(jnp.float32).min))
     w = jax.nn.softmax(s, axis=-1)
-    out = jnp.einsum("bkgt,btkd->bkgd", w, cv.astype(jnp.float32))
-    return out.reshape(B, 1, nq, D).astype(q.dtype)
+    out = jnp.einsum("bwkgt,btkd->bwkgd", w, cv.astype(jnp.float32))
+    return out.reshape(B, W, nq, D).astype(q.dtype)
 
 
 def _embed_at(p: Params, tokens: jax.Array, pos, cfg: ModelArgs,

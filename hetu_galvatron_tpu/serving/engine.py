@@ -1,18 +1,33 @@
 """Serving engine: jitted paged prefill/decode + continuous batching loop.
 
-Two programs, compiled once each (prefill once per length bucket), drive all
-traffic:
+A small set of programs, compiled once each (prefill once per length
+bucket), drives all traffic:
 
 * **prefill** — one request's (right-padded, bucketed) prompt through the
   stack with the same attention math as offline ``models/generate.prefill``,
   k/v written straight into the request's pool blocks, first token sampled
   from the last real position's logits.
+* **prefix prefill** (``serving.prefix_cache`` on) — the same, but only
+  over the UNCACHED suffix of a prompt whose block-aligned prefix the
+  radix cache (``prefix_cache.py``) already holds: queries are the suffix
+  bucket, keys are the gathered full block table, and the cached-prefix
+  FLOPs are simply never spent. A fully-cached prompt dispatches no
+  prefill at all — its slot enters at ``pos = len-1`` and the next decode
+  step produces the first token (bit-identical: the decode program's
+  single-row math equals the prefill row's).
 * **decode** — one token for every slot at a FIXED batch shape
   ``[max_batch_size]``: per-slot positions, per-slot block tables, per-slot
   sampling params. Retired slots alias the scratch block and their outputs
   are discarded, so admission/retirement never changes the compiled shape —
   steady state runs with zero recompiles (``compile_count()`` lets tests
   pin this).
+* **verify** (``serving.spec_decode`` on) — the speculative window: every
+  slot's ``[last_token, draft_1..draft_K]`` through the stack at one fixed
+  ``[max_batch_size, K+1]`` shape (``kv_cache.paged_sdpa_window`` masks
+  row j at position pos+j), returning the target model's choice after
+  every drafted token. Greedy acceptance keeps the stream bit-identical
+  to plain decode while emitting up to K+1 tokens per step
+  (``spec_decode.py`` holds the draft providers + acceptance rule).
 
 Plan-aware SPMD: given a mesh + :class:`HybridParallelConfig`, params are
 sharded by the plan's PartitionSpecs (``parallel/spmd.py``) and the KV pool's
@@ -52,18 +67,24 @@ from hetu_galvatron_tpu.observability.trace_analysis import (
     maybe_record_jit_cost,
 )
 from hetu_galvatron_tpu.serving.kv_cache import (
+    SCRATCH_BLOCK,
     PagedKVCache,
+    copy_block,
     gather_pages,
     paged_sdpa,
+    paged_sdpa_window,
     scatter_prefill,
     scatter_token,
+    scatter_window,
 )
+from hetu_galvatron_tpu.serving.prefix_cache import PrefixCache
 from hetu_galvatron_tpu.serving.scheduler import (
     Request,
     RequestHandle,
     Scheduler,
     Slot,
 )
+from hetu_galvatron_tpu.serving.spec_decode import accept_length, make_draft
 
 Params = Dict[str, Any]
 
@@ -143,6 +164,8 @@ class ServingEngine:
         registry: Optional[MetricsRegistry] = None,
         compute_dtype=jnp.bfloat16,
         kv_dtype=None,
+        draft_params: Optional[Params] = None,
+        draft_cfg: Optional[ModelArgs] = None,
     ):
         serving = serving if serving is not None else ServingArgs()
         _check_supported(cfg, params)
@@ -189,6 +212,11 @@ class ServingEngine:
             model_flops_per_token,
         )
 
+        self.prefix: Optional[PrefixCache] = None
+        if serving.prefix_cache:
+            self.prefix = PrefixCache(
+                self.kv.allocator, self.kv.block_size,
+                max_blocks=serving.prefix_cache_max_blocks)
         self.scheduler = Scheduler(
             self.kv, max_slots=self.S,
             max_position_embeddings=cfg.max_position_embeddings,
@@ -196,7 +224,8 @@ class ServingEngine:
             # cost-model FLOPs are fwd+bwd (bwd counted 2x); prefill is
             # forward-only
             flops_per_token=model_flops_per_token(cfg) / 3.0,
-            max_prefill_tokens=serving.max_prefill_tokens)
+            max_prefill_tokens=serving.max_prefill_tokens,
+            prefix_cache=self.prefix)
 
         # rope/position tables cover every storable position
         self._table_len = self.kv.max_blocks_per_seq * self.kv.block_size
@@ -208,6 +237,19 @@ class ServingEngine:
         self._sample = _make_sampler(cfg, serving.top_k)
         self._decode_fn = self._build_decode()
         self._prefill_fns: Dict[int, Callable] = {}
+        self._prefix_fns: Dict[int, Callable] = {}
+        self._cow_fn: Optional[Callable] = None
+        # speculative decoding: draft provider + the [S, K+1] verify
+        # program (None when serving.spec_decode is off)
+        if serving.spec_decode and serving.spec_k < 1:
+            raise ValueError(f"serving.spec_k must be >= 1, "
+                             f"got {serving.spec_k}")
+        self._draft = make_draft(serving, draft_params=draft_params,
+                                 draft_cfg=draft_cfg)
+        self._verify_fn = (self._build_verify()
+                           if self._draft is not None else None)
+        self._drafted_total = 0
+        self._accepted_total = 0
 
         # Prometheus /metrics endpoint (serving.metrics_port): off unless
         # asked for; port 0 binds ephemeral and .metrics_port reports it
@@ -361,18 +403,157 @@ class ServingEngine:
 
         return self._jit(fn, n_extra=6)
 
+    def _build_prefix_prefill(self, bucket: int):
+        """(params, pools, tokens [1, bucket], full_table [MB], ctx,
+        true_len, temp, seed) -> (pools, first_token). The shared-prefix
+        suffix prefill: queries are the UNCACHED suffix tokens at absolute
+        positions ctx..ctx+bucket-1; keys are the slot's whole assembled
+        page table (the cached prefix + the suffix being written), masked
+        per row — bit-identical to having prefilled the whole prompt
+        (``paged_sdpa_window`` mirrors the decode/prefill arithmetic).
+        Pad lanes past the per-sequence table capacity write to scratch
+        (a pow-of-two bucket may overshoot the capacity a deep prefix
+        leaves)."""
+        cfg = self.cfg
+        maxpos = cfg.max_position_embeddings
+        bs = self.kv.block_size
+        MB = self.kv.max_blocks_per_seq
+
+        def fn(params, pools, tokens, table, ctx, true_len, temp, seed):
+            rope = None
+            if self._rope is not None:
+                rope = (
+                    jax.lax.dynamic_slice_in_dim(self._rope[0], ctx, bucket),
+                    jax.lax.dynamic_slice_in_dim(self._rope[1], ctx, bucket))
+            pos_ids = None
+            if "wpe" in params["embed"]:
+                pos_ids = jnp.minimum(ctx + jnp.arange(bucket),
+                                      maxpos - 1)[None]
+            x = M.apply_embedding(params["embed"], tokens, cfg,
+                                  compute_dtype=self.compute_dtype,
+                                  position_ids=pos_ids)
+            idx = ctx // bs + jnp.arange(bucket // bs)
+            sblocks = jnp.where(idx < MB, table[jnp.minimum(idx, MB - 1)],
+                                SCRATCH_BLOCK)
+
+            def sdpa_for(i, new_pools, cell):
+                def sdpa(q, k, v, *, causal=True):
+                    pk = scatter_prefill(new_pools[i]["k"], k[0], sblocks)
+                    pv = scatter_prefill(new_pools[i]["v"], v[0], sblocks)
+                    cell["k"], cell["v"] = pk, pv
+                    ck = gather_pages(pk, table[None])
+                    cv = gather_pages(pv, table[None])
+                    return paged_sdpa_window(q, ck, cv, ctx)
+
+                return sdpa
+
+            new_pools, logits = self._layer_stack(params, pools, x, rope,
+                                                  sdpa_for)
+            last = jax.lax.dynamic_slice_in_dim(
+                logits[0], true_len - 1, 1, axis=0)  # [1, V]
+            tok = self._sample(
+                last, jnp.asarray([temp], jnp.float32),
+                jnp.asarray([seed], jnp.int32),
+                jnp.zeros((1,), jnp.int32))
+            return new_pools, tok[0]
+
+        return self._jit(fn, n_extra=6)
+
+    def _build_verify(self):
+        """(params, pools, tokens [S, K+1], pos [S], tables [S, MB],
+        temps [S], seeds [S], gen_idx [S], limit [S]) -> (pools,
+        targets [S, K+1]). The speculative window: lane s's tokens are
+        [last_token, draft_1..draft_K] at positions pos..pos+K; row j's
+        target is what the model emits AFTER seeing the drafts before j —
+        the same arithmetic as j+1 sequential decode steps. Writes past a
+        lane's position budget (``limit``) land on the scratch block;
+        rejected drafts leave garbage k/v beyond the accepted point that
+        the position mask hides until a later step overwrites it (the
+        standard retired-lane contract). The [S, K+1] embedding below
+        mirrors ``models/generate._embed_at`` (same op order: wte gather,
+        wpe add, embedding norm, gemma scale, cast)."""
+        cfg = self.cfg
+        S = self.S
+        K1 = int(self.serving.spec_k) + 1
+        bs = self.kv.block_size
+        tl = self._table_len
+        maxpos = cfg.max_position_embeddings
+
+        def fn(params, pools, tokens, pos, tables, temps, seeds, gen_idx,
+               limit):
+            p_j = pos[:, None] + jnp.arange(K1)[None, :]  # [S, K1] abs pos
+            pc = jnp.minimum(p_j, tl - 1)
+            x = jnp.take(params["embed"]["wte"], tokens, axis=0)
+            if "wpe" in params["embed"]:
+                x = x + jnp.take(params["embed"]["wpe"],
+                                 jnp.minimum(p_j, maxpos - 1), axis=0)
+            if "ln" in params["embed"]:
+                x = M.apply_norm(params["embed"]["ln"], x, cfg)
+            if cfg.scale_embeddings:
+                x = x * jnp.sqrt(
+                    jnp.float32(cfg.hidden_size)).astype(x.dtype)
+            x = x.astype(self.compute_dtype)
+            rope = None
+            if self._rope is not None:
+                rope = (self._rope[0][pc], self._rope[1][pc])
+            write_ok = p_j <= limit[:, None]
+            blks = jnp.where(
+                write_ok, tables[jnp.arange(S)[:, None], pc // bs],
+                SCRATCH_BLOCK)
+            offs = pc % bs
+
+            def sdpa_for(i, new_pools, cell):
+                def sdpa(q, k, v, *, causal=True):
+                    pk = scatter_window(new_pools[i]["k"], k, blks, offs)
+                    pv = scatter_window(new_pools[i]["v"], v, blks, offs)
+                    cell["k"], cell["v"] = pk, pv
+                    ck = gather_pages(pk, tables)
+                    cv = gather_pages(pv, tables)
+                    return paged_sdpa_window(q, ck, cv, pos)
+
+                return sdpa
+
+            new_pools, logits = self._layer_stack(params, pools, x, rope,
+                                                  sdpa_for)
+            outs = [self._sample(logits[:, j], temps, seeds, gen_idx + j)
+                    for j in range(K1)]
+            return new_pools, jnp.stack(outs, axis=1)
+
+        return self._jit(fn, n_extra=7)
+
+    def _build_cow(self):
+        """(params, pools, src, dst) -> (pools, 0): duplicate one block in
+        every layer's k/v pool — the copy-on-write a fully-cached prompt
+        needs before its bootstrap decode step rewrites the last prompt
+        position (which lives in a SHARED block)."""
+
+        def fn(params, pools, src, dst):
+            out = [{"k": copy_block(pl["k"], src, dst),
+                    "v": copy_block(pl["v"], src, dst)} for pl in pools]
+            return out, jnp.zeros((), jnp.int32)
+
+        return self._jit(fn, n_extra=2)
+
     def compile_count(self) -> int:
-        """Total compiled-program count across decode + prefill buckets
-        (tests pin this flat across steady state)."""
-        fns = [self._decode_fn] + list(self._prefill_fns.values())
+        """Total compiled-program count across decode/verify/copy-block +
+        prefill and prefix-prefill buckets (tests pin this flat across
+        steady state)."""
+        fns = ([self._decode_fn] + list(self._prefill_fns.values())
+               + list(self._prefix_fns.values()))
+        if self._verify_fn is not None:
+            fns.append(self._verify_fn)
+        if self._cow_fn is not None:
+            fns.append(self._cow_fn)
         return sum(f._cache_size() for f in fns)
 
     def step_jaxprs(self, bucket: Optional[int] = None) -> Dict[str, Any]:
-        """ClosedJaxprs of the decode and one prefill-bucket program — the
+        """ClosedJaxprs of every program family in the token-latency path
+        — decode, one prefill bucket, and (when enabled) the
+        prefix-prefill bucket and the speculative verify window — the
         static-analysis hook (``analysis/census.py`` censuses them for
-        host callbacks / unmarked collectives in the token-latency path).
-        Tracing only: nothing executes, the donated pools are untouched,
-        and the traced programs land in the normal jit caches."""
+        host callbacks / unmarked collectives). Tracing only: nothing
+        executes, the donated pools are untouched, and the traced programs
+        land in the normal jit caches."""
         if bucket is None:
             bucket = default_buckets(self.kv.block_size, self._table_len)[0]
         prefill = self._prefill_for(bucket)
@@ -388,14 +569,37 @@ class ServingEngine:
                     jnp.asarray(state["temps"], jnp.float32),
                     jnp.asarray(state["seeds"], jnp.int32),
                     jnp.asarray(state["gen_idx"], jnp.int32))
-        return {f"prefill_{bucket}": jax.make_jaxpr(prefill)(*pre_args),
-                "decode": jax.make_jaxpr(self._decode_fn)(*dec_args)}
+        out = {f"prefill_{bucket}": jax.make_jaxpr(prefill)(*pre_args),
+               "decode": jax.make_jaxpr(self._decode_fn)(*dec_args)}
+        if self.prefix is not None:
+            fnp = self._prefix_prefill_for(bucket)
+            full = jnp.zeros((self.kv.max_blocks_per_seq,), jnp.int32)
+            ppre_args = (self.params, self.kv.pools,
+                         jnp.zeros((1, bucket), jnp.int32), full, 0, 1,
+                         0.0, 0)
+            out[f"prefix_prefill_{bucket}"] = \
+                jax.make_jaxpr(fnp)(*ppre_args)
+        if self._verify_fn is not None:
+            K1 = int(self.serving.spec_k) + 1
+            ver_args = (self.params, self.kv.pools,
+                        jnp.zeros((self.S, K1), jnp.int32),
+                        jnp.asarray(state["pos"], jnp.int32),
+                        jnp.asarray(state["tables"], jnp.int32),
+                        jnp.asarray(state["temps"], jnp.float32),
+                        jnp.asarray(state["seeds"], jnp.int32),
+                        jnp.asarray(state["gen_idx"], jnp.int32),
+                        jnp.asarray(state["limit"], jnp.int32))
+            out["verify"] = jax.make_jaxpr(self._verify_fn)(*ver_args)
+        return out
 
     def warmup(self, buckets: Optional[List[int]] = None) -> None:
-        """Pre-compile the decode program and the given prefill buckets
+        """Pre-compile every program traffic can reach — the decode (or,
+        under spec decode, verify) step, the given prefill buckets
         (defaults to every power-of-two bucket up to the pool's
-        per-sequence capacity). Dummy runs write only the scratch block,
-        so a warm engine is still empty."""
+        per-sequence capacity), their prefix-prefill twins, and the
+        copy-on-write block duplicator — so steady state never compiles.
+        Dummy runs write only the scratch block, so a warm engine is
+        still empty."""
         if buckets is None:
             buckets = default_buckets(self.kv.block_size, self._table_len)
         for b in buckets:
@@ -412,7 +616,28 @@ class ServingEngine:
             new_pools, tok = fn(*args)
             self.kv.pools = new_pools
             jax.block_until_ready(tok)
-        toks = self._run_decode(self.scheduler.decode_state())
+            if self.prefix is not None:
+                fnp = self._prefix_prefill_for(b)
+                full = jnp.zeros((self.kv.max_blocks_per_seq,), jnp.int32)
+                pargs = (self.params, self.kv.pools,
+                         jnp.zeros((1, b), jnp.int32), full, 0, 1, 0.0, 0)
+                maybe_record_jit_cost(f"serve/prefix_prefill_{b}", fnp,
+                                      pargs, registry=self.registry)
+                new_pools, tok = fnp(*pargs)
+                self.kv.pools = new_pools
+                jax.block_until_ready(tok)
+        if self.prefix is not None:
+            self._cow_copy(SCRATCH_BLOCK, SCRATCH_BLOCK)
+        state = self.scheduler.decode_state()
+        if self._draft is not None:
+            # both step programs: verify drives greedy lanes; a step
+            # whose live lanes are ALL sampled (which never speculate)
+            # falls back to the cheaper plain decode
+            drafted = [[0] * int(self.serving.spec_k)
+                       for _ in range(self.S)]
+            toks = self._run_decode(state, drafted=drafted)
+            del toks
+        toks = self._run_decode(state)
         del toks
 
     # -- the serving loop ---------------------------------------------------
@@ -454,13 +679,24 @@ class ServingEngine:
             return handle
 
     def step(self) -> bool:
-        """One engine iteration: sweep retirements, admit + prefill, one
-        decode step. Returns whether any work happened."""
+        """One engine iteration: sweep retirements, admit + prefill the
+        uncached suffixes (fully-cached prompts dispatch NO prefill — the
+        decode step below produces their first token), one decode/verify
+        step. Returns whether any work happened."""
         with self._lock:
             did = self._sweep() > 0
             admitted = self.scheduler.admit()
             for slot, bucket in admitted:
-                self._prefill_slot(slot, bucket)
+                if slot.cached_len:
+                    self.registry.counter("serve/prefix_hits").inc()
+                    self.registry.counter("serve/prefix_cached_tokens").inc(
+                        slot.cached_len)
+                if slot.cow is not None:
+                    self._cow_copy(*slot.cow)
+                    slot.cow = None
+                if bucket:
+                    self._prefill_slot(slot, bucket)
+                self.scheduler.note_prefilled(slot)
                 did = True
             if self.scheduler.slots:
                 self._decode_active()
@@ -543,51 +779,139 @@ class ServingEngine:
             self._prefill_fns[bucket] = fn
         return fn
 
+    def _prefix_prefill_for(self, bucket: int) -> Callable:
+        fn = self._prefix_fns.get(bucket)
+        if fn is None:
+            fn = self._build_prefix_prefill(bucket)
+            self._prefix_fns[bucket] = fn
+        return fn
+
+    def _cow_copy(self, src: int, dst: int) -> None:
+        if self._cow_fn is None:
+            self._cow_fn = self._build_cow()
+        new_pools, _ = self._cow_fn(self.params, self.kv.pools, src, dst)
+        self.kv.pools = new_pools
+
     def _prefill_slot(self, slot: Slot, bucket: int) -> None:
         req = slot.request
         prompt_len = len(req.tokens)
+        cached = slot.cached_len
+        suffix = req.tokens[cached:]
         padded = np.zeros((1, bucket), np.int32)
-        padded[0, :prompt_len] = req.tokens
-        table = np.asarray(slot.blocks[: bucket // self.kv.block_size],
-                           np.int32)
-        fn = self._prefill_for(bucket)
-        args = (self.params, self.kv.pools, jnp.asarray(padded),
-                jnp.asarray(table), prompt_len,
-                float(req.temperature), int(req.seed))
+        padded[0, :len(suffix)] = suffix
+        if cached:
+            fn = self._prefix_prefill_for(bucket)
+            name = f"serve/prefix_prefill_{bucket}"
+            full = jnp.asarray(self.scheduler.padded_table(slot.blocks),
+                               jnp.int32)
+            args = (self.params, self.kv.pools, jnp.asarray(padded),
+                    full, cached, len(suffix),
+                    float(req.temperature), int(req.seed))
+        else:
+            table = np.asarray(slot.blocks[: bucket // self.kv.block_size],
+                               np.int32)
+            fn = self._prefill_for(bucket)
+            name = f"serve/prefill_{bucket}"
+            args = (self.params, self.kv.pools, jnp.asarray(padded),
+                    jnp.asarray(table), prompt_len,
+                    float(req.temperature), int(req.seed))
         # fallback for buckets warmup() never covered — warmed buckets
         # were recorded there, so this early-outs to a set lookup and the
         # request path never pays the lower() retrace (BEFORE the call —
         # the program donates the pools)
-        maybe_record_jit_cost(f"serve/prefill_{bucket}", fn, args,
-                              registry=self.registry)
+        maybe_record_jit_cost(name, fn, args, registry=self.registry)
         new_pools, tok = fn(*args)
         self.kv.pools = new_pools
         tok = int(np.asarray(tok))
-        self.registry.counter("serve/prefill_tokens").inc(prompt_len)
+        self.registry.counter("serve/prefill_tokens").inc(len(suffix))
         self._emit(slot, tok, first=True)
 
-    def _run_decode(self, state) -> np.ndarray:
-        args = (self.params, self.kv.pools,
-                jnp.asarray(state["tokens"], jnp.int32),
-                jnp.asarray(state["pos"], jnp.int32),
-                jnp.asarray(state["tables"], jnp.int32),
-                jnp.asarray(state["temps"], jnp.float32),
-                jnp.asarray(state["seeds"], jnp.int32),
-                jnp.asarray(state["gen_idx"], jnp.int32))
-        maybe_record_jit_cost("serve/decode", self._decode_fn, args,
-                              registry=self.registry)
-        new_pools, toks = self._decode_fn(*args)
+    def _run_decode(self, state, drafted=None) -> np.ndarray:
+        if drafted is None:
+            fn, name = self._decode_fn, "serve/decode"
+            args = (self.params, self.kv.pools,
+                    jnp.asarray(state["tokens"], jnp.int32),
+                    jnp.asarray(state["pos"], jnp.int32),
+                    jnp.asarray(state["tables"], jnp.int32),
+                    jnp.asarray(state["temps"], jnp.float32),
+                    jnp.asarray(state["seeds"], jnp.int32),
+                    jnp.asarray(state["gen_idx"], jnp.int32))
+        else:
+            fn, name = self._verify_fn, "serve/verify"
+            window = [[t] + list(d)
+                      for t, d in zip(state["tokens"], drafted)]
+            args = (self.params, self.kv.pools,
+                    jnp.asarray(window, jnp.int32),
+                    jnp.asarray(state["pos"], jnp.int32),
+                    jnp.asarray(state["tables"], jnp.int32),
+                    jnp.asarray(state["temps"], jnp.float32),
+                    jnp.asarray(state["seeds"], jnp.int32),
+                    jnp.asarray(state["gen_idx"], jnp.int32),
+                    jnp.asarray(state["limit"], jnp.int32))
+        maybe_record_jit_cost(name, fn, args, registry=self.registry)
+        new_pools, toks = fn(*args)
         self.kv.pools = new_pools
         return np.asarray(toks)
 
     def _decode_active(self) -> None:
+        if self._draft is not None and any(
+                s.request.temperature <= 0.0
+                for s in self.scheduler.slots.values()):
+            # at least one greedy lane can profit from drafts; sampled
+            # lanes ride along untouched (they never speculate)
+            self._verify_active()
+            return
         state = self.scheduler.decode_state()
         toks = self._run_decode(state)
         for slot in list(self.scheduler.slots.values()):
             slot.pos += 1
-            self._emit(slot, int(toks[slot.index]))
+            # a fully-cached prompt skipped prefill entirely: its FIRST
+            # token comes from this decode step (TTFT records here)
+            self._emit(slot, int(toks[slot.index]),
+                       first=slot.generated == 0)
         self.registry.counter("serve/decode_tokens").inc(
             sum(state["active"]))
+
+    def _verify_active(self) -> None:
+        """One speculative step: draft K tokens per live lane (host-side),
+        verify the whole window in one fixed-shape pass, emit the accepted
+        prefix + the bonus token. Greedy lanes emit exactly the
+        non-speculative stream; sampled lanes do not speculate (row 0's
+        sample uses the same per-request fold_in key plain decode
+        would)."""
+        K = int(self.serving.spec_k)
+        state = self.scheduler.decode_state()
+        slots = list(self.scheduler.slots.values())
+        drafted = [[0] * K for _ in range(self.S)]
+        for slot in slots:
+            if slot.request.temperature > 0.0:
+                continue  # sampled lanes never speculate: don't pay the
+                # O(context) draft scan or skew the accept-rate stats
+            ctx = list(slot.request.tokens) + slot.handle.output
+            prop = list(self._draft.propose(ctx, K))[:K]
+            drafted[slot.index][: len(prop)] = prop
+        out = self._run_decode(state, drafted=drafted)
+        emitted = 0
+        for slot in slots:
+            req = slot.request
+            row = out[slot.index].tolist()
+            budget = req.max_new_tokens - slot.generated
+            k_eff = (min(K, max(budget - 1, 0))
+                     if req.temperature <= 0.0 else 0)
+            a = accept_length(drafted[slot.index], row, k_eff)
+            if req.temperature <= 0.0:
+                self._drafted_total += K
+                self._accepted_total += a
+                self.registry.counter("serve/drafted_tokens").inc(K)
+            if a:
+                self.registry.counter("serve/spec_accepted_tokens").inc(a)
+            for tok in row[: a + 1]:
+                slot.pos += 1
+                self._emit(slot, int(tok), first=slot.generated == 0)
+                emitted += 1
+                if slot.index not in self.scheduler.slots:
+                    break  # retired (eos / length) mid-window
+        self.registry.counter("serve/decode_tokens").inc(emitted)
 
     def _emit(self, slot: Slot, tok: int, first: bool = False) -> None:
         """Record one generated token: stream it, time it, retire on
@@ -630,6 +954,20 @@ class ServingEngine:
             return 0.0
         return (w[-1][1] - w[0][1]) / (w[-1][0] - w[0][0])
 
+    def spec_accept_rate(self) -> float:
+        """Fraction of drafted tokens the verify pass accepted (0 when
+        spec decode is off or nothing was drafted yet)."""
+        if not self._drafted_total:
+            return 0.0
+        return self._accepted_total / self._drafted_total
+
+    def defrag(self) -> None:
+        """Compact live pool blocks to the low indices (pool-shrink /
+        snapshot): delegates to the scheduler, which rewrites every
+        referencing table — active sequences AND radix prefix nodes."""
+        with self._lock:
+            self.scheduler.defrag()
+
     def flush(self) -> None:
         reg = self.registry
         reg.gauge("serve/queue_depth").set(self.scheduler.queue_depth)
@@ -638,6 +976,12 @@ class ServingEngine:
         reg.gauge("serve/kv_blocks_used").set(self.kv.allocator.used)
         reg.gauge("serve/tokens_per_sec").set(self.tokens_per_sec())
         reg.gauge("serve/jit_programs").set(self.compile_count())
+        if self.prefix is not None:
+            reg.gauge("serve/prefix_hit_rate").set(self.prefix.hit_rate)
+            reg.gauge("serve/prefix_cache_blocks").set(
+                self.prefix.blocks_held)
+        if self._draft is not None:
+            reg.gauge("serve/spec_accept_rate").set(self.spec_accept_rate())
         reg.flush(step=self._steps)
 
     def close(self) -> None:

@@ -48,13 +48,30 @@ SCRATCH_BLOCK = 0
 Pools = List[Dict[str, jax.Array]]
 
 
+class BlockAccountingError(ValueError):
+    """A block lifecycle violation: double free, strict-freeing a block
+    other owners still reference, or touching the refcount of a block that
+    was never allocated. Typed so callers (and tests) can distinguish a
+    bookkeeping bug from ordinary ValueErrors."""
+
+
 class BlockAllocator:
-    """Host-side free-list allocator over the pool's block ids.
+    """Host-side refcounted free-list allocator over the pool's block ids.
 
     Blocks are position-independent (the table indirection absorbs any
     ordering), so there is no fragmentation in the contiguous-memory sense;
     :meth:`defrag_plan` exists to compact live blocks to the low indices
     (pool-shrink / snapshot use cases), not to satisfy allocations.
+
+    Refcounts make KV *sharing* copy-free (the radix prefix cache,
+    ``serving/prefix_cache.py``): every owner — a running sequence, the
+    radix tree — holds one reference, and a block returns to the free list
+    only when the last one drops it. :meth:`alloc` hands out blocks at
+    refcount 1; co-owners :meth:`incref`; owners release via
+    :meth:`decref`. :meth:`free` stays the STRICT single-owner path:
+    freeing a block somebody else still references (or freeing twice)
+    raises :class:`BlockAccountingError` instead of silently corrupting a
+    neighbor's cache.
     """
 
     def __init__(self, num_blocks: int):
@@ -64,6 +81,7 @@ class BlockAllocator:
         self.num_blocks = num_blocks
         # LIFO free list: recycled blocks are reused first (warm pages)
         self._free = list(range(num_blocks - 1, SCRATCH_BLOCK, -1))
+        self._rc = [0] * num_blocks  # per-block owner count; 0 = free
 
     @property
     def available(self) -> int:
@@ -73,22 +91,85 @@ class BlockAllocator:
     def used(self) -> int:
         return (self.num_blocks - 1) - len(self._free)
 
+    def refcount(self, block: int) -> int:
+        self._check_id(block)
+        return self._rc[block]
+
+    def _check_id(self, b: int) -> None:
+        if not (SCRATCH_BLOCK < b < self.num_blocks):
+            raise BlockAccountingError(f"invalid block id {b} "
+                                       f"(pool has 1..{self.num_blocks - 1})")
+
     def alloc(self, n: int) -> Optional[List[int]]:
-        """n blocks, or None when the pool cannot satisfy the request
-        (caller keeps the sequence queued — never a partial grant)."""
+        """n blocks at refcount 1, or None when the pool cannot satisfy
+        the request (caller keeps the sequence queued — never a partial
+        grant)."""
         if n < 0:
             raise ValueError(f"alloc({n})")
         if n > len(self._free):
             return None
         out = [self._free.pop() for _ in range(n)]
+        for b in out:
+            self._rc[b] = 1
         return out
 
-    def free(self, blocks: Sequence[int]) -> None:
+    def incref(self, blocks: Sequence[int]) -> None:
+        """A new owner (e.g. the radix tree adopting a prompt's blocks)
+        takes a reference. Only allocated blocks can gain owners."""
         for b in blocks:
-            if not (SCRATCH_BLOCK < b < self.num_blocks):
-                raise ValueError(f"free() of invalid block id {b}")
-            if b in self._free:
-                raise ValueError(f"double free of block {b}")
+            self._check_id(b)
+            if self._rc[b] < 1:
+                raise BlockAccountingError(
+                    f"incref of unallocated block {b}")
+        for b in blocks:
+            self._rc[b] += 1
+
+    @staticmethod
+    def _check_unique(blocks: Sequence[int]) -> None:
+        # a duplicated id in ONE call would pass per-block validation
+        # (the refcount only drops in the mutation phase) and then
+        # double-release: the free list would hand the same block to two
+        # sequences — silent cross-request KV corruption
+        if len(set(blocks)) != len(blocks):
+            dup = sorted(b for b in set(blocks)
+                         if list(blocks).count(b) > 1)
+            raise BlockAccountingError(
+                f"duplicate block id(s) {dup} in one release call")
+
+    def decref(self, blocks: Sequence[int]) -> List[int]:
+        """Drop one reference per block; blocks whose last owner left are
+        recycled and returned. Decref of an already-free block is a double
+        free; so is the same id twice in one call."""
+        self._check_unique(blocks)
+        for b in blocks:
+            self._check_id(b)
+            if self._rc[b] < 1:
+                raise BlockAccountingError(f"double free of block {b}")
+        freed: List[int] = []
+        for b in blocks:
+            self._rc[b] -= 1
+            if self._rc[b] == 0:
+                self._free.append(b)
+                freed.append(b)
+        return freed
+
+    def free(self, blocks: Sequence[int]) -> None:
+        """Strict sole-owner release: every block must be allocated with
+        refcount exactly 1. Freeing a SHARED block this way raises —
+        co-owned blocks must go through :meth:`decref` so the other
+        owners' tables stay valid."""
+        self._check_unique(blocks)
+        for b in blocks:
+            self._check_id(b)
+            if self._rc[b] == 0:
+                raise BlockAccountingError(f"double free of block {b}")
+            if self._rc[b] > 1:
+                raise BlockAccountingError(
+                    f"free() of shared block {b} "
+                    f"(refcount {self._rc[b]}); other owners still "
+                    "reference it — use decref()")
+        for b in blocks:
+            self._rc[b] = 0
             self._free.append(b)
 
     def defrag_plan(self, tables: Sequence[Sequence[int]]
@@ -99,11 +180,22 @@ class BlockAllocator:
         gather order (length num_blocks; scratch stays at 0) and
         ``new_tables`` mirror ``tables`` under the renaming. The caller
         applies ``perm`` to the pool arrays (:meth:`PagedKVCache.defrag`)
-        and adopts the new tables; the free list is rebuilt as the tail."""
+        and adopts the new tables; the free list is rebuilt as the tail.
+
+        ``tables`` must cover EVERY referencing view of every live block —
+        all sequences' tables, their ownership lists, and the radix prefix
+        cache's node tables (:meth:`Scheduler.defrag` collects them) — or
+        an unlisted view would silently keep pointing at a permuted id.
+        Shared blocks may appear in many tables; refcounts survive the
+        renaming unchanged."""
         remap: Dict[int, int] = {SCRATCH_BLOCK: SCRATCH_BLOCK}
         for table in tables:
             for b in table:
                 if b not in remap:
+                    self._check_id(b)
+                    if self._rc[b] < 1:
+                        raise BlockAccountingError(
+                            f"defrag table references free block {b}")
                     remap[b] = len(remap)
         n_live = len(remap) - 1
         if n_live != self.used:
@@ -119,6 +211,7 @@ class BlockAllocator:
             perm[n_live + 1 + i] = old
         new_tables = [[remap[b] for b in t] for t in tables]
         self._free = list(range(self.num_blocks - 1, n_live, -1))
+        self._rc = [self._rc[perm[new]] for new in range(self.num_blocks)]
         return perm, new_tables
 
 
@@ -167,6 +260,39 @@ def paged_sdpa(q: jax.Array, ck: jax.Array, cv: jax.Array,
     from hetu_galvatron_tpu.models.generate import _cached_sdpa
 
     return _cached_sdpa(q, ck, cv, pos)
+
+
+def paged_sdpa_window(q: jax.Array, ck: jax.Array, cv: jax.Array,
+                      start) -> jax.Array:
+    """Windowed cached attention: q [S,W,Nq,D] holds W consecutive query
+    positions per slot starting at absolute position ``start[s]`` (scalar
+    or [S]); row j attends key positions <= start[s] + j of the assembled
+    pages [S,T,K,D]. Delegates to the ONE dense-cache attention
+    implementation (``models/generate._cached_sdpa``, W-wide), so the
+    speculative verify program and the prefix-suffix prefill are
+    bit-identical to W sequential decode steps — by construction, not by
+    parallel maintenance (:func:`paged_sdpa` is the W=1 view of the same
+    delegation)."""
+    from hetu_galvatron_tpu.models.generate import _cached_sdpa
+
+    return _cached_sdpa(q, ck, cv, start)
+
+
+def scatter_window(pool: jax.Array, kv: jax.Array, blocks: jax.Array,
+                   offsets: jax.Array) -> jax.Array:
+    """Write a window of tokens per slot: kv [S, W, K, D] lands at
+    (blocks[s, j], offsets[s, j]). The verify program routes
+    out-of-budget lanes at the scratch block — colliding scratch writes
+    are unordered but never read (same contract as
+    :func:`scatter_token`)."""
+    return pool.at[blocks, offsets].set(kv.astype(pool.dtype))
+
+
+def copy_block(pool: jax.Array, src, dst) -> jax.Array:
+    """Duplicate one block's contents (copy-on-write for a fully-cached
+    prompt: the block holding the last prompt position must be private
+    before the bootstrap decode step overwrites that position)."""
+    return pool.at[dst].set(pool[src])
 
 
 # module-level so repeated defrag() calls hit the jit cache instead of

@@ -17,7 +17,16 @@ fixed-shape decode step — and this module decides what feeds them:
   jitted shape. Retired slots park on the scratch block and recycle on the
   next admission — no recompiles in steady state.
 * **Retirement** is per-sequence: EOS, length budget, cancellation, or
-  timeout. Freed blocks return to the allocator LIFO.
+  timeout. Retirement DECREFS (never strict-frees): a retiring sequence
+  drops its references and blocks the radix prefix cache co-owns stay
+  resident for future shared-prefix hits.
+* **Prefix-aware admission** (``prefix_cache.PrefixCache``): the cached
+  block-aligned prefix of a prompt is matched copy-free into the block
+  table, and only the UNCACHED suffix is charged against the prefill
+  budget — a fully-cached prompt charges nothing, dispatches no prefill,
+  and bootstraps its first token through the regular decode step (its
+  last prompt position's block is copy-on-write duplicated so the decode
+  write never touches a shared block).
 
 Prompt lengths are bucketed to ``block_size * 2^k`` so the set of prefill
 programs is logarithmic in the max prompt length.
@@ -33,6 +42,7 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from hetu_galvatron_tpu.serving.kv_cache import PagedKVCache, SCRATCH_BLOCK
+from hetu_galvatron_tpu.serving.prefix_cache import PrefixCache
 
 _req_counter = itertools.count()
 
@@ -70,6 +80,7 @@ class RequestHandle:
         self.request = request
         self.status = "queued"
         self.finish_reason: Optional[str] = None
+        self.cached_tokens = 0  # prompt tokens served by the prefix cache
         self.output: List[int] = []
         self.submitted_t = time.monotonic()
         self.first_token_t: Optional[float] = None
@@ -136,7 +147,16 @@ class RequestHandle:
 class Slot:
     """One decode lane: the sequence occupying it plus its paged-cache
     view. ``pos`` is the context length (tokens already in the cache);
-    ``last_token`` is the next decode step's input."""
+    ``last_token`` is the next decode step's input.
+
+    Prefix-cache bookkeeping: ``blocks`` is the TABLE view (shared prefix
+    blocks + private blocks); ``owned_blocks`` are the ones this sequence
+    allocated (decref'd at retirement — shared blocks are pinned via
+    ``prefix_path`` instead). ``cached_len`` prompt tokens were served
+    from the cache; ``cow`` asks the engine to copy one block
+    (src, dst) before the slot's first decode step; ``limit`` is the last
+    absolute position this sequence may ever write (spec-decode windows
+    mask writes past it at the scratch block)."""
 
     index: int
     handle: RequestHandle
@@ -145,6 +165,12 @@ class Slot:
     last_token: int
     generated: int = 0
     last_token_t: float = 0.0
+    cached_len: int = 0
+    owned_blocks: List[int] = field(default_factory=list)
+    shared_blocks: List[int] = field(default_factory=list)
+    prefix_path: Tuple = ()
+    cow: Optional[Tuple[int, int]] = None
+    limit: int = 0
 
     @property
     def request(self) -> Request:
@@ -175,8 +201,10 @@ class Scheduler:
         prefill_flops_budget: float = 0.0,
         flops_per_token: float = 0.0,
         max_prefill_tokens: int = 0,
+        prefix_cache: Optional[PrefixCache] = None,
     ):
         self.kv = kv
+        self.prefix = prefix_cache
         self.max_slots = int(max_slots)
         self.max_positions = int(max_position_embeddings)
         # per-step prefill token budget: the tighter of the explicit token
@@ -236,39 +264,135 @@ class Scheduler:
 
     # -- admission ----------------------------------------------------------
 
+    def _alloc_or_evict(self, n: int) -> Optional[List[int]]:
+        """Allocate ``n`` blocks, evicting cold radix nodes LRU-first when
+        the free list alone cannot satisfy the request (active sequences'
+        blocks are pinned and never touched)."""
+        blocks = self.kv.allocator.alloc(n)
+        if blocks is None and self.prefix is not None:
+            self.prefix.evict(n - self.kv.allocator.available)
+            blocks = self.kv.allocator.alloc(n)
+        return blocks
+
+    def _need_for(self, prompt_len: int, max_new: int, cached_len: int,
+                  bucket: int) -> int:
+        """Private blocks an admission must allocate on top of its
+        ``cached_len`` shared prefix tokens."""
+        bs = self.kv.block_size
+        n_cached = cached_len // bs
+        total_need = self.kv.blocks_for(prompt_len + max_new)
+        if prompt_len - cached_len:
+            # the rest of the budget, or the suffix bucket's overshoot
+            # past a power-of-two boundary — clipped at the per-sequence
+            # table capacity (the prefix prefill routes bucket lanes past
+            # it to scratch)
+            cover = min(n_cached + bucket // bs,
+                        self.kv.max_blocks_per_seq)
+            return max(total_need, cover) - n_cached
+        # fully cached: +1 for the copy-on-write duplicate of the block
+        # holding the last prompt position (the bootstrap decode step
+        # rewrites that position)
+        return total_need - n_cached + 1
+
     def admit(self) -> List[Tuple[Slot, int]]:
         """Admit waiting requests into free slots under the block + prefill
-        budget. Returns ``(slot, bucket_len)`` pairs the engine must
-        prefill this step. At least one request is admitted per call when a
-        slot and blocks are available, even if its bucket exceeds the
-        prefill cap — a cap below the smallest bucket must not deadlock."""
+        budget. Returns ``(slot, suffix_bucket)`` pairs: the engine must
+        prefill each slot's UNCACHED prompt suffix this step
+        (``suffix_bucket == 0`` means fully cached — no prefill dispatch
+        at all; the slot enters decode directly and its first token comes
+        from the next decode step). Only the uncached suffix is charged
+        against the prefill budget. At least one request is admitted per
+        call when a slot and blocks are available, even if its bucket
+        exceeds the prefill cap — a cap below the smallest bucket must not
+        deadlock."""
         self._drop_cancelled_waiting()
         admitted: List[Tuple[Slot, int]] = []
         budget_used = 0
+        cap_tokens = self.kv.max_blocks_per_seq * self.kv.block_size
+        bs = self.kv.block_size
         while self.waiting and self._free_slots:
             handle = self.waiting[0]
             req = handle.request
             prompt_len = len(req.tokens)
-            bucket = bucket_length(
-                prompt_len, self.kv.block_size,
-                self.kv.max_blocks_per_seq * self.kv.block_size)
-            if self.prefill_token_cap and admitted and (
+            cached_len, shared, path = 0, [], ()
+            if self.prefix is not None:
+                cached_len, shared, path = self.prefix.match(req.tokens)
+                if not getattr(handle, "_prefix_counted", False):
+                    # stats once per REQUEST: a deferred head-of-queue
+                    # request is re-matched every step and must not
+                    # inflate the hit-rate gauge on each retry
+                    handle._prefix_counted = True
+                    self.prefix.note_lookup(cached_len)
+            suffix = prompt_len - cached_len
+            bucket = bucket_length(suffix, bs, cap_tokens) if suffix else 0
+            if self.prefill_token_cap and admitted and bucket and (
                     budget_used + bucket > self.prefill_token_cap):
+                if path:
+                    self.prefix.release(path)
                 break
-            n_blocks = self._blocks_needed(prompt_len, req.max_new_tokens)
-            blocks = self.kv.allocator.alloc(n_blocks)
-            if blocks is None:
+            need = self._need_for(prompt_len, req.max_new_tokens,
+                                  cached_len, bucket)
+            owned = self._alloc_or_evict(need)
+            if owned is None and path:
+                # the match itself pins the path, which can make the
+                # request UNADMITTABLE forever (its own cached blocks are
+                # the only evictable ones) — retry as a cold request with
+                # the pins dropped before concluding the pool is full
+                self.prefix.release(path)
+                cached_len, shared, path = 0, [], ()
+                suffix = prompt_len
+                bucket = bucket_length(suffix, bs, cap_tokens)
+                if self.prefill_token_cap and admitted and (
+                        budget_used + bucket > self.prefill_token_cap):
+                    break  # requeued; admits (cold or hit) next step
+                need = self._need_for(prompt_len, req.max_new_tokens,
+                                      0, bucket)
+                owned = self._alloc_or_evict(need)
+            if owned is None:
+                if path:
+                    self.prefix.release(path)
                 break  # pool full; FIFO order preserved
+            # the request takes its own reference on every matched block
+            # (on top of the node pins), so a stray free() of a block a
+            # live sequence is reading raises instead of corrupting it
+            self.kv.allocator.incref(shared)
             self.waiting.pop(0)
             idx = self._free_slots.pop()
-            slot = Slot(index=idx, handle=handle, blocks=blocks,
-                        pos=prompt_len, last_token=req.tokens[-1],
-                        last_token_t=time.monotonic())
+            cow = None
+            if suffix:
+                table = shared + owned
+            else:
+                cow = (shared[-1], owned[0])
+                table = shared[:-1] + owned
+            slot = Slot(index=idx, handle=handle, blocks=table,
+                        pos=prompt_len - (0 if suffix else 1),
+                        last_token=req.tokens[-1],
+                        last_token_t=time.monotonic(),
+                        cached_len=cached_len, owned_blocks=owned,
+                        shared_blocks=list(shared),
+                        prefix_path=path, cow=cow,
+                        limit=prompt_len + req.max_new_tokens - 1)
             handle.status = "running"
+            handle.cached_tokens = cached_len
             self.slots[idx] = slot
             admitted.append((slot, bucket))
             budget_used += bucket
         return admitted
+
+    def note_prefilled(self, slot: Slot) -> List[int]:
+        """Offer a freshly prefilled prompt's full blocks to the radix
+        cache (the engine calls this right after the prefill dispatch).
+        Returns the block ids the tree adopted (it holds its own
+        references; the slot keeps decref'ing its ``owned_blocks`` at
+        retirement as usual)."""
+        if self.prefix is None:
+            return []
+        n_full = len(slot.request.tokens) // self.kv.block_size
+        if n_full == 0 or slot.cached_len >= n_full * self.kv.block_size:
+            return []
+        return self.prefix.insert(
+            slot.request.tokens[: n_full * self.kv.block_size],
+            slot.blocks[:n_full])
 
     def _drop_cancelled_waiting(self) -> None:
         self.sweep_waiting()
@@ -299,8 +423,14 @@ class Scheduler:
     # -- retirement ---------------------------------------------------------
 
     def retire(self, slot: Slot, status: str, reason: str) -> None:
-        """Free the slot's blocks, recycle the lane, resolve the handle."""
-        self.kv.allocator.free(slot.blocks)
+        """Drop the slot's block references (decref, NOT strict free —
+        blocks the radix cache adopted stay resident for future hits),
+        unpin its prefix path, recycle the lane, resolve the handle."""
+        self.kv.allocator.decref(slot.owned_blocks)
+        if slot.shared_blocks:
+            self.kv.allocator.decref(slot.shared_blocks)
+        if slot.prefix_path:
+            self.prefix.release(slot.prefix_path)
         del self.slots[slot.index]
         self._free_slots.append(slot.index)
         if status == "done":
@@ -334,7 +464,10 @@ class Scheduler:
     def decode_state(self) -> Dict[str, List]:
         """Fixed-shape per-lane arrays for the decode program. Inactive
         lanes feed token 0 at position 0 against the scratch block; their
-        outputs are discarded host-side."""
+        outputs are discarded host-side. ``limit`` bounds each lane's
+        writable positions (the speculative verify window routes writes
+        past it at the scratch block; parked lanes sit at 0 so their whole
+        window lands on scratch)."""
         S, MB = self.max_slots, self.kv.max_blocks_per_seq
         state = {
             "tokens": [0] * S,
@@ -344,6 +477,7 @@ class Scheduler:
             "seeds": [0] * S,
             "gen_idx": [0] * S,
             "active": [False] * S,
+            "limit": [0] * S,
         }
         for i, slot in self.slots.items():
             req = slot.request
@@ -354,4 +488,31 @@ class Scheduler:
             state["seeds"][i] = int(req.seed)
             state["gen_idx"][i] = slot.generated
             state["active"][i] = True
+            state["limit"][i] = slot.limit
         return state
+
+    # -- maintenance --------------------------------------------------------
+
+    def defrag(self) -> None:
+        """Compact live blocks to the low pool indices, rewriting EVERY
+        referencing view: each active sequence's table and ownership list
+        AND every radix node's block list (a node's table is as live as a
+        sequence's — a stale one would hand future hits permuted ids)."""
+        slots = self.active
+        tables: List[List[int]] = [list(s.blocks) for s in slots]
+        tables += [list(s.owned_blocks) for s in slots]
+        tables += [list(s.shared_blocks) for s in slots]
+        nodes: List = []
+        if self.prefix is not None:
+            nodes, node_tables = self.prefix.export_tables()
+            tables += node_tables
+        new = self.kv.defrag(tables)
+        n = len(slots)
+        for s, t in zip(slots, new[:n]):
+            s.blocks = t
+        for s, t in zip(slots, new[n:2 * n]):
+            s.owned_blocks = t
+        for s, t in zip(slots, new[2 * n:3 * n]):
+            s.shared_blocks = t
+        if self.prefix is not None:
+            self.prefix.adopt_tables(nodes, new[3 * n:])

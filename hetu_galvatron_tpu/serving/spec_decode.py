@@ -1,0 +1,161 @@
+"""Lossless speculative decoding: pluggable drafts + batched verify.
+
+One decode step emits one token per sequence no matter how fast the
+hardware is — the step is latency-bound, not FLOPs-bound. Speculative
+decoding drafts K candidate tokens per sequence CHEAPLY (host-side n-gram
+lookup by default; optionally a small draft model) and verifies all of
+them in ONE batched pass through a fixed-shape ``[max_batch_size, K+1]``
+jitted program (``ServingEngine._build_verify``), accepting the longest
+prefix the target model agrees with. Accepted tokens cost one step
+instead of one step each.
+
+**Losslessness.** The verify program computes, for every window row j,
+the target model's next token given the context *including the drafted
+tokens before j* — the same arithmetic as j sequential decode steps
+(``kv_cache.paged_sdpa_window`` mirrors the decode attention bit for
+bit). Greedy acceptance keeps a drafted token only while it EQUALS the
+target's own choice, so the emitted stream is exactly the non-speculative
+stream: the draft only ever changes how many steps it takes, never the
+tokens. Sampled (temperature > 0) rows do not speculate — row 0's sample
+uses the same per-request ``fold_in(seed, emitted-index)`` key the plain
+decode would, so those streams are unchanged too.
+
+**Drafts.** A draft is anything with ``propose(context, k) -> tokens``:
+
+* :class:`NgramDraft` — prompt-lookup decoding: find the most recent
+  earlier occurrence of the context's trailing n-gram and propose the
+  tokens that followed it. Free (no model, no device work) and strong on
+  the copy/repetition structure real generations are full of.
+* :class:`ModelDraft` — a small draft model behind the same interface:
+  greedy continuation via the offline ``models/generate.generate`` on a
+  bucketed (left-padded) context window, one jitted program per (bucket,
+  k). A wrong draft costs nothing but the wasted lane — verification
+  guarantees the stream either way.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Protocol, Sequence, runtime_checkable
+
+
+@runtime_checkable
+class DraftProvider(Protocol):
+    """The pluggable draft interface: given the request's full context
+    (prompt + emitted tokens, host-side ints), propose up to ``k`` next
+    tokens. Fewer (or zero) proposals are fine — unfilled lanes are
+    padded and simply fail verification."""
+
+    def propose(self, context: Sequence[int], k: int) -> List[int]:
+        ...
+
+
+class NgramDraft:
+    """Prompt-lookup decoding (n-gram matching against the request's own
+    context). Tries the longest trailing n-gram first (``max_n`` down to
+    ``min_n``); on a hit at position i, proposes ``context[i+n : i+n+k]``
+    — the continuation observed last time this n-gram appeared."""
+
+    def __init__(self, max_n: int = 3, min_n: int = 1):
+        if not (1 <= min_n <= max_n):
+            raise ValueError(f"bad ngram range [{min_n}, {max_n}]")
+        self.max_n = int(max_n)
+        self.min_n = int(min_n)
+
+    def propose(self, context: Sequence[int], k: int) -> List[int]:
+        ctx = list(context)
+        L = len(ctx)
+        for n in range(min(self.max_n, L - 1), self.min_n - 1, -1):
+            tail = ctx[L - n:]
+            # most recent earlier occurrence (scan right to left, the
+            # continuation seen last is likeliest to repeat)
+            for i in range(L - n - 1, -1, -1):
+                if ctx[i:i + n] == tail:
+                    return ctx[i + n:i + n + k]
+        return []
+
+
+class ModelDraft:
+    """A small causal LM as the draft, behind the same ``propose``
+    interface. The context is clipped to its trailing ``window`` tokens
+    and left-padded to a power-of-two bucket so there is one jitted
+    program per (bucket, k) — the clip is an approximation the verifier
+    makes harmless."""
+
+    def __init__(self, params, cfg, *, window: int = 128,
+                 compute_dtype=None):
+        import jax.numpy as jnp
+
+        self.params = params
+        self.cfg = cfg
+        self.window = int(min(window, cfg.max_position_embeddings))
+        self.compute_dtype = (compute_dtype if compute_dtype is not None
+                              else jnp.float32)
+        self._fns: Dict[tuple, object] = {}
+
+    def _fn_for(self, bucket: int, k: int):
+        import jax
+
+        from hetu_galvatron_tpu.models.generate import generate
+
+        key = (bucket, k)
+        fn = self._fns.get(key)
+        if fn is None:
+            fn = jax.jit(lambda p, t, n: generate(
+                p, t, self.cfg, k, prompt_lens=n, pad_id=0,
+                compute_dtype=self.compute_dtype))
+            self._fns[key] = fn
+        return fn
+
+    def propose(self, context: Sequence[int], k: int) -> List[int]:
+        import jax.numpy as jnp
+        import numpy as np
+
+        ctx = [t for t in context if t < self.cfg.vocab_size][-self.window:]
+        if not ctx or k < 1:
+            return []
+        bucket = 8
+        while bucket < len(ctx):
+            bucket *= 2
+        bucket = min(bucket, self.window)
+        ctx = ctx[-bucket:]
+        if len(ctx) + k > self.cfg.max_position_embeddings:
+            return []
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, bucket - len(ctx):] = ctx
+        out = self._fn_for(bucket, k)(
+            self.params, jnp.asarray(padded),
+            jnp.asarray([len(ctx)], jnp.int32))
+        return np.asarray(out)[0, bucket:].tolist()
+
+    def compile_count(self) -> int:
+        return sum(f._cache_size() for f in self._fns.values())
+
+
+def make_draft(serving, *, draft_params=None, draft_cfg=None
+               ) -> Optional[DraftProvider]:
+    """Build the draft the ServingArgs ask for (None when spec decode is
+    off). ``spec_draft="model"`` needs the draft checkpoint passed to the
+    engine (``draft_params``/``draft_cfg``)."""
+    if not serving.spec_decode:
+        return None
+    if serving.spec_draft == "model":
+        if draft_params is None or draft_cfg is None:
+            raise ValueError(
+                "serving.spec_draft='model' needs draft_params + draft_cfg "
+                "(the small draft checkpoint) passed to ServingEngine")
+        return ModelDraft(draft_params, draft_cfg)
+    return NgramDraft(max_n=serving.spec_ngram_max,
+                      min_n=serving.spec_ngram_min)
+
+
+def accept_length(drafted: Sequence[int], targets: Sequence[int],
+                  k_eff: int) -> int:
+    """Greedy acceptance: the longest prefix of ``drafted`` the target
+    model reproduced. ``targets[j]`` is the model's choice AFTER seeing
+    drafted[0..j-1]; drafted[j] survives iff it equals targets[j]. The
+    emitted tokens are then ``targets[0..a]`` (a accepted drafts + the
+    bonus token), which is exactly the non-speculative stream."""
+    a = 0
+    while a < k_eff and a < len(drafted) and drafted[a] == targets[a]:
+        a += 1
+    return a

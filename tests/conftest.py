@@ -123,6 +123,17 @@ _SLOW_TESTS = {
     "test_numpy_fallback_matches_cpp",
     "test_microbatch_accumulation_matches_full_batch",
     "test_microbatch_nonuniform_loss_mask_matches",
+    # shared-prefix serving acceptance drill (8-device mesh, two engine
+    # warmups x two variants) and secondary prefix/spec legs — the
+    # single-device hit-parity, spec-losslessness, and eviction tests
+    # stay fast-tier
+    "test_shared_prefix_drill_mesh8",
+    "test_serve_bench_ab_legs_importable",
+    "test_serve_bench_shared_prefix_trace",
+    "test_prefix_engine_defrag_mid_serving",
+    "test_suffix_bucket_overshoot_at_table_capacity",
+    "test_spec_eos_and_budget_mid_window",
+    "test_spec_sampled_lanes_match_plain_engine",
 }
 
 

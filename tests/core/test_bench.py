@@ -36,6 +36,10 @@ def _run_bench(tmp_path, extra_env, timeout=600):
         # test_pipeline_dispatch_bench), and it would crowd the 300s
         # watchdog budget
         "BENCH_COMPILED_OVERLAP": "0",
+        # likewise the default-on serving A/B legs (covered by
+        # tests/serving/test_serve_bench.py)
+        "BENCH_SERVE_PREFIX": "0",
+        "BENCH_SPEC_DECODE": "0",
     })
     env.update(extra_env)
     proc = subprocess.run(

@@ -9,10 +9,12 @@ import pytest
 from hetu_galvatron_tpu.core.args_schema import ModelArgs
 from hetu_galvatron_tpu.serving.kv_cache import (
     SCRATCH_BLOCK,
+    BlockAccountingError,
     BlockAllocator,
     PagedKVCache,
     gather_pages,
     paged_sdpa,
+    paged_sdpa_window,
     pool_pspecs,
     scatter_prefill,
     scatter_token,
@@ -59,6 +61,111 @@ def test_allocator_rejects_bad_frees():
     a.free(x)
     with pytest.raises(ValueError):
         a.free(x)  # double free
+
+
+def test_refcount_share_decref_lifecycle():
+    """Sharing semantics: incref adds an owner, decref drops one, the
+    block returns to the free list only when the LAST owner leaves."""
+    a = BlockAllocator(8)
+    x = a.alloc(2)
+    assert all(a.refcount(b) == 1 for b in x)
+    a.incref(x)  # second owner (e.g. the radix tree adopting the blocks)
+    assert all(a.refcount(b) == 2 for b in x)
+    assert a.decref(x) == []  # still co-owned: nothing freed
+    assert a.used == 2
+    assert sorted(a.decref(x)) == sorted(x)  # last owner out -> recycled
+    assert a.used == 0
+    with pytest.raises(BlockAccountingError):
+        a.decref(x)  # double free, typed
+    with pytest.raises(BlockAccountingError):
+        a.incref([x[0]])  # can't adopt an unallocated block
+
+
+def test_free_while_shared_raises_typed_error():
+    """Strict free() of a co-owned block must raise (a silent free would
+    yank a block out from under the other owner's table) — and the error
+    is typed so callers can tell bookkeeping bugs from other
+    ValueErrors."""
+    a = BlockAllocator(8)
+    x = a.alloc(1)
+    a.incref(x)
+    with pytest.raises(BlockAccountingError, match="shared"):
+        a.free(x)
+    assert a.refcount(x[0]) == 2  # nothing changed
+    a.decref(x)
+    a.free(x)  # sole owner again: strict free is fine
+    with pytest.raises(BlockAccountingError, match="double free"):
+        a.free(x)
+    with pytest.raises(BlockAccountingError):
+        a.free([0])  # scratch is never freeable
+    # a duplicated id within ONE call must raise, not double-release
+    # (validate-then-mutate would otherwise hand the block out twice)
+    y = a.alloc(1)
+    with pytest.raises(BlockAccountingError, match="duplicate"):
+        a.free(y + y)
+    with pytest.raises(BlockAccountingError, match="duplicate"):
+        a.decref(y + y)
+    assert a.refcount(y[0]) == 1  # untouched by the rejected calls
+
+
+def test_defrag_rewrites_every_referencing_table_and_keeps_refcounts():
+    """Compaction with refcount>1 blocks: the same block appears in
+    several tables (a sequence's view + the radix tree's view); defrag
+    must rename it consistently EVERYWHERE, preserve contents, and carry
+    the refcounts through the permutation."""
+    cfg = _cfg()
+    kv = PagedKVCache(cfg, num_blocks=9, block_size=4, max_seq_len=16,
+                      dtype=jnp.float32)
+    shared = kv.allocator.alloc(2)  # a cached prefix: seq + tree own it
+    kv.allocator.incref(shared)
+    hole = kv.allocator.alloc(1)
+    private = kv.allocator.alloc(1)
+    kv.allocator.decref(hole)  # leave a hole so compaction moves things
+    for j, b in enumerate(shared + private):
+        kv.pools[0]["k"] = kv.pools[0]["k"].at[b].set(float(j + 1))
+    seq_table = shared + private
+    tree_table = list(shared)
+    new_seq, new_tree = kv.defrag([seq_table, tree_table])
+    assert new_seq[:2] == new_tree  # shared ids renamed consistently
+    assert sorted(new_seq) == [1, 2, 3]  # compacted to the low indices
+    assert kv.allocator.refcount(new_tree[0]) == 2  # rc survived the move
+    assert kv.allocator.refcount(new_seq[2]) == 1
+    got = np.asarray(gather_pages(
+        kv.pools[0]["k"], jnp.asarray([new_seq], jnp.int32)))[0]
+    want = np.concatenate([np.full((4, cfg.kv_heads, cfg.head_dim), v)
+                           for v in (1.0, 2.0, 3.0)])
+    np.testing.assert_array_equal(got, want)
+    # decref to zero -> everything recycles cleanly under the new names
+    assert sorted(kv.allocator.decref(new_seq[2:])) == [new_seq[2]]
+    kv.allocator.decref(new_tree)
+    assert sorted(kv.allocator.decref(new_tree)) == sorted(new_tree)
+    assert kv.allocator.used == 0
+
+
+def test_defrag_rejects_table_referencing_free_block():
+    cfg = _cfg()
+    kv = PagedKVCache(cfg, num_blocks=6, block_size=4, max_seq_len=8,
+                      dtype=jnp.float32)
+    t = kv.allocator.alloc(2)
+    kv.allocator.decref(t[1:])
+    with pytest.raises(BlockAccountingError):
+        kv.defrag([t])  # t[1] is free — a stale table must be loud
+
+
+def test_paged_sdpa_window_matches_sequential_rows():
+    """Row j of a W-wide window == paged_sdpa at position start+j with
+    the same cache (the bit-parity the verify program and the
+    prefix-suffix prefill both ride on)."""
+    rng = np.random.RandomState(0)
+    S, W, T, nq, nkv, D = 2, 3, 16, 4, 2, 8
+    q = jnp.asarray(rng.randn(S, W, nq, D), jnp.float32)
+    ck = jnp.asarray(rng.randn(S, T, nkv, D), jnp.float32)
+    cv = jnp.asarray(rng.randn(S, T, nkv, D), jnp.float32)
+    start = jnp.asarray([2, 9], jnp.int32)
+    got = np.asarray(paged_sdpa_window(q, ck, cv, start))
+    for j in range(W):
+        want = np.asarray(paged_sdpa(q[:, j:j + 1], ck, cv, start + j))
+        np.testing.assert_array_equal(got[:, j:j + 1], want)
 
 
 def test_defrag_compacts_live_blocks():

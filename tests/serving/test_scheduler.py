@@ -2,6 +2,7 @@
 recycling, fixed-shape decode state."""
 
 import jax.numpy as jnp
+import numpy as np
 import pytest
 
 from hetu_galvatron_tpu.core.args_schema import ModelArgs
@@ -162,6 +163,167 @@ def test_decode_state_is_fixed_shape():
     # inactive lanes park on the scratch block at pos 0
     assert st["tables"][1] == [SCRATCH_BLOCK] * kv.max_blocks_per_seq
     assert st["pos"][1] == 0
+
+
+def _prefix_sched(num_blocks=33, block_size=4, max_seq_len=32,
+                  max_slots=4, **kw):
+    from hetu_galvatron_tpu.serving.prefix_cache import PrefixCache
+
+    cfg = ModelArgs(hidden_size=32, num_hidden_layers=2,
+                    num_attention_heads=4, vocab_size=64,
+                    max_position_embeddings=64,
+                    make_vocab_size_divisible_by=1)
+    kv = PagedKVCache(cfg, num_blocks=num_blocks, block_size=block_size,
+                      max_seq_len=max_seq_len, dtype=jnp.float32)
+    pc = PrefixCache(kv.allocator, block_size)
+    return Scheduler(kv, max_slots=max_slots, max_position_embeddings=64,
+                     prefix_cache=pc, **kw), kv, pc
+
+
+def _seed_cache(s, tokens, max_new=4):
+    """Run one request through admit -> note_prefilled -> retire so its
+    prompt's full blocks live in the radix tree."""
+    h = s.submit(Request(tokens=list(tokens), max_new_tokens=max_new))
+    (slot, bucket), = s.admit()
+    s.note_prefilled(slot)
+    s.retire(slot, "done", "length")
+    return h
+
+
+def test_prefix_admission_charges_only_uncached_suffix():
+    """A hit's admission cost is the SUFFIX bucket, not the prompt: with
+    an 8-token prefill budget, two hit requests (suffix bucket 4 each)
+    ride one step where cold twins (bucket 16) would be serialized."""
+    sys_toks = [7] * 12  # 3 full blocks
+    s, kv, pc = _prefix_sched(max_prefill_tokens=8)
+    _seed_cache(s, sys_toks)
+    for i in range(2):
+        s.submit(Request(tokens=sys_toks + [20 + i, 30 + i],
+                         max_new_tokens=2))
+    admitted = s.admit()
+    assert len(admitted) == 2  # 2 x bucket-4 suffixes fit the 8 budget
+    assert all(sl.cached_len == 12 for sl, _ in admitted)
+    assert all(b == 4 for _, b in admitted)
+    assert all(sl.handle.cached_tokens == 12 for sl, _ in admitted)
+    # cold twins of the same total length (14) bucket to 16 > 8: one per
+    # step (the never-deadlock clause), proving the charge really is the
+    # suffix, not the prompt
+    s2, _, _ = _prefix_sched(max_prefill_tokens=8)
+    for i in range(2):
+        s2.submit(Request(tokens=[50 + i] * 14, max_new_tokens=2))
+    assert len(s2.admit()) == 1
+
+
+def test_fully_cached_prompt_admits_cleanly():
+    """Zero uncached prefill tokens: no prefill dispatch (bucket 0), the
+    slot enters at pos = len-1 with a copy-on-write of the last block,
+    zero prefill-budget charge, and a FLOPs-derived budget divides
+    nothing by zero."""
+    sys_toks = [3] * 16  # exactly 4 blocks: a full-hit candidate
+    s, kv, pc = _prefix_sched(prefill_flops_budget=400.0,
+                              flops_per_token=100.0)  # cap = 4 tokens
+    _seed_cache(s, sys_toks)
+    h = s.submit(Request(tokens=list(sys_toks), max_new_tokens=4))
+    admitted = s.admit()
+    assert len(admitted) == 1
+    slot, bucket = admitted[0]
+    assert bucket == 0  # nothing to prefill
+    assert slot.cached_len == 16 and h.cached_tokens == 16
+    assert slot.pos == 15 and slot.last_token == sys_toks[-1]
+    assert slot.cow is not None
+    src, dst = slot.cow
+    assert src not in slot.blocks and dst in slot.blocks
+    assert dst in slot.owned_blocks  # the COW copy is private
+    # table covers the whole budget: 20 tokens / bs 4 = 5 blocks
+    assert len(slot.blocks) == 5
+    # a second full-hit rides the same admit even under the 4-token cap
+    # (charge is zero), while a cold 16-token twin would exceed it
+    h2 = s.submit(Request(tokens=list(sys_toks), max_new_tokens=4))
+    admitted2 = s.admit()
+    assert len(admitted2) == 1 and admitted2[0][1] == 0
+    del h2
+
+
+def test_retirement_decrefs_shared_blocks_stay_cached():
+    sys_toks = [9] * 8  # 2 blocks
+    s, kv, pc = _prefix_sched()
+    _seed_cache(s, sys_toks)
+    held = kv.allocator.used
+    assert pc.blocks_held == 2 and held == 2  # tree keeps the prefix
+    h = s.submit(Request(tokens=sys_toks + [1, 2], max_new_tokens=2))
+    (slot, bucket), = s.admit()
+    assert bucket == 4 and slot.cached_len == 8
+    shared = list(slot.blocks[:2])
+    # tree ref + the running request's own ref: a stray strict free()
+    # while a live sequence reads the blocks raises instead of corrupting
+    assert all(kv.allocator.refcount(b) == 2 for b in shared)
+    from hetu_galvatron_tpu.serving.kv_cache import BlockAccountingError
+    with pytest.raises(BlockAccountingError, match="shared"):
+        kv.allocator.free(shared)
+    s.note_prefilled(slot)  # tree adopts the new full block too? (10//4=2
+    # full blocks are exactly the cached ones -> nothing new)
+    s.retire(slot, "done", "length")
+    assert h.status == "done"
+    # shared prefix survives retirement; the request's privates are gone
+    assert pc.blocks_held == 2
+    assert all(kv.allocator.refcount(b) == 1 for b in shared)
+    assert kv.allocator.used == 2
+
+
+def test_pool_pressure_evicts_cold_radix_nodes():
+    """When the free list cannot satisfy an admission, unpinned radix
+    nodes are evicted LRU-first instead of stalling the queue."""
+    s, kv, pc = _prefix_sched(num_blocks=9, max_seq_len=32)  # 8 usable
+    _seed_cache(s, [5] * 16, max_new=4)  # tree holds 4 blocks
+    assert kv.allocator.available == 4
+    # needs 6 blocks (16 prompt + 8 new @ bs 4): must evict the tree
+    h = s.submit(Request(tokens=[6] * 16, max_new_tokens=8))
+    (slot, bucket), = s.admit()
+    assert h.status == "running"
+    assert pc.blocks_held < 4  # cache gave blocks back
+    del slot, bucket
+
+
+def test_self_pinned_prefix_cannot_livelock_admission():
+    """A request whose own match() pins the only evictable radix path
+    must not stall forever when the pool cannot also satisfy its private
+    need: admission drops the pins and retries COLD (evicting the now
+    unpinned path) before concluding the pool is full."""
+    s, kv, pc = _prefix_sched(num_blocks=8, block_size=4, max_seq_len=28,
+                              max_slots=2)
+    _seed_cache(s, [9] * 24, max_new=4)  # tree holds 6 of the 7 blocks
+    assert kv.allocator.available == 1
+    h = s.submit(Request(tokens=[9] * 24, max_new_tokens=4))
+    admitted = s.admit()  # full hit needs 2 blocks; only 1 free
+    assert len(admitted) == 1 and h.status == "running"
+    slot, bucket = admitted[0]
+    assert slot.cached_len == 0 and bucket > 0  # admitted cold
+    assert pc.blocks_held == 0  # its own prefix was sacrificed
+    s.retire(slot, "done", "length")
+    assert kv.allocator.used == pc.blocks_held  # accounting coherent
+
+
+def test_scheduler_defrag_rewrites_slots_and_radix():
+    s, kv, pc = _prefix_sched()
+    _seed_cache(s, [4] * 8)
+    h = s.submit(Request(tokens=[4] * 8 + [9, 9], max_new_tokens=2))
+    (slot, _), = s.admit()
+    old_content_block = slot.blocks[0]
+    kv.pools[0]["k"] = kv.pools[0]["k"].at[old_content_block].set(42.0)
+    s.defrag()
+    # every view renamed consistently: the tree's tables still name
+    # exactly the slot's shared-prefix blocks (under the NEW ids)
+    _, node_tables = pc.export_tables()
+    assert sorted(set(b for t in node_tables for b in t)
+                  ) == sorted(set(slot.blocks[:2]))
+    assert set(slot.owned_blocks) <= set(slot.blocks)
+    got = np.asarray(kv.pools[0]["k"][slot.blocks[0]])
+    np.testing.assert_array_equal(got, np.full_like(got, 42.0))
+    # allocator still coherent: retiring cleans up under the new names
+    s.note_prefilled(slot)
+    s.retire(slot, "done", "length")
+    assert h.status == "done"
+    assert kv.allocator.used == pc.blocks_held
 
 
 def test_handle_stream_and_result():

@@ -1,0 +1,177 @@
+"""Speculative decoding: draft providers, the greedy acceptance rule, and
+engine-level losslessness (spec streams bit-identical to plain decode and
+offline generate, accept rate > 0 on cyclic continuations, sampled lanes
+unchanged, zero steady-state recompiles)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hetu_galvatron_tpu.core.args_schema import ModelArgs, ServingArgs
+from hetu_galvatron_tpu.models.builder import init_causal_lm
+from hetu_galvatron_tpu.models.generate import generate
+from hetu_galvatron_tpu.observability.registry import MetricsRegistry
+from hetu_galvatron_tpu.serving.engine import ServingEngine
+from hetu_galvatron_tpu.serving.spec_decode import (
+    ModelDraft,
+    NgramDraft,
+    accept_length,
+    make_draft,
+)
+
+pytestmark = pytest.mark.serving
+
+
+def _cfg(**kw):
+    base = dict(
+        hidden_size=64, num_hidden_layers=2, num_attention_heads=4,
+        vocab_size=128, max_position_embeddings=128, seq_length=32,
+        hidden_act="swiglu", normalization="rmsnorm",
+        position_embedding_type="rope", tie_word_embeddings=False,
+        add_bias_linear=False, add_qkv_bias=False,
+        make_vocab_size_divisible_by=1, ffn_hidden_size=128)
+    base.update(kw)
+    return ModelArgs(**base)
+
+
+def _offline(params, cfg, prompt, n_new, cache={}):
+    key = (id(params), len(prompt), n_new)
+    fn = cache.get(key)
+    if fn is None:
+        fn = jax.jit(lambda p, t: generate(
+            p, t, cfg, n_new, pad_id=0, compute_dtype=jnp.float32))
+        cache[key] = fn
+    out = np.asarray(fn(params, jnp.asarray([prompt], jnp.int32)))
+    return out[0, len(prompt):].tolist()
+
+
+# ---------------------------------------------------------------------------
+# drafts + acceptance rule (host-side)
+# ---------------------------------------------------------------------------
+
+
+def test_ngram_draft_prompt_lookup():
+    d = NgramDraft(max_n=3, min_n=1)
+    # trailing 3-gram [1,2,3] occurred at the start; propose what followed
+    assert d.propose([1, 2, 3, 4, 5, 1, 2, 3], 2) == [4, 5]
+    # most RECENT earlier occurrence wins
+    assert d.propose([7, 9, 7, 8, 7], 2) == [8, 7]
+    # falls back to shorter n-grams before giving up
+    assert d.propose([5, 6, 1, 9, 4, 6], 1) == [1]
+    assert d.propose([1, 2, 3], 2) == []  # nothing repeats
+    assert d.propose([], 2) == []
+    with pytest.raises(ValueError):
+        NgramDraft(max_n=0)
+
+
+def test_accept_length_rule():
+    # targets[j] = model's choice after drafted[0..j-1]
+    assert accept_length([5, 6, 7], [5, 6, 7, 8], k_eff=3) == 3
+    assert accept_length([5, 9, 7], [5, 6, 7, 8], k_eff=3) == 1
+    assert accept_length([9, 6, 7], [5, 6, 7, 8], k_eff=3) == 0
+    assert accept_length([5, 6, 7], [5, 6, 7, 8], k_eff=1) == 1  # budget
+    assert accept_length([], [5], k_eff=3) == 0
+
+
+def test_model_draft_matches_offline_greedy():
+    cfg = _cfg()
+    params, _ = init_causal_lm(jax.random.key(0), cfg)
+    draft = ModelDraft(params, cfg, window=32)
+    ctx = np.random.RandomState(0).randint(0, 128, (11,)).tolist()
+    assert draft.propose(ctx, 4) == _offline(params, cfg, ctx, 4)
+    # jits once per (bucket, k): a second same-bucket call reuses it
+    n = draft.compile_count()
+    draft.propose(ctx + [1], 4)
+    assert draft.compile_count() == n
+
+
+def test_make_draft_dispatch():
+    sv = ServingArgs(spec_decode=True, spec_draft="ngram")
+    assert isinstance(make_draft(sv), NgramDraft)
+    assert make_draft(ServingArgs()) is None
+    with pytest.raises(ValueError, match="draft_params"):
+        make_draft(ServingArgs(spec_decode=True, spec_draft="model"))
+
+
+# ---------------------------------------------------------------------------
+# engine-level losslessness
+# ---------------------------------------------------------------------------
+
+
+def test_spec_streams_bit_identical_with_accepts():
+    """Greedy spec streams == plain engine streams == offline generate,
+    with a strictly positive accept rate (long continuations cycle, and
+    prompt-lookup predicts the cycle), at zero steady-state recompiles."""
+    cfg = _cfg()
+    params, _ = init_causal_lm(jax.random.key(0), cfg)
+    reg = MetricsRegistry()
+    rng = np.random.RandomState(0)
+    reqs = [(rng.randint(0, 128, (n,)).tolist(), 24)
+            for n in (10, 7, 13, 10)]
+
+    sv = ServingArgs(max_batch_size=4, kv_block_size=8, max_seq_len=64,
+                     max_new_tokens=24, spec_decode=True, spec_k=3)
+    eng = ServingEngine(params, cfg, sv, registry=reg,
+                        compute_dtype=jnp.float32)
+    eng.warmup(buckets=[8, 16])  # every bucket this workload reaches
+    warm = eng.compile_count()
+    handles = [eng.submit(p, max_new_tokens=m) for p, m in reqs]
+    steps = 0
+    while eng.scheduler.has_work():
+        eng.step()
+        steps += 1
+        assert steps < 500
+    assert eng.compile_count() == warm
+    for (p, m), h in zip(reqs, handles):
+        assert h.status == "done"
+        assert h.result(0) == _offline(params, cfg, p, m)
+    assert eng.spec_accept_rate() > 0.0
+    assert reg.counter("serve/drafted_tokens").value > 0
+    assert reg.counter("serve/spec_accepted_tokens").value > 0
+    # accepted tokens shrink the step count below one-token-per-step
+    total_emitted = sum(len(h.output) for h in handles)
+    decode_steps = total_emitted - len(reqs)  # prefill emits the first
+    assert steps < decode_steps + len(reqs) + 4  # strictly fewer steps
+
+
+def test_spec_sampled_lanes_match_plain_engine():
+    """temperature > 0 lanes do not speculate but still emit the same
+    per-request fold_in stream the plain engine produces."""
+    cfg = _cfg()
+    params, _ = init_causal_lm(jax.random.key(1), cfg)
+    prompt = np.random.RandomState(1).randint(0, 128, (9,)).tolist()
+    outs = []
+    for spec in (False, True):
+        sv = ServingArgs(max_batch_size=2, kv_block_size=8, max_seq_len=48,
+                         max_new_tokens=10, spec_decode=spec, spec_k=3)
+        eng = ServingEngine(params, cfg, sv, compute_dtype=jnp.float32)
+        h = eng.submit(prompt, temperature=0.8, seed=13)
+        eng.run_until_idle()
+        assert h.status == "done"
+        outs.append(h.result(0))
+    assert outs[0] == outs[1]
+    assert len(set(outs[0])) > 1  # genuinely sampling
+
+
+def test_spec_eos_and_budget_mid_window():
+    """EOS inside an accepted window retires the stream exactly at the
+    offline truncation point; a 1-token budget emits exactly one."""
+    cfg = _cfg()
+    params, _ = init_causal_lm(jax.random.key(2), cfg)
+    prompt = np.random.RandomState(2).randint(0, 128, (6,)).tolist()
+    free_run = _offline(params, cfg, prompt, 16)
+    eos = free_run[7]  # deep enough that spec windows cross it
+    want = free_run[: free_run.index(eos) + 1]
+    sv = ServingArgs(max_batch_size=2, kv_block_size=8, max_seq_len=48,
+                     max_new_tokens=16, spec_decode=True, spec_k=4,
+                     eos_id=eos)
+    eng = ServingEngine(params, cfg, sv, compute_dtype=jnp.float32)
+    h = eng.submit(prompt)
+    eng.run_until_idle()
+    assert h.status == "done" and h.finish_reason == "eos"
+    assert h.result(0) == want
+    assert eng.kv.allocator.used == 0
+    h1 = eng.submit(prompt, max_new_tokens=1)
+    eng.run_until_idle()
+    assert h1.result(0) == _offline(params, cfg, prompt, 1)

@@ -62,6 +62,13 @@ LEGS: Tuple[Tuple[str, str, bool], ...] = (
     # matmuls + flash) inside, vs the host engine with the same kernels
     # (tools/pipeline_dispatch_bench.py --kernels). A ratio, regresses UP.
     ("compiled_overlap", "compiled_overlap_vs_host", False),
+    # serving legs (tools/serve_bench.py run_prefix / run_spec):
+    # hit-vs-cold TTFT ratio under the radix prefix cache (below 1.0 =
+    # cached prefill really skipped; regresses UP) and speculative-decode
+    # vs plain tokens/sec (above 1.0 = accepted drafts beat the wider
+    # verify program; regresses DOWN)
+    ("serve_prefix", "serve_prefix_ttft_ratio", False),
+    ("spec_decode", "spec_decode_tokens_ratio", True),
 )
 
 
@@ -202,13 +209,18 @@ def smoke() -> int:
     render end-to-end without any bench history."""
     base = {"device": "TPU v5 lite",
             "legs": {"mfu_pct": 40.0, "tokens_per_sec": 100000.0,
-                     "compiled_vs_host": 0.7, "compiled_overlap": 0.75}}
+                     "compiled_vs_host": 0.7, "compiled_overlap": 0.75,
+                     "serve_prefix": 0.3, "spec_decode": 1.4}}
     same = {"device": "TPU v5 lite",
             "legs": {"mfu_pct": 39.2, "tokens_per_sec": 98000.0,
-                     "compiled_vs_host": 0.72, "compiled_overlap": 0.77}}
+                     "compiled_vs_host": 0.72, "compiled_overlap": 0.77,
+                     "serve_prefix": 0.31, "spec_decode": 1.37}}
     bad = {"device": "TPU v5 lite",
            "legs": {"mfu_pct": 40.1, "tokens_per_sec": 80000.0,
-                    "compiled_vs_host": 0.95, "compiled_overlap": 1.2}}
+                    "compiled_vs_host": 0.95, "compiled_overlap": 1.2,
+                    # serve_prefix regresses UP (hits stop skipping
+                    # prefill), spec_decode DOWN (drafts stop paying)
+                    "serve_prefix": 0.9, "spec_decode": 0.8}}
     other_dev = {"device": "cpu", "legs": {"mfu_pct": 5.0}}
 
     rows, ok_same = compare(base, same, threshold=0.10)
@@ -225,7 +237,8 @@ def smoke() -> int:
                   baseline_name="<synthetic baseline>", out=buf)
     healthy = (ok_same and not ok_bad
                and regressed == {"tokens_per_sec", "compiled_vs_host",
-                                 "compiled_overlap"}
+                                 "compiled_overlap", "serve_prefix",
+                                 "spec_decode"}
                and ok_dev
                and all(r["status"].startswith("skipped") for r in rows)
                and "NO VERDICT" in buf.getvalue())
