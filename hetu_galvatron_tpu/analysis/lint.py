@@ -39,13 +39,20 @@ import re
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
-# step-path modules for GAL001 (relative to the package root)
+# step-path modules for GAL001 (relative to the package root).
+# serving/scheduler.py and the observability event/recorder modules are
+# included so request-lifecycle event emission can never quietly grow a
+# host sync into the serving hot loop — events are host-side dicts by
+# contract.
 HOT_PATH_MODULES = (
     "runtime/trainer.py",
     "runtime/pipeline.py",
     "runtime/compiled_pipeline.py",
     "parallel/spmd.py",
     "serving/engine.py",
+    "serving/scheduler.py",
+    "observability/events.py",
+    "observability/recorder.py",
 )
 
 # mesh axis-name canon (runtime/mesh.py build_mesh): 'pp' + binary d-axes

@@ -203,6 +203,23 @@ def main(argv=None) -> int:
               f"spec_decode={serving.spec_decode}"
               + (f" (k={serving.spec_k}, draft={serving.spec_draft})"
                  if serving.spec_decode else ""), file=sys.stderr)
+    if serving.trace_requests:
+        # request-lifecycle tracing (observability/events.py): timelines
+        # + TTFT breakdown render with `summarize <metrics> --timeline`
+        print("request tracing: ON (per-request lifecycle events in the "
+              "metrics stream)", file=sys.stderr)
+    slo_parts = []
+    if serving.slo_ttft_ms > 0:
+        slo_parts.append(f"ttft<={serving.slo_ttft_ms}ms")
+    if serving.slo_itl_ms > 0:
+        slo_parts.append(f"itl<={serving.slo_itl_ms}ms")
+    if slo_parts:
+        # 0 means that SLO is off — never print an impossible 0ms target
+        print(f"SLO targets: {' '.join(slo_parts)} (attainment gauges "
+              "in serve/slo_*)", file=sys.stderr)
+    if serving.flight_dir:
+        print(f"flight recorder: dumps to {serving.flight_dir} on engine "
+              "fault", file=sys.stderr)
     reqs = _read_requests(kv)
     # compile decode + every prefill bucket BEFORE traffic: TTFT must
     # measure serving latency, not jit compilation

@@ -1,11 +1,24 @@
 """Run-summary CLI: ``python -m hetu_galvatron_tpu.cli.summarize
-<metrics.jsonl>``.
+<metrics.jsonl | flight_*.json> [--timeline [rid|all]]``.
 
 Reads the JSONL metrics stream a telemetry-enabled run writes
 (``observability/sinks.py`` record schema) and prints a human-readable
 throughput / MFU / memory / span summary. Counters and gauges carry their
 current value at each flush, so the LAST record per (name, labels) is the
 end-of-run state; histograms likewise snapshot cumulative percentiles.
+
+Request tracing (``serving.trace_requests``, ``observability/events.py``):
+when the stream carries per-request lifecycle events the summary adds a
+TTFT component breakdown (queue vs prefill vs first-decode, p50/p90/p99
+per component — the components are additive, so each request's split sums
+to its measured TTFT), an SLO attainment report, and — with
+``--timeline`` — per-request event timelines. Corrupt or torn event
+records are skipped with a warning, never fatal (the postmortem contract:
+this tool runs on files crashed runs left behind).
+
+Also renders flight-recorder dumps (``observability/recorder.py``
+``flight_<ts>.json``): reason, exception, the last-N-events ring, and the
+metric snapshot; a torn dump degrades to a warning.
 """
 
 from __future__ import annotations
@@ -92,6 +105,154 @@ def _load_hardware_json(path: str) -> Optional[Dict[str, Any]]:
     return None
 
 
+def _load_flight_json(path: str) -> Optional[Dict[str, Any]]:
+    """A flight-recorder dump (observability/recorder.py) rather than a
+    JSONL metrics stream. Sniffs the head of the file for the schema
+    marker BEFORE attempting a full parse, so a multi-GB per-token
+    metrics stream is not slurped just to decide it isn't a dump. A
+    torn/truncated dump fails json parsing and returns None — the caller
+    falls through to the line-tolerant JSONL loader, whose
+    skip-and-warn path covers it."""
+    try:
+        with open(path, errors="replace") as f:
+            head = f.read(4096)
+            if '"flight_recorder"' not in head:
+                return None
+            obj = json.loads(head + f.read())
+    except (json.JSONDecodeError, OSError):
+        return None
+    if isinstance(obj, dict) and obj.get("kind") == "flight_recorder":
+        return obj
+    return None
+
+
+def summarize_flight(obj: Dict[str, Any], path: str, out=None
+                     ) -> Dict[str, Any]:
+    """Render one flight-recorder dump: the crash reason, the exception
+    (if any), the tail of the event ring, and the metric snapshot — a
+    self-contained postmortem for a run that is no longer around to ask."""
+    out = out or sys.stdout
+    w = lambda s="": print(s, file=out)
+    headline: Dict[str, Any] = {"flight_reason": obj.get("reason")}
+    events = [e for e in obj.get("events", []) if isinstance(e, dict)]
+    metrics = [m for m in obj.get("metrics", []) if isinstance(m, dict)]
+    w(f"== flight recorder dump: {path} ==")
+    w(f"reason           {obj.get('reason', '?')}")
+    if obj.get("t"):
+        w(f"wall time        {obj['t']:.3f} (pid {obj.get('pid', '?')})")
+    exc = obj.get("exception")
+    if exc:
+        headline["flight_exception"] = exc.get("type")
+        w(f"exception        {exc.get('type', '?')}: "
+          f"{exc.get('message', '')}")
+        tb = (exc.get("traceback") or "").strip().splitlines()
+        for line in tb[-8:]:
+            w(f"  {line}")
+    headline["flight_events"] = len(events)
+    w(f"events in ring   {len(events)}")
+    for e in events[-16:]:
+        d = e.get("data") if isinstance(e.get("data"), dict) else {}
+        extra = " ".join(f"{k}={_fmt(v)}" for k, v in sorted(d.items())
+                         if k not in ("ev", "seq", "tm"))
+        tm = d.get("tm")
+        w(f"  {(_fmt(tm) + 'ms').rjust(12) if tm is not None else '?'.rjust(12)}"
+          f"  {d.get('ev', e.get('name', '?')):<14} {extra}")
+    if metrics:
+        w(f"metrics snapshot {len(metrics)} series (last values)")
+        for m in metrics[:20]:
+            lbl = ("{" + ",".join(f"{k}={v}" for k, v in
+                                  sorted((m.get('labels') or {}).items()))
+                   + "}" if m.get("labels") else "")
+            val = m.get("value", m.get("count"))
+            w(f"  {m.get('name', '?') + lbl:<44} {_fmt(val)}")
+        if len(metrics) > 20:
+            w(f"  ... and {len(metrics) - 20} more")
+    return headline
+
+
+# ---------------------------------------------------------------------------
+# request-lifecycle timelines (observability/events.py records)
+# ---------------------------------------------------------------------------
+
+
+def request_timelines(records: List[Dict[str, Any]]
+                      ) -> Tuple[Dict[int, List[Dict[str, Any]]], int]:
+    """Group ``request`` events by rid, ordered by the stream sequence
+    number. Corrupt records (torn writes, missing/mistyped fields) are
+    counted and skipped — a crashed run's stream must still summarize.
+    Well-formed events WITHOUT a rid (stream-level records like
+    ``engine_error``) are not corrupt; they simply belong to no
+    timeline. Returns ``(timelines, n_corrupt)``."""
+    tl: Dict[int, List[Dict[str, Any]]] = {}
+    bad = 0
+    for r in records:
+        if r.get("kind") != "event" or r.get("name") != "request":
+            continue
+        d = r.get("data")
+        if (not isinstance(d, dict) or "ev" not in d
+                or not isinstance(d.get("seq"), (int, float))):
+            bad += 1
+            continue
+        if "rid" not in d:
+            continue  # stream-level event (e.g. engine_error), not corrupt
+        try:
+            tl.setdefault(int(d["rid"]), []).append(d)
+        except (TypeError, ValueError):
+            bad += 1
+    for evs in tl.values():
+        evs.sort(key=lambda d: d["seq"])
+    return tl, bad
+
+
+def timeline_complete(evs: List[Dict[str, Any]]) -> bool:
+    """A complete, well-ordered lifecycle: starts at ``submit``, ends at
+    ``retire``, and the monotonic timestamps never run backwards (the
+    acceptance drill pins no orphaned / out-of-order events)."""
+    if not evs or evs[0]["ev"] != "submit" or evs[-1]["ev"] != "retire":
+        return False
+    tms = [e.get("tm") for e in evs if isinstance(e.get("tm"), (int, float))]
+    return all(a <= b for a, b in zip(tms, tms[1:]))
+
+
+def ttft_components(timelines: Dict[int, List[Dict[str, Any]]]
+                    ) -> Dict[str, List[float]]:
+    """Per-request TTFT component samples from the ``first_token`` events
+    (the engine makes the split additive: queue + prefill + decode ==
+    ttft)."""
+    comp: Dict[str, List[float]] = {"queue": [], "prefill": [],
+                                    "first_decode": [], "ttft": []}
+    for evs in timelines.values():
+        ft = next((e for e in evs if e["ev"] == "first_token"), None)
+        if ft is None:
+            continue
+        try:
+            vals = (float(ft["queue_ms"]), float(ft["prefill_ms"]),
+                    float(ft["decode_ms"]), float(ft["ttft_ms"]))
+        except (KeyError, TypeError, ValueError):
+            continue  # corrupt first_token event: skip the whole row
+        for key, v in zip(("queue", "prefill", "first_decode", "ttft"),
+                          vals):
+            comp[key].append(v)
+    return comp
+
+
+def render_timeline(rid: int, evs: List[Dict[str, Any]], w) -> None:
+    """One request's event listing, timestamps relative to submit."""
+    t0 = evs[0].get("tm") if evs else None
+    status = next((e.get("status") for e in reversed(evs)
+                   if e["ev"] == "retire"), "?")
+    w(f"request {rid} ({len(evs)} events, {status}"
+      + ("" if timeline_complete(evs) else ", INCOMPLETE") + "):")
+    for e in evs:
+        dt = (e["tm"] - t0 if isinstance(e.get("tm"), (int, float))
+              and isinstance(t0, (int, float)) else None)
+        extra = " ".join(
+            f"{k}={_fmt(v)}" for k, v in sorted(e.items())
+            if k not in ("ev", "seq", "tm", "rid"))
+        w(f"  {('+' + _fmt(dt) + 'ms').rjust(12) if dt is not None else '?'}"
+          f"  {e['ev']:<12} {extra}")
+
+
 def summarize_hardware(cfg: Dict[str, Any], path: str, out=None
                        ) -> Dict[str, Any]:
     """Render a hardware bandwidth JSON: per (group size, consecutiveness)
@@ -125,13 +286,19 @@ def summarize_hardware(cfg: Dict[str, Any], path: str, out=None
     return headline
 
 
-def summarize(path: str, out=None) -> Dict[str, Any]:
-    """Print the summary; returns the headline numbers (for tests)."""
+def summarize(path: str, out=None,
+              timeline: Optional[str] = None) -> Dict[str, Any]:
+    """Print the summary; returns the headline numbers (for tests).
+    ``timeline`` renders per-request event listings: ``"all"`` or a
+    specific rid (string)."""
     out = out or sys.stdout
     w = lambda s="": print(s, file=out)
     hw = _load_hardware_json(path)
     if hw is not None:
         return summarize_hardware(hw, path, out)
+    fl = _load_flight_json(path)
+    if fl is not None:
+        return summarize_flight(fl, path, out)
     records = load_records(path)
     latest = last_by_name(records)
 
@@ -300,6 +467,12 @@ def summarize(path: str, out=None) -> Dict[str, Any]:
                              + (f", {emitted['value']:,.0f} emitted)"
                                 if emitted else ")"))
             w(" ".join(parts))
+        qw = get("histogram", "serve/queue_wait_ms")
+        if qw and qw.get("count"):
+            headline["queue_wait_p50_ms"] = qw["p50"]
+            w(f"queue wait ms    p50 {_fmt(qw['p50'])} | p90 "
+              f"{_fmt(qw['p90'])} | p99 {_fmt(qw['p99'])} "
+              f"(n={qw['count']})")
         if ttft and ttft.get("count"):
             headline["ttft_p50_ms"] = ttft["p50"]
             w(f"TTFT ms          p50 {_fmt(ttft['p50'])} | p90 "
@@ -311,6 +484,20 @@ def summarize(path: str, out=None) -> Dict[str, Any]:
             w(f"inter-token ms   p50 {_fmt(itl['p50'])} | p90 "
               f"{_fmt(itl['p90'])} | p99 {_fmt(itl['p99'])} "
               f"(n={itl['count']})")
+        # SLO attainment report (serving.slo_ttft_ms / slo_itl_ms knobs)
+        slo_parts = []
+        for kind, gname, tname in (
+                ("TTFT", "serve/slo_ttft_attainment", "serve/slo_ttft_ms"),
+                ("ITL", "serve/slo_itl_attainment", "serve/slo_itl_ms")):
+            att = get("gauge", gname)
+            if att is not None:
+                tgt = get("gauge", tname)
+                headline[gname] = att["value"]
+                slo_parts.append(
+                    f"{kind}<={_fmt(tgt['value']) if tgt else '?'}ms "
+                    f"attainment {att['value'] * 100:.1f}%")
+        if slo_parts:
+            w("SLO              " + " | ".join(slo_parts))
         if srv_tps:
             headline["serve_tokens_per_sec"] = srv_tps["value"]
             w(f"serve tokens/sec {_fmt(srv_tps['value'])}")
@@ -322,6 +509,91 @@ def summarize(path: str, out=None) -> Dict[str, Any]:
             g = get("gauge", key)
             if g is not None:
                 w(f"{label:<21} {_fmt(g['value'])}")
+
+    # -- request-lifecycle tracing (observability/events.py) --
+    timelines, bad_ev = request_timelines(records)
+    if bad_ev:
+        print(f"warning: skipped {bad_ev} corrupt request event(s) in "
+              f"{path}", file=sys.stderr)
+    # stream-level fatal-engine events carry no rid; surface them here —
+    # they are the one record explaining why every request retired
+    eng_errs = [r["data"] for r in records
+                if r.get("kind") == "event" and r.get("name") == "request"
+                and isinstance(r.get("data"), dict)
+                and r["data"].get("ev") == "engine_error"]
+    if eng_errs:
+        headline["engine_error_events"] = len(eng_errs)
+        w()
+        for e in eng_errs:
+            w(f"ENGINE ERROR: {e.get('error', '?')}: "
+              f"{e.get('message', '')}")
+    if timelines:
+        complete = sum(1 for evs in timelines.values()
+                       if timeline_complete(evs))
+        headline["requests_traced"] = len(timelines)
+        headline["timelines_complete"] = complete
+        w()
+        w(f"-- request traces: {len(timelines)} requests "
+          f"({complete} complete timelines) --")
+        if complete < len(timelines):
+            w(f"   {len(timelines) - complete} INCOMPLETE timeline(s) "
+              "(crashed mid-request, or out-of-order events)")
+        comp = ttft_components(timelines)
+        if comp["ttft"]:
+            import numpy as _np
+
+            w(f"TTFT breakdown (n={len(comp['ttft'])}, additive "
+              "components)")
+            w(f"{'component':<14}{'p50 ms':>10}{'p90 ms':>10}"
+              f"{'p99 ms':>10}{'mean ms':>10}")
+            for key in ("queue", "prefill", "first_decode", "ttft"):
+                arr = _np.asarray(comp[key])
+                p50, p90, p99 = _np.percentile(arr, [50, 90, 99])
+                headline[f"ttft_{key}_p50_ms"] = float(p50)
+                w(f"{key:<14}{_fmt(float(p50)):>10}{_fmt(float(p90)):>10}"
+                  f"{_fmt(float(p99)):>10}{_fmt(float(arr.mean())):>10}")
+        cold = sum(1 for evs in timelines.values()
+                   for e in evs if e["ev"] == "admit" and e.get("cold_retry"))
+        if cold:
+            w(f"cold retries (prefix-pin livelock fallback)  {cold}")
+        if timeline:
+            w()
+            w("-- request timelines --")
+            if timeline == "all":
+                for rid in sorted(timelines):
+                    render_timeline(rid, timelines[rid], w)
+            else:
+                try:
+                    rid = int(timeline)
+                except ValueError:
+                    rid = -1
+                if rid in timelines:
+                    render_timeline(rid, timelines[rid], w)
+                else:
+                    w(f"(no traced request with rid {timeline})")
+
+    # -- goodput accounting (observability/goodput.py) --
+    gp = {n.split("/", 1)[1]: r for (k, n, lb), r in latest.items()
+          if k == "gauge" and n.startswith("goodput/")}
+    if gp:
+        w()
+        w("-- goodput --")
+        order = ("productive_step_s", "recompile_s", "checkpoint_save_s",
+                 "resume_replay_s", "restart_lost_s")
+        for key in order + tuple(
+                k for k in sorted(gp)
+                if k not in order + ("goodput_frac",)):
+            r = gp.get(key)
+            if r is None:
+                continue
+            if key.endswith("_s"):
+                headline[f"goodput/{key}"] = r["value"]
+                w(f"{key:<22} {_fmt(r['value'])} s")
+            else:
+                w(f"{key:<22} {_fmt(r['value'])}")
+        if "goodput_frac" in gp:
+            headline["goodput_frac"] = gp["goodput_frac"]["value"]
+            w(f"{'goodput':<22} {gp['goodput_frac']['value'] * 100:.1f}%")
 
     spans = [(json.loads(lb).get("path", "?"), r)
              for (k, n, lb), r in latest.items()
@@ -337,7 +609,7 @@ def summarize(path: str, out=None) -> Dict[str, Any]:
     rest = [((k, n, lb), r) for (k, n, lb), r in sorted(latest.items())
             if k in ("counter", "gauge")
             and not n.startswith(("train/", "device/", "plan/", "serve/",
-                                  "tp/", "audit/", "cost/"))]
+                                  "tp/", "audit/", "cost/", "goodput/"))]
     if rest:
         w()
         w("-- other counters/gauges --")
@@ -357,12 +629,25 @@ def summarize(path: str, out=None) -> Dict[str, Any]:
 
 
 def main(argv=None) -> int:
-    argv = argv if argv is not None else sys.argv[1:]
+    argv = list(argv if argv is not None else sys.argv[1:])
     if not argv or argv[0] in ("-h", "--help"):
         print("usage: python -m hetu_galvatron_tpu.cli.summarize "
-              "<metrics.jsonl>")
+              "<metrics.jsonl | flight_*.json> [--timeline [rid|all]]")
         return 0 if argv else 2
-    summarize(argv[0])
+    timeline = None
+    if "--timeline" in argv:
+        i = argv.index("--timeline")
+        argv.pop(i)
+        # optional value: "all" or a numeric rid — anything else (e.g.
+        # the metrics path when the flag comes first) is NOT consumed
+        timeline = "all"
+        if i < len(argv) and (argv[i] == "all" or argv[i].isdigit()):
+            timeline = argv.pop(i)
+    if not argv:
+        print("usage: python -m hetu_galvatron_tpu.cli.summarize "
+              "<metrics.jsonl | flight_*.json> [--timeline [rid|all]]")
+        return 2
+    summarize(argv[0], timeline=timeline)
     return 0
 
 

@@ -11,6 +11,7 @@ YAML, models/configs/*.yaml).
 from __future__ import annotations
 
 import sys
+import time
 from typing import Any, Dict
 
 import numpy as np
@@ -80,12 +81,41 @@ def train(args) -> Dict[str, Any]:
         emit_plan_telemetry(
             telemetry.registry, hpc, cfg,
             mixed_precision=args.parallel.mixed_precision != "fp32")
+    # goodput accounting (observability/goodput.py): wall-clock
+    # partitioned into productive / recompile / save / resume-replay /
+    # restart-lost; snapshots ride every checkpoint's train_state, so the
+    # goodput/* gauges survive preemption with the model state
+    from hetu_galvatron_tpu.observability.goodput import GoodputTracker
+
+    goodput = GoodputTracker()
+    # crash-forensics flight recorder (observability/recorder.py): dumps
+    # flight_<ts>.json on crash / trapped signal / rerun halt. Directory:
+    # observability.flight_dir, else (when telemetry owns a stream) the
+    # metrics file's directory
+    recorder = None
+    if jax.process_index() == 0 and (telemetry is not None
+                                     or args.observability.flight_dir):
+        import os as _os
+
+        from hetu_galvatron_tpu.observability.recorder import FlightRecorder
+
+        fdir = args.observability.flight_dir
+        if fdir is None:
+            fdir = _os.path.dirname(_os.path.abspath(
+                args.observability.metrics_path or _os.path.join(
+                    args.logging.tensorboard_dir or ".", "metrics.jsonl")))
+        recorder = FlightRecorder(
+            registry=(telemetry.registry if telemetry is not None
+                      else None),
+            out_dir=fdir, capacity=args.observability.flight_events)
+        recorder.note("run_start", plan=hpc.describe(), world=world)
     profiler = RuntimeProfiler(args, world_size=world,
                                rank=jax.process_index())
     rerun = RerunStateMachine(args.rerun)
     # preemption guard + at-step-k fault drill (runtime/supervisor.py):
     # SIGTERM/SIGINT become a checkpoint-and-exit at the next step boundary
-    guard = PreemptionGuard(enabled=args.supervisor.graceful_signals)
+    guard = PreemptionGuard(enabled=args.supervisor.graceful_signals,
+                            recorder=recorder)
     drill = FaultDrill(args.rerun)
     start_iter = 0
 
@@ -199,7 +229,11 @@ def train(args) -> Dict[str, Any]:
             batches = step
         ts = {"step": step, "seed": args.train.seed, "telemetry_step": step,
               "batches_consumed": batches if calc is None else None,
-              "consumed_samples": samples if calc is not None else None}
+              "consumed_samples": samples if calc is not None else None,
+              # goodput totals as of this commit + a wall stamp: the
+              # resuming process books the commit-to-resume gap (dead
+              # attempt's discarded work + downtime) as restart_lost
+              "goodput": goodput.state_dict()}
         if rerun.enabled:
             ts["rerun"] = rerun.state_dict()
         return ts
@@ -207,12 +241,13 @@ def train(args) -> Dict[str, Any]:
     def maybe_save(it, sp, so):
         ck = args.ckpt
         if ck.save and ck.save_interval and (it + 1) % ck.save_interval == 0:
-            save_checkpoint(ck.save, it + 1, sp, so, hpc=hpc,
-                            async_save=ck.async_save,
-                            train_state=train_state_at(
-                                it + 1, consumed_box[0],
-                                batches=data_iter.batches_consumed),
-                            keep_last=ck.keep_last)
+            with goodput.measure("checkpoint_save"):
+                save_checkpoint(ck.save, it + 1, sp, so, hpc=hpc,
+                                async_save=ck.async_save,
+                                train_state=train_state_at(
+                                    it + 1, consumed_box[0],
+                                    batches=data_iter.batches_consumed),
+                                keep_last=ck.keep_last)
             state.log(f"saved checkpoint at iter {it + 1}")
 
     def maybe_resume(sp, so):
@@ -235,13 +270,20 @@ def train(args) -> Dict[str, Any]:
         if args.ckpt.load:
             ckdir = latest_checkpoint(args.ckpt.load)
             if ckdir:
-                sp, so, start = load_checkpoint(
-                    ckdir, sp, so, hpc=hpc,
-                    strict_plan=args.ckpt.distributed_checkpoint)
+                with goodput.measure("resume_replay"):
+                    sp, so, start = load_checkpoint(
+                        ckdir, sp, so, hpc=hpc,
+                        strict_plan=args.ckpt.distributed_checkpoint)
                 state.log(f"resumed from {ckdir} at iter {start}")
                 meta = read_checkpoint_meta(ckdir)
                 stored = meta.get("hybrid_parallel_config") or {}
                 ts = meta.get("train_state") or {}
+                if ts.get("goodput"):
+                    # restore committed totals; the wall gap since the
+                    # commit lands in restart_lost
+                    goodput.load_state_dict(ts["goodput"])
+                if recorder is not None:
+                    recorder.note("resume", ckdir=ckdir, step=start)
                 sbsz = stored.get("global_bsz")
                 if ts.get("seed") not in (None, args.train.seed):
                     state.log(
@@ -312,7 +354,8 @@ def train(args) -> Dict[str, Any]:
                 if telemetry is not None:
                     telemetry.resume_from(ts.get("telemetry_step", start),
                                           samples=resumed_samples)
-                skip_batches(data_iter, skip)
+                with goodput.measure("resume_replay"):
+                    skip_batches(data_iter, skip)
         return sp, so, start
 
     use_dropout = (cfg.hidden_dropout > 0.0 or cfg.attention_dropout > 0.0)
@@ -328,6 +371,7 @@ def train(args) -> Dict[str, Any]:
         try:
             for it in range(start_iter, args.train.train_iters):
                 profiler.time_start(it)
+                it_t0 = time.perf_counter()
                 consumed_prev = consumed_box[0]
                 if calc is not None:
                     if calc.update(consumed_box[0]):
@@ -359,6 +403,13 @@ def train(args) -> Dict[str, Any]:
                             calc.current_running_global_batch_size
                     telemetry(it, metrics)
                 profiler.time_end(it, sync=metrics.get("loss"))
+                # goodput: the synced step wall (profiler.time_end blocks
+                # on the loss). Each attempt's first iteration pays the
+                # jit compile, booked as recompile, not productive;
+                # checkpoint saves are measured separately below
+                goodput.add(
+                    "recompile" if it == start_iter else "productive_step",
+                    time.perf_counter() - it_t0)
                 profiler.iteration_log(it, metrics, lr=float(schedule(it)))
                 # at-step-k fault drill: may corrupt the loss (nan/spike,
                 # exercising the rerun machine), raise InjectedCrash, or
@@ -390,19 +441,28 @@ def train(args) -> Dict[str, Any]:
                 if exit_code is not None:
                     state.log(f"rerun machine requested exit (code {exit_code});"
                               " checkpointing pre-fault state")
+                    if recorder is not None:
+                        # NaN/validation halt: leave the postmortem (ring
+                        # + metric snapshot) next to the metrics stream
+                        recorder.dump(f"rerun_exit_{exit_code}")
                     if args.ckpt.save and prev is not None:
                         # save the PRE-update state at iter `it`: the faulty
                         # update must not be persisted, and the relaunch re-runs
                         # the suspect iteration to disambiguate
-                        wait_for_checkpoints()  # never race an in-flight save
-                        save_checkpoint(
-                            args.ckpt.save, it, prev[0], prev[1], hpc=hpc,
-                            # position excludes the suspect iteration's
-                            # batch: the relaunch must re-consume it
-                            train_state=train_state_at(
-                                it, consumed_prev,
-                                batches=data_iter.batches_consumed - 1),
-                            keep_last=args.ckpt.keep_last)
+                        with goodput.measure("checkpoint_save"):
+                            # never race an in-flight save; the drain is
+                            # save time too (async saves bill their wall
+                            # here, not at dispatch)
+                            wait_for_checkpoints()
+                            save_checkpoint(
+                                args.ckpt.save, it, prev[0], prev[1],
+                                hpc=hpc,
+                                # position excludes the suspect iteration's
+                                # batch: the relaunch must re-consume it
+                                train_state=train_state_at(
+                                    it, consumed_prev,
+                                    batches=data_iter.batches_consumed - 1),
+                                keep_last=args.ckpt.keep_last)
                     break
                 if guard.requested():
                     # preemption/interrupt at a step boundary: the update
@@ -420,21 +480,32 @@ def train(args) -> Dict[str, Any]:
                                         (it + 1) % ck.save_interval == 0):
                         # the interval save above did not already cover
                         # this exact step
-                        wait_for_checkpoints()
-                        save_checkpoint(
-                            ck.save, it + 1, sp, so, hpc=hpc,
-                            train_state=train_state_at(
-                                it + 1, consumed_box[0],
-                                batches=data_iter.batches_consumed),
-                            keep_last=ck.keep_last)
+                        with goodput.measure("checkpoint_save"):
+                            wait_for_checkpoints()
+                            save_checkpoint(
+                                ck.save, it + 1, sp, so, hpc=hpc,
+                                train_state=train_state_at(
+                                    it + 1, consumed_box[0],
+                                    batches=data_iter.batches_consumed),
+                                keep_last=ck.keep_last)
                     break
+        except BaseException as e:
+            # crash forensics BEFORE re-raising: the dump (ring + metric
+            # snapshot + this traceback) is atomic and dump() never
+            # raises, so the original fault surfaces untouched
+            if recorder is not None:
+                recorder.dump("crash", exc=e)
+            raise
         finally:
             guard.__exit__()
             try:
                 # drain async saves even on the crash path: a supervised
                 # in-process restart must never inherit live background
-                # writes or stale pending commits from a dead attempt
-                wait_for_checkpoints()
+                # writes or stale pending commits from a dead attempt.
+                # The blocking drain IS checkpoint time — async saves
+                # bill their real wall here, not at dispatch
+                with goodput.measure("checkpoint_save"):
+                    wait_for_checkpoints()
             except Exception as e:  # noqa: BLE001 — never mask the crash
                 state.log(f"warning: async checkpoint drain failed: {e}")
             # crash-safe: flush an open XLA trace window + the metrics
@@ -487,6 +558,9 @@ def train(args) -> Dict[str, Any]:
                 except Exception as e:  # noqa: BLE001 — never mask the crash
                     state.log(f"warning: plan audit failed: {e}")
             if telemetry is not None:
+                # export the goodput partition before the final flush so
+                # the last records in the stream carry it
+                goodput.flush(telemetry.registry)
                 telemetry.close()
         return sp, so
 
@@ -600,7 +674,8 @@ def train(args) -> Dict[str, Any]:
 
         sp, so = run_loop(sp, so, finish_tp_overlap_setup(spmd_step))
 
-    wait_for_checkpoints()
+    with goodput.measure("checkpoint_save"):
+        wait_for_checkpoints()
     test_loss = None
     if (test_iter is not None and "fn" in eval_box and exit_code is None
             and losses):
@@ -616,6 +691,10 @@ def train(args) -> Dict[str, Any]:
     return {"losses": losses, "val_losses": val_losses,
             "test_loss": test_loss, "iter_ms": profiler.filtered_time_ms(),
             "rerun": rerun.report() if rerun.enabled else None,
+            "goodput": {"totals": dict(goodput.totals),
+                        "frac": goodput.goodput(),
+                        "restarts_survived": goodput.restarts_survived},
+            "flight_dumps": list(recorder.dumped) if recorder else [],
             "exit_code": exit_code}
 
 

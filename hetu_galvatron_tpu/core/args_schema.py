@@ -337,6 +337,13 @@ class ObservabilityArgs(BaseModel):
     # pairs price the audit's predicted collective times; None = volume-
     # only audit (no fitted hardware profile at hand)
     audit_hardware_config: Optional[str] = None
+    # crash-forensics flight recorder (observability/recorder.py):
+    # directory for flight_<ts>.json dumps on crash / trapped signal /
+    # rerun-machine halt. None derives the metrics stream's directory
+    # when observability is enabled; setting it explicitly enables the
+    # recorder even with enabled=false
+    flight_dir: Optional[str] = None
+    flight_events: int = 256
 
 
 class ServingArgs(BaseModel):
@@ -395,6 +402,22 @@ class ServingArgs(BaseModel):
     # bind address for the endpoint; loopback by default — the endpoint
     # is unauthenticated, so exposing it (0.0.0.0) is an explicit choice
     metrics_host: str = "127.0.0.1"
+    # per-request lifecycle tracing (observability/events.py): structured
+    # submit/admit/prefill/decode/retire events with a stable request id,
+    # written through the metrics sinks; cli/summarize.py rebuilds
+    # timelines and the TTFT component breakdown. Off by default — the
+    # JSONL stream grows per token when on
+    trace_requests: bool = False
+    # SLO targets in milliseconds (0 = none): when set, the engine
+    # exports serve/slo_ttft_attainment / serve/slo_itl_attainment
+    # gauges (share of observations inside the target)
+    slo_ttft_ms: float = 0.0
+    slo_itl_ms: float = 0.0
+    # crash-forensics flight recorder (observability/recorder.py):
+    # directory for flight_<ts>.json dumps on a fatal engine error; None
+    # keeps the in-memory ring only (no artifact)
+    flight_dir: Optional[str] = None
+    flight_events: int = 256
 
 
 class RerunArgs(BaseModel):

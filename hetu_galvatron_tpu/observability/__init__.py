@@ -13,6 +13,18 @@ The measurement substrate the ROADMAP's "measurably faster" contract needs:
   percentiles, model-FLOPs utilization (FLOPs accounting lives in
   ``core/cost_model/cost.py``), device memory gauges, and per-strategy
   predicted comm volume from the plan JSON.
+* :mod:`events` — per-request lifecycle event stream for the serving
+  stack (submit/admit/prefill/decode/retire with a stable request id),
+  written through the same sinks so ``cli/summarize.py`` can rebuild a
+  timeline and a TTFT component breakdown per request.
+* :mod:`recorder` — crash-forensics flight recorder: bounded ring of
+  recent events + metric snapshots, dumped atomically
+  (``flight_<ts>.json``) on fault/signal/NaN-halt without ever masking
+  the real traceback.
+* :mod:`goodput` — wall-clock partitioned into productive-step /
+  checkpoint-save / restart-lost / recompile / resume-replay time,
+  persisted across restarts through the checkpoint ``train_state``
+  payload.
 
 Everything here is host-side and sync-free: nothing in the hot loop calls
 ``float()`` on a device value (see ``TrainingTelemetry``'s lagged drain),
@@ -56,6 +68,9 @@ from hetu_galvatron_tpu.observability.prometheus import (
     MetricsHTTPServer,
     prometheus_text,
 )
+from hetu_galvatron_tpu.observability.events import EventStream
+from hetu_galvatron_tpu.observability.recorder import FlightRecorder
+from hetu_galvatron_tpu.observability.goodput import GoodputTracker
 
 __all__ = [
     "Counter",
@@ -83,4 +98,7 @@ __all__ = [
     "maybe_record_jit_cost",
     "MetricsHTTPServer",
     "prometheus_text",
+    "EventStream",
+    "FlightRecorder",
+    "GoodputTracker",
 ]

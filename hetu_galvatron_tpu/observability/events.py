@@ -1,0 +1,110 @@
+"""Per-request lifecycle event stream (the serving half of observability).
+
+PR 1 gave the serving stack aggregate gauges (``serve/ttft_ms`` et al.);
+this module records *why* an individual request saw the latency it did: a
+monotonic-clocked structured event per lifecycle transition, written as
+ordinary registry events (``kind: "event"``, ``name: "request"``) through
+the existing JSONL sinks, so one metrics stream carries both the
+aggregates and the per-request story.
+
+Event vocabulary (the ``ev`` field of each record; every request's
+timeline starts with ``submit`` and ends with ``retire``):
+
+=============  ==========================================================
+``submit``     request entered the system (prompt_len, max_new)
+``admit``      scheduler granted a slot (slot, queue_ms, cached_len,
+               hit_blocks, bucket; ``cold_retry`` marks the prefix-pin
+               livelock fallback — the match pinned the only evictable
+               blocks, so admission retried cold)
+``prefill``    prefill dispatch for the uncached suffix (bucket, suffix,
+               cached, ms)
+``first_token`` TTFT with its additive component split: queue_ms +
+               prefill_ms + decode_ms == ttft_ms by construction
+``decode``     one plain decode window emitted one token (pos)
+``verify``     one speculative window (drafted, accepted; tokens emitted
+               is bounded by accepted+1 but mid-window EOS/length
+               retirement can cut it short — ``retire.generated`` is the
+               authoritative per-request total)
+``retire``     terminal transition (status, reason, generated);
+               ``queued=True`` marks a request resolved before admission
+=============  ==========================================================
+
+Records carry a global monotonic sequence number (``seq``) and a
+monotonic-clock millisecond timestamp (``tm``), so a timeline can be
+re-assembled and its ordering *verified* after the fact
+(``cli/summarize.py::request_timelines``) — orphaned or out-of-order
+events are a bug the acceptance drill pins, not a rendering wart.
+
+Cost contract: emission is host-side dict assembly + a buffered sink
+write — no device values, no syncs (``analysis/lint.py`` GAL001 covers
+this module). With ``enabled=False`` and no taps attached, ``emit`` is a
+single attribute check; taps (the flight recorder's ring buffer) still
+receive events when the sink stream is off, so a crash dump has context
+even for untraced runs.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from hetu_galvatron_tpu.observability.registry import (
+    MetricsRegistry,
+    get_registry,
+)
+
+# the registry-event name every lifecycle record is filed under
+REQUEST_EVENT = "request"
+
+# terminal event: a timeline missing it is incomplete (crashed run)
+TERMINAL_EV = "retire"
+
+
+class EventStream:
+    """Structured request-lifecycle event emitter.
+
+    ``enabled`` gates the sink write (the JSONL stream); taps — e.g.
+    :class:`~hetu_galvatron_tpu.observability.recorder.FlightRecorder`
+    — always receive events, so crash forensics works even when the
+    full stream is off. A tap that raises is counted
+    (``tap_errors``) and skipped; event emission must never take down
+    the serving loop it instruments.
+    """
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None, *,
+                 enabled: bool = True, name: str = REQUEST_EVENT):
+        self.registry = registry if registry is not None else get_registry()
+        self.enabled = bool(enabled)
+        self.name = name
+        self.tap_errors = 0
+        self._taps: List[Callable[[str, Dict[str, Any]], None]] = []
+        self._seq = itertools.count()
+
+    def add_tap(self, fn: Callable[[str, Dict[str, Any]], None]) -> None:
+        """Subscribe ``fn(name, data)`` to every emitted event (called
+        synchronously, exceptions swallowed-and-counted)."""
+        self._taps.append(fn)
+
+    def emit(self, ev: str, rid: Optional[int] = None,
+             **fields: Any) -> Optional[Dict[str, Any]]:
+        """Record one lifecycle event; returns the data dict (or None on
+        the disabled fast path). ``seq`` totally orders events within a
+        stream; ``tm`` is monotonic milliseconds (duration arithmetic,
+        never wall-clock)."""
+        if not self.enabled and not self._taps:
+            return None
+        data: Dict[str, Any] = {"ev": ev, "seq": next(self._seq),
+                                "tm": time.monotonic() * 1000.0}
+        if rid is not None:
+            data["rid"] = int(rid)
+        data.update(fields)
+        for tap in self._taps:
+            try:
+                tap(self.name, data)
+            except Exception:  # noqa: BLE001 — a broken tap must not
+                # break serving; the count surfaces it
+                self.tap_errors += 1
+        if self.enabled:
+            self.registry.event(self.name, data)
+        return data

@@ -24,10 +24,12 @@ lookup + float op, and snapshots read plain attributes).
 
 from __future__ import annotations
 
+import json
 import re
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Dict, Optional
+from typing import Any, Callable, Dict, Optional
 
 from hetu_galvatron_tpu.observability.registry import (
     MetricsRegistry,
@@ -97,15 +99,47 @@ class MetricsHTTPServer:
     asked for a metrics port wants to hear the port is taken, not serve
     silently unscrapeable. The endpoint is unauthenticated, so the
     default bind is loopback-only; pass ``host="0.0.0.0"`` (or
-    ``serving.metrics_host``) to expose it to an external scraper."""
+    ``serving.metrics_host``) to expose it to an external scraper.
+
+    ``/healthz`` answers liveness probes (load generators, k8s) with a
+    tiny JSON body — 200 + uptime and last-step age — so probes never
+    pay for (or depend on) the full text exposition. The serving engine
+    calls :meth:`note_step` each step; ``health_fn`` lets a host process
+    merge extra fields into the response (guarded: a failing hook
+    reports itself instead of breaking the probe)."""
 
     def __init__(self, registry: Optional[MetricsRegistry] = None,
-                 port: int = 0, host: str = "127.0.0.1"):
+                 port: int = 0, host: str = "127.0.0.1",
+                 health_fn: Optional[Callable[[], Dict[str, Any]]] = None):
         self._registry = registry
         self.host = host
         self.port = port
+        self.health_fn = health_fn
+        self._t_start: Optional[float] = None
+        self._last_step_t: Optional[float] = None
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+
+    def note_step(self) -> None:
+        """Mark one unit of forward progress (engine/train step); the
+        ``/healthz`` ``last_step_age_s`` field reads this."""
+        self._last_step_t = time.monotonic()
+
+    def health(self) -> Dict[str, Any]:
+        now = time.monotonic()
+        payload: Dict[str, Any] = {
+            "status": "ok",
+            "uptime_s": (now - self._t_start
+                         if self._t_start is not None else 0.0),
+            "last_step_age_s": (now - self._last_step_t
+                                if self._last_step_t is not None else None),
+        }
+        if self.health_fn is not None:
+            try:
+                payload.update(self.health_fn() or {})
+            except Exception as e:  # noqa: BLE001 — probe must stay alive
+                payload["health_fn_error"] = f"{type(e).__name__}: {e}"
+        return payload
 
     @property
     def registry(self) -> MetricsRegistry:
@@ -119,13 +153,18 @@ class MetricsHTTPServer:
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 — http.server API
-                if self.path.split("?")[0] not in ("/metrics", "/"):
+                route = self.path.split("?")[0]
+                if route == "/healthz":
+                    body = json.dumps(server.health()).encode()
+                    ctype = "application/json"
+                elif route in ("/metrics", "/"):
+                    body = prometheus_text(server.registry).encode()
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
+                else:
                     self.send_error(404)
                     return
-                body = prometheus_text(server.registry).encode()
                 self.send_response(200)
-                self.send_header("Content-Type",
-                                 "text/plain; version=0.0.4; charset=utf-8")
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -136,6 +175,7 @@ class MetricsHTTPServer:
         self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
         self._httpd.daemon_threads = True
         self.port = self._httpd.server_address[1]
+        self._t_start = time.monotonic()
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True,
             name="metrics-http")

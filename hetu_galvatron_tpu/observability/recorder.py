@@ -1,0 +1,163 @@
+"""Crash-forensics flight recorder: a bounded ring of recent events +
+metric snapshots, dumped atomically on fault.
+
+A dead run should leave a self-contained postmortem artifact. The
+recorder keeps the last N structured events (tapped off an
+:class:`~hetu_galvatron_tpu.observability.events.EventStream` and/or
+recorded directly via :meth:`note`) in a ``collections.deque`` ring; on a
+fault, signal, or NaN-halt, :meth:`dump` snapshots every registry metric
+and writes one ``flight_<ts>.json`` with the same tmp+rename atomicity
+discipline as checkpoints (``runtime/checkpoint.py::_commit``) — a
+torn dump is a ``.tmp`` file readers never select, not a half-valid JSON.
+
+The dump contract mirrors PR 6's audit hook: **dumping must never mask
+the real traceback**. Every failure inside :meth:`dump` is swallowed into
+``last_error`` and the method returns ``None`` — the caller's crash path
+(engine abort, trainer finally, PreemptionGuard) re-raises the *original*
+fault untouched.
+
+Registration points:
+
+* ``serving/engine.py`` — taps the engine's event stream, dumps on
+  ``_abort`` (fatal engine-thread error) when ``serving.flight_dir`` is
+  set.
+* ``runtime/supervisor.py::PreemptionGuard`` — dumps on the first
+  trapped signal (from the main thread, at the step-boundary check, not
+  inside the async handler).
+* ``cli/train_dist.py`` — dumps on crash (the run_loop except path) and
+  on rerun-machine halt codes (NaN / validation faults).
+
+``cli/summarize.py`` renders a dump (and warns-and-skips a torn one).
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import time
+import traceback
+from typing import Any, Dict, List, Optional
+
+from hetu_galvatron_tpu.observability.registry import (
+    MetricsRegistry,
+    get_registry,
+)
+
+# schema marker cli/summarize.py dispatches on
+FLIGHT_KIND = "flight_recorder"
+
+
+def _jsonable(x: Any) -> Any:
+    """Last-resort encoder (numpy/jax scalars -> numbers, else str) —
+    the dump must serialize whatever the ring happened to capture."""
+    try:
+        return float(x)
+    except (TypeError, ValueError):
+        return str(x)
+
+
+class FlightRecorder:
+    """Bounded in-memory black box with an atomic crash dump.
+
+    ``capacity`` bounds the ring (oldest events fall off); ``out_dir``
+    is where dumps land — ``None`` keeps the ring alive (taps still
+    record) but makes :meth:`dump` a counted no-op, so engines that did
+    not opt into an artifact directory never litter the filesystem.
+    """
+
+    def __init__(self, *, capacity: int = 256,
+                 registry: Optional[MetricsRegistry] = None,
+                 out_dir: Optional[str] = None,
+                 prefix: str = "flight"):
+        self.capacity = max(int(capacity), 1)
+        self._registry = registry
+        self.out_dir = out_dir
+        self.prefix = prefix
+        self._ring: "collections.deque[Dict[str, Any]]" = collections.deque(
+            maxlen=self.capacity)
+        self.dumped: List[str] = []  # paths of successful dumps
+        self.last_error: Optional[BaseException] = None
+
+    @property
+    def registry(self) -> MetricsRegistry:
+        return (self._registry if self._registry is not None
+                else get_registry())
+
+    # -- recording ----------------------------------------------------------
+
+    def record(self, name: str, data: Dict[str, Any]) -> None:
+        """Tap-shaped entry point (``EventStream.add_tap(recorder.record)``)."""
+        self._ring.append({"name": name, "data": data})
+
+    def attach(self, events: Any) -> "FlightRecorder":
+        """Subscribe to an :class:`EventStream`; returns self for chaining."""
+        events.add_tap(self.record)
+        return self
+
+    def note(self, name: str, **data: Any) -> None:
+        """Record one ad-hoc entry (timestamps like the event stream)."""
+        self.record(name, {"ev": name, "tm": time.monotonic() * 1000.0,
+                           **data})
+
+    def events(self) -> List[Dict[str, Any]]:
+        return list(self._ring)
+
+    # -- dumping ------------------------------------------------------------
+
+    def snapshot(self, reason: str,
+                 exc: Optional[BaseException] = None) -> Dict[str, Any]:
+        """The dump payload: reason, optional exception (type + message +
+        traceback), the event ring, and a snapshot of every registry
+        metric — self-contained, no other file needed to read it."""
+        metrics = []
+        for m in self.registry.metrics():
+            rec: Dict[str, Any] = {"kind": m.kind, "name": m.name}
+            if m.labels:
+                rec["labels"] = m.labels
+            rec.update(m.snapshot())
+            metrics.append(rec)
+        payload: Dict[str, Any] = {
+            "kind": FLIGHT_KIND,
+            "reason": reason,
+            "t": time.time(),
+            "pid": os.getpid(),
+            "exception": None,
+            "events": list(self._ring),
+            "metrics": metrics,
+        }
+        if exc is not None:
+            payload["exception"] = {
+                "type": type(exc).__name__,
+                "message": str(exc),
+                "traceback": "".join(traceback.format_exception(
+                    type(exc), exc, exc.__traceback__)),
+            }
+        return payload
+
+    def dump(self, reason: str, exc: Optional[BaseException] = None,
+             out_dir: Optional[str] = None) -> Optional[str]:
+        """Write ``flight_<ts>.json`` atomically (tmp + rename); returns
+        the path, or ``None`` when no directory is configured or anything
+        failed. NEVER raises — the crash path that calls this must
+        surface its own fault, not the recorder's."""
+        d = out_dir if out_dir is not None else self.out_dir
+        if not d:
+            return None
+        try:
+            payload = self.snapshot(reason, exc)
+            os.makedirs(d, exist_ok=True)
+            ts = int(payload["t"] * 1000.0)
+            path = os.path.join(d, f"{self.prefix}_{ts}_{os.getpid()}.json")
+            tmp = path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(payload, f, default=_jsonable)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+            self.dumped.append(path)
+            return path
+        except Exception as e:  # noqa: BLE001 — dumping must never mask
+            # the real fault; the failure is kept for postmortem asserts
+            self.last_error = e
+            return None

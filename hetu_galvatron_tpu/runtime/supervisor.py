@@ -73,12 +73,17 @@ class PreemptionGuard:
 
     def __init__(self, signals: Iterable[int] = (signal.SIGTERM,
                                                  signal.SIGINT),
-                 *, enabled: bool = True, registry=None):
+                 *, enabled: bool = True, registry=None, recorder=None):
         self.signals = tuple(signals)
         self.enabled = enabled
         self._requested = threading.Event()
         self._previous = {}
         self._registry = registry
+        # optional observability.recorder.FlightRecorder: the first
+        # trapped signal dumps a postmortem (from the main thread, at the
+        # boundary check — never inside the async handler); dump() never
+        # raises, so the checkpoint-and-exit path is unaffected
+        self.recorder = recorder
         self.installed = False
         self.signum: Optional[int] = None  # first signal that fired
         self._counted = False
@@ -119,6 +124,8 @@ class PreemptionGuard:
                 name = str(self.signum)
             _registry(self._registry).counter(
                 "supervisor/preemption_signals", sig=name).inc()
+            if self.recorder is not None:
+                self.recorder.dump(f"signal:{name}")
         return self._requested.is_set()
 
     def exit_code(self) -> int:
@@ -213,6 +220,11 @@ def run_with_restarts(
             delay = backoff_delay(restarts, base=base_delay, cap=max_delay,
                                   rng=rng)
             reg.counter("supervisor/restarts", reason="crash").inc()
+            # goodput accounting: backoff wall-clock is lost time (the
+            # checkpoint-persisted goodput tracker books the full
+            # commit-to-resume gap; this counter is the supervisor's own
+            # receipt of the deliberately-slept share)
+            reg.counter("supervisor/backoff_wait_s").inc(delay)
             log(f"supervisor: attempt crashed ({type(e).__name__}: {e}); "
                 f"restart {restarts + 1}/{max_restarts} in {delay:.1f}s")
             restarts += 1
@@ -236,6 +248,7 @@ def run_with_restarts(
         delay = backoff_delay(restarts, base=base_delay, cap=max_delay,
                               rng=rng)
         reg.counter("supervisor/restarts", code=code).inc()
+        reg.counter("supervisor/backoff_wait_s").inc(delay)
         log(f"supervisor: exit code {code}; restart "
             f"{restarts + 1}/{max_restarts} in {delay:.1f}s")
         restarts += 1

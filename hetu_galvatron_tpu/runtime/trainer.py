@@ -13,6 +13,7 @@ without host round-trips.
 
 from __future__ import annotations
 
+import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
@@ -152,6 +153,7 @@ def train_loop(
     hooks: Tuple[Callable, ...] = (),
     telemetry: Optional[Any] = None,
     preemption: Optional[Any] = None,
+    goodput: Optional[Any] = None,
 ) -> Tuple[Any, Any, list]:
     """Host-side iteration driver (reference train_dist.py:49-73): fetch
     batch, run jitted step, invoke profiler/logging hooks. Returns final
@@ -168,7 +170,12 @@ def train_loop(
     final-flushed when the loop exits (even on error) and left open for
     the caller to reuse/close. When ``args.observability.enabled`` and no
     instance is passed, one is built from the args (JSONL sink at
-    ``observability.metrics_path``) and closed with the loop."""
+    ``observability.metrics_path``) and closed with the loop.
+    ``goodput`` is an optional
+    ``observability.goodput.GoodputTracker``: each iteration's host wall
+    is booked as ``productive_step`` (the first iteration as
+    ``recompile`` — it pays the jit), so even this minimal loop feeds
+    the goodput partition; flushing/persistence stay the caller's job."""
     from hetu_galvatron_tpu.models.modules import compute_dtype_of
     from hetu_galvatron_tpu.observability.tracing import span
 
@@ -199,6 +206,7 @@ def train_loop(
     all_hooks = hooks + ((telemetry,) if telemetry is not None else ())
     try:
         for it in range(args.train.train_iters):
+            it_t0 = time.perf_counter()
             with span("train/fetch"):
                 batch = put(next(data_iter))
             if use_dropout:
@@ -223,6 +231,9 @@ def train_loop(
             device_losses.append(metrics["loss"])
             for h in all_hooks:
                 h(it, metrics)
+            if goodput is not None:
+                goodput.add("recompile" if it == 0 else "productive_step",
+                            time.perf_counter() - it_t0)
             if preemption is not None and preemption.requested():
                 # step boundary: the update above is complete and safe to
                 # checkpoint; never abandon a step mid-flight

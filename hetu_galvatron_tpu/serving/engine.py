@@ -59,6 +59,8 @@ import jax.numpy as jnp
 
 from hetu_galvatron_tpu.core.args_schema import ModelArgs, ServingArgs
 from hetu_galvatron_tpu.models import modules as M
+from hetu_galvatron_tpu.observability.events import EventStream
+from hetu_galvatron_tpu.observability.recorder import FlightRecorder
 from hetu_galvatron_tpu.observability.registry import (
     MetricsRegistry,
     get_registry,
@@ -217,6 +219,20 @@ class ServingEngine:
             self.prefix = PrefixCache(
                 self.kv.allocator, self.kv.block_size,
                 max_blocks=serving.prefix_cache_max_blocks)
+        # request-lifecycle tracing (observability/events.py): the sink
+        # stream is gated on serving.trace_requests (zero JSONL growth by
+        # default). The flight recorder taps the stream whenever its ring
+        # can matter — tracing on, or a dump directory configured — so
+        # crash dumps carry last-N-events context; with BOTH off, no tap
+        # is attached and emit() is a single attribute check per event
+        # (the default serving path pays nothing per token)
+        self.events = EventStream(self.registry,
+                                  enabled=serving.trace_requests)
+        self.recorder = FlightRecorder(
+            registry=self.registry, out_dir=serving.flight_dir,
+            capacity=serving.flight_events)
+        if serving.trace_requests or serving.flight_dir:
+            self.recorder.attach(self.events)
         self.scheduler = Scheduler(
             self.kv, max_slots=self.S,
             max_position_embeddings=cfg.max_position_embeddings,
@@ -225,7 +241,7 @@ class ServingEngine:
             # forward-only
             flops_per_token=model_flops_per_token(cfg) / 3.0,
             max_prefill_tokens=serving.max_prefill_tokens,
-            prefix_cache=self.prefix)
+            prefix_cache=self.prefix, events=self.events)
 
         # rope/position tables cover every storable position
         self._table_len = self.kv.max_blocks_per_seq * self.kv.block_size
@@ -264,6 +280,11 @@ class ServingEngine:
                 self.registry, port=int(serving.metrics_port),
                 host=serving.metrics_host)
             self.metrics_port = self.metrics_server.start()
+
+        # SLO attainment accounting (serving.slo_ttft_ms / slo_itl_ms):
+        # plain host-side counts; flush() exports the attainment gauges
+        self._ttft_n = self._ttft_ok = 0
+        self._itl_n = self._itl_ok = 0
 
         self._lock = threading.RLock()
         self._thread: Optional[threading.Thread] = None
@@ -672,6 +693,11 @@ class ServingEngine:
                 handle = RequestHandle(req)
                 handle._finish("error", f"engine error: {self.error}")
                 self.registry.counter("serve/requests_rejected").inc()
+                self.events.emit("submit", req.rid,
+                                 prompt_len=len(req.tokens),
+                                 max_new=req.max_new_tokens)
+                self.events.emit("retire", req.rid, status="error",
+                                 reason="engine dead", generated=0)
                 return handle
             handle = self.scheduler.submit(req)
             if handle.status == "rejected":
@@ -687,6 +713,10 @@ class ServingEngine:
             did = self._sweep() > 0
             admitted = self.scheduler.admit()
             for slot, bucket in admitted:
+                h = slot.handle
+                if h.admitted_t is not None:
+                    self.registry.histogram("serve/queue_wait_ms").observe(
+                        (h.admitted_t - h.submitted_t) * 1000.0)
                 if slot.cached_len:
                     self.registry.counter("serve/prefix_hits").inc()
                     self.registry.counter("serve/prefix_cached_tokens").inc(
@@ -739,15 +769,27 @@ class ServingEngine:
         self._thread.start()
 
     def _abort(self, exc: BaseException) -> None:
-        """Resolve every outstanding handle after a fatal engine error."""
+        """Resolve every outstanding handle after a fatal engine error.
+        Every retirement is attributed (``serve/errors`` labelled with the
+        exception class, retire events per request) and the flight
+        recorder dumps a postmortem — dump() never raises, so the real
+        fault always reaches ``self.error`` / the caller untouched."""
         self.error = exc
         with self._lock:
             self.registry.counter("serve/engine_errors").inc()
+            self.registry.counter("serve/errors",
+                                  error=type(exc).__name__).inc()
+            self.events.emit("engine_error", error=type(exc).__name__,
+                             message=str(exc))
             for slot in list(self.scheduler.slots.values()):
                 self.scheduler.retire(slot, "error", f"engine error: {exc}")
             for h in self.scheduler.waiting:
                 h._finish("error", f"engine error: {exc}")
+                self.events.emit("retire", h.request.rid, status="error",
+                                 reason="engine error", generated=0,
+                                 queued=True)
             self.scheduler.waiting = []
+            self.recorder.dump("engine_error", exc=exc)
 
     def stop(self) -> None:
         if self._thread is None:
@@ -793,6 +835,7 @@ class ServingEngine:
         self.kv.pools = new_pools
 
     def _prefill_slot(self, slot: Slot, bucket: int) -> None:
+        t0 = time.perf_counter()
         req = slot.request
         prompt_len = len(req.tokens)
         cached = slot.cached_len
@@ -823,7 +866,14 @@ class ServingEngine:
         new_pools, tok = fn(*args)
         self.kv.pools = new_pools
         tok = int(np.asarray(tok))
+        # dispatch-to-sync host wall for this slot's prefill: the TTFT
+        # component split in _emit (queue + prefill + decode == ttft)
+        # reads it, so set it BEFORE the first-token emit below
+        slot.prefill_ms = (time.perf_counter() - t0) * 1000.0
         self.registry.counter("serve/prefill_tokens").inc(len(suffix))
+        self.events.emit("prefill", req.rid, bucket=bucket,
+                         suffix=len(suffix), cached=cached,
+                         ms=slot.prefill_ms)
         self._emit(slot, tok, first=True)
 
     def _run_decode(self, state, drafted=None) -> np.ndarray:
@@ -865,6 +915,7 @@ class ServingEngine:
         toks = self._run_decode(state)
         for slot in list(self.scheduler.slots.values()):
             slot.pos += 1
+            self.events.emit("decode", slot.request.rid, pos=slot.pos, n=1)
             # a fully-cached prompt skipped prefill entirely: its FIRST
             # token comes from this decode step (TTFT records here)
             self._emit(slot, int(toks[slot.index]),
@@ -899,6 +950,10 @@ class ServingEngine:
             k_eff = (min(K, max(budget - 1, 0))
                      if req.temperature <= 0.0 else 0)
             a = accept_length(drafted[slot.index], row, k_eff)
+            # accepted is the window outcome; the EMITTED count is bounded
+            # by accepted+1 but can be cut short by mid-window EOS/length
+            # retirement — retire.generated stays the authoritative total
+            self.events.emit("verify", req.rid, drafted=k_eff, accepted=a)
             if req.temperature <= 0.0:
                 self._drafted_total += K
                 self._accepted_total += a
@@ -920,12 +975,30 @@ class ServingEngine:
         now = time.monotonic()
         slot.generated += 1
         slot.last_token = tok
+        h = slot.handle
         if first:
-            self.registry.histogram("serve/ttft_ms").observe(
-                (now - slot.handle.submitted_t) * 1000.0)
+            ttft_ms = (now - h.submitted_t) * 1000.0
+            self.registry.histogram("serve/ttft_ms").observe(ttft_ms)
+            self._ttft_n += 1
+            if ttft_ms <= self.serving.slo_ttft_ms:
+                self._ttft_ok += 1
+            # additive TTFT split: queue (submit -> slot granted) +
+            # prefill (this slot's dispatch wall) + decode (residual —
+            # fully-cached prompts bootstrap through the decode step, so
+            # their whole post-admit latency lands here). Components sum
+            # to the measured TTFT by construction.
+            queue_ms = ((h.admitted_t - h.submitted_t) * 1000.0
+                        if h.admitted_t is not None else 0.0)
+            self.events.emit(
+                "first_token", req.rid, ttft_ms=ttft_ms, queue_ms=queue_ms,
+                prefill_ms=slot.prefill_ms,
+                decode_ms=max(ttft_ms - queue_ms - slot.prefill_ms, 0.0))
         else:
-            self.registry.histogram("serve/itl_ms").observe(
-                (now - slot.last_token_t) * 1000.0)
+            itl_ms = (now - slot.last_token_t) * 1000.0
+            self.registry.histogram("serve/itl_ms").observe(itl_ms)
+            self._itl_n += 1
+            if itl_ms <= self.serving.slo_itl_ms:
+                self._itl_ok += 1
         slot.last_token_t = now
         slot.handle._emit(tok)
         self._emitted_total += 1
@@ -941,6 +1014,8 @@ class ServingEngine:
     def _telemetry_step(self) -> None:
         reg = self.registry
         reg.counter("serve/steps").inc()
+        if self.metrics_server is not None:
+            self.metrics_server.note_step()  # /healthz last-step age
         now = time.monotonic()
         self._emitted_window.append((now, self._emitted_total))
         if len(self._emitted_window) > 64:
@@ -982,6 +1057,17 @@ class ServingEngine:
                 self.prefix.blocks_held)
         if self._draft is not None:
             reg.gauge("serve/spec_accept_rate").set(self.spec_accept_rate())
+        # SLO attainment (serving.slo_ttft_ms / slo_itl_ms > 0): share of
+        # observations inside the target, exported for the Prometheus
+        # endpoint and the summarize SLO report
+        if self.serving.slo_ttft_ms > 0:
+            reg.gauge("serve/slo_ttft_ms").set(self.serving.slo_ttft_ms)
+            reg.gauge("serve/slo_ttft_attainment").set(
+                self._ttft_ok / self._ttft_n if self._ttft_n else 1.0)
+        if self.serving.slo_itl_ms > 0:
+            reg.gauge("serve/slo_itl_ms").set(self.serving.slo_itl_ms)
+            reg.gauge("serve/slo_itl_attainment").set(
+                self._itl_ok / self._itl_n if self._itl_n else 1.0)
         reg.flush(step=self._steps)
 
     def close(self) -> None:

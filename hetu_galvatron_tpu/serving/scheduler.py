@@ -83,6 +83,7 @@ class RequestHandle:
         self.cached_tokens = 0  # prompt tokens served by the prefix cache
         self.output: List[int] = []
         self.submitted_t = time.monotonic()
+        self.admitted_t: Optional[float] = None  # slot granted (queue end)
         self.first_token_t: Optional[float] = None
         self.finished_t: Optional[float] = None
         self._q: "queue.Queue[Any]" = queue.Queue()
@@ -165,6 +166,7 @@ class Slot:
     last_token: int
     generated: int = 0
     last_token_t: float = 0.0
+    prefill_ms: float = 0.0  # host wall of this slot's prefill dispatch
     cached_len: int = 0
     owned_blocks: List[int] = field(default_factory=list)
     shared_blocks: List[int] = field(default_factory=list)
@@ -202,9 +204,15 @@ class Scheduler:
         flops_per_token: float = 0.0,
         max_prefill_tokens: int = 0,
         prefix_cache: Optional[PrefixCache] = None,
+        events: Optional[Any] = None,
     ):
         self.kv = kv
         self.prefix = prefix_cache
+        # request-lifecycle event stream (observability/events.py): the
+        # scheduler emits the transitions it owns — submit, admit (incl.
+        # the cold-retry livelock fallback), retire — with the stable
+        # Request.rid; None degrades every emit to a no-op
+        self.events = events
         self.max_slots = int(max_slots)
         self.max_positions = int(max_position_embeddings)
         # per-step prefill token budget: the tighter of the explicit token
@@ -233,8 +241,14 @@ class Scheduler:
         return max(self.kv.blocks_for(prompt_len + max_new),
                    bucket // self.kv.block_size)
 
+    def _emit(self, ev: str, rid: int, **fields) -> None:
+        if self.events is not None:
+            self.events.emit(ev, rid, **fields)
+
     def submit(self, request: Request) -> RequestHandle:
         handle = RequestHandle(request)
+        self._emit("submit", request.rid, prompt_len=len(request.tokens),
+                   max_new=request.max_new_tokens)
         total = len(request.tokens) + request.max_new_tokens
         if (not request.tokens or request.max_new_tokens < 1
                 or not self.kv.fits(total)
@@ -246,6 +260,8 @@ class Scheduler:
                     > self.kv.num_blocks - 1)):
             self.rejected += 1
             handle._finish("rejected", "capacity")
+            self._emit("retire", request.rid, status="rejected",
+                       reason="capacity", generated=0)
             return handle
         handle.status = "queued"
         self.waiting.append(handle)
@@ -333,6 +349,7 @@ class Scheduler:
             need = self._need_for(prompt_len, req.max_new_tokens,
                                   cached_len, bucket)
             owned = self._alloc_or_evict(need)
+            cold_retry = False
             if owned is None and path:
                 # the match itself pins the path, which can make the
                 # request UNADMITTABLE forever (its own cached blocks are
@@ -340,6 +357,7 @@ class Scheduler:
                 # the pins dropped before concluding the pool is full
                 self.prefix.release(path)
                 cached_len, shared, path = 0, [], ()
+                cold_retry = True
                 suffix = prompt_len
                 bucket = bucket_length(suffix, bs, cap_tokens)
                 if self.prefill_token_cap and admitted and (
@@ -364,19 +382,25 @@ class Scheduler:
             else:
                 cow = (shared[-1], owned[0])
                 table = shared[:-1] + owned
+            now = time.monotonic()
             slot = Slot(index=idx, handle=handle, blocks=table,
                         pos=prompt_len - (0 if suffix else 1),
                         last_token=req.tokens[-1],
-                        last_token_t=time.monotonic(),
+                        last_token_t=now,
                         cached_len=cached_len, owned_blocks=owned,
                         shared_blocks=list(shared),
                         prefix_path=path, cow=cow,
                         limit=prompt_len + req.max_new_tokens - 1)
             handle.status = "running"
             handle.cached_tokens = cached_len
+            handle.admitted_t = now
             self.slots[idx] = slot
             admitted.append((slot, bucket))
             budget_used += bucket
+            self._emit("admit", req.rid, slot=idx,
+                       queue_ms=(now - handle.submitted_t) * 1000.0,
+                       cached_len=cached_len, hit_blocks=len(shared),
+                       suffix=suffix, bucket=bucket, cold_retry=cold_retry)
         return admitted
 
     def note_prefilled(self, slot: Slot) -> List[int]:
@@ -410,10 +434,14 @@ class Scheduler:
         for h in self.waiting:
             if h.cancelled:
                 h._finish("cancelled", "cancelled")
+                self._emit("retire", h.request.rid, status="cancelled",
+                           reason="cancelled", generated=0, queued=True)
                 n_cancel += 1
             elif (h.request.timeout_s > 0
                   and now - h.submitted_t > h.request.timeout_s):
                 h._finish("timeout", "timeout")
+                self._emit("retire", h.request.rid, status="timeout",
+                           reason="timeout", generated=0, queued=True)
                 n_timeout += 1
             else:
                 still.append(h)
@@ -436,6 +464,8 @@ class Scheduler:
         if status == "done":
             self.completed += 1
         slot.handle._finish(status, reason)
+        self._emit("retire", slot.request.rid, status=status, reason=reason,
+                   generated=slot.generated)
 
     def sweep(self, now: Optional[float] = None) -> Tuple[int, int]:
         """Retire cancelled / deadline-expired active sequences; returns

@@ -1,0 +1,141 @@
+"""Goodput + flight-recorder fault drills on the CPU mesh: a SIGTERM
+preemption must leave (1) goodput counters that survive the supervisor
+restart through the checkpoint train_state payload — nonzero
+restart-lost time, goodput < 1 but above a floor — and (2) a parseable
+flight-recorder dump from the trapped signal; summarize renders both."""
+
+import io
+import json
+import os
+
+import pytest
+
+pytestmark = [pytest.mark.distributed, pytest.mark.robustness]
+
+ZOO = os.path.join(os.path.dirname(__file__), "..", "..",
+                   "hetu_galvatron_tpu", "models", "configs")
+
+TINY = [
+    "model.hidden_size=32", "model.num_hidden_layers=2",
+    "model.num_attention_heads=2", "model.vocab_size=64",
+    "model.seq_length=8", "model.max_position_embeddings=16",
+    "model.make_vocab_size_divisible_by=1",
+    "train.train_iters=6", "parallel.mixed_precision=fp32",
+    "parallel.global_train_batch_size=8",
+]
+
+
+def _args(extra):
+    from hetu_galvatron_tpu.core.arguments import args_from_cli
+
+    return args_from_cli([os.path.join(ZOO, "gpt2-small.yaml")] + TINY +
+                         extra, mode="train_dist")
+
+
+def _supervised_train(args):
+    from hetu_galvatron_tpu.cli.train_dist import train
+    from hetu_galvatron_tpu.runtime.supervisor import run_with_restarts
+
+    outs = []
+
+    def attempt():
+        if args.ckpt.save and not args.ckpt.load:
+            args.ckpt.load = args.ckpt.save
+        out = train(args)
+        outs.append(out)
+        return out.get("exit_code") or 0
+
+    rc = run_with_restarts(attempt, max_restarts=3, base_delay=0.0,
+                           sleep=lambda s: None, log=lambda m: None)
+    return rc, outs
+
+
+def test_preempt_drill_goodput_survives_restart_and_flight_dump(tmp_path):
+    metrics = str(tmp_path / "metrics.jsonl")
+    rc, outs = _supervised_train(_args([
+        f"ckpt.save={tmp_path / 'ckpt'}",
+        "observability.enabled=true",
+        f"observability.metrics_path={metrics}",
+        "rerun.inject_kind=preempt", "rerun.inject_at_iter=2"]))
+    assert rc == 0
+    assert len(outs) == 2  # preempted attempt + resumed attempt
+
+    # the preempted attempt's trapped SIGTERM dumped a flight record
+    assert len(outs[0]["flight_dumps"]) == 1
+    fpath = outs[0]["flight_dumps"][0]
+    assert os.path.basename(fpath).startswith("flight_")
+    with open(fpath) as f:
+        flight = json.load(f)  # parseable (atomic tmp+rename)
+    assert flight["kind"] == "flight_recorder"
+    assert flight["reason"].startswith("signal:")
+    assert any(e["data"].get("ev") == "run_start" for e in flight["events"])
+
+    # goodput survived the restart: the resumed tracker merged the
+    # committed totals (attempt 1's productive steps), booked the
+    # commit-to-resume wall gap as restart-lost, and counts the restart
+    gp = outs[1]["goodput"]
+    assert gp["restarts_survived"] == 1
+    assert gp["totals"]["restart_lost"] > 0.0
+    assert gp["totals"]["productive_step"] > 0.0
+    assert gp["totals"]["recompile"] > 0.0
+    assert 0.0 < gp["frac"] < 1.0
+    # ... and covers BOTH attempts' productive work (attempt 1 trained
+    # iters 1..2 after its compile step, attempt 2 iters 4..5), so the
+    # merged productive time exceeds what attempt 2 alone accrued
+    assert gp["totals"]["productive_step"] > \
+        outs[0]["goodput"]["totals"]["productive_step"] / 2
+
+    # goodput/* gauges landed in the metrics stream and summarize
+    # renders the partition
+    from hetu_galvatron_tpu.cli.summarize import summarize
+
+    buf = io.StringIO()
+    headline = summarize(metrics, out=buf)
+    text = buf.getvalue()
+    assert "-- goodput --" in text
+    assert "restart_lost" in text and "goodput" in text
+    assert 0.0 < headline["goodput_frac"] < 1.0
+    assert headline["goodput/restart_lost_s"] > 0.0
+
+    # the flight dump renders too
+    fbuf = io.StringIO()
+    fh = summarize(fpath, out=fbuf)
+    assert fh["flight_reason"].startswith("signal:")
+
+
+def test_clean_run_has_full_goodput_and_no_flight_dump(tmp_path):
+    """No fault: nothing restart-lost, goodput is the productive share
+    (compile time keeps it below 1), and no flight artifact appears."""
+    from hetu_galvatron_tpu.cli.train_dist import train
+
+    metrics = str(tmp_path / "metrics.jsonl")
+    out = train(_args([
+        "observability.enabled=true",
+        f"observability.metrics_path={metrics}"]))
+    assert out["exit_code"] is None and len(out["losses"]) == 6
+    gp = out["goodput"]
+    assert gp["totals"]["restart_lost"] == 0.0
+    assert gp["restarts_survived"] == 0
+    assert gp["totals"]["productive_step"] > 0.0
+    assert 0.0 < gp["frac"] <= 1.0
+    assert not [f for f in os.listdir(tmp_path)
+                if f.startswith("flight_")]
+
+
+def test_nan_halt_leaves_flight_dump(tmp_path):
+    """The rerun machine's resume-to-disambiguate halt (a NaN drill) is
+    a forensics event: the run exits 16 AND leaves a dump recording the
+    halt."""
+    metrics = str(tmp_path / "metrics.jsonl")
+    rc, outs = _supervised_train(_args([
+        f"ckpt.save={tmp_path / 'ckpt'}",
+        "observability.enabled=true",
+        f"observability.metrics_path={metrics}",
+        "rerun.enable=true", "rerun.mode=validate_results",
+        "rerun.inject_kind=nan", "rerun.inject_at_iter=2"]))
+    assert rc == 0
+    assert outs[0]["exit_code"] == 16
+    assert len(outs[0]["flight_dumps"]) == 1
+    with open(outs[0]["flight_dumps"][0]) as f:
+        flight = json.load(f)
+    assert flight["reason"] == "rerun_exit_16"

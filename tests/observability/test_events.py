@@ -1,0 +1,84 @@
+"""Request-lifecycle event stream (observability/events.py): record
+schema through the JSONL sink, sequence ordering, the disabled fast path,
+tap fan-out, and tap-failure isolation."""
+
+import json
+
+import pytest
+
+from hetu_galvatron_tpu.observability.events import EventStream
+from hetu_galvatron_tpu.observability.registry import MetricsRegistry
+from hetu_galvatron_tpu.observability.sinks import JsonlSink
+
+pytestmark = pytest.mark.observability
+
+
+def test_emit_schema_lands_in_sink(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    reg = MetricsRegistry([JsonlSink(path)])
+    ev = EventStream(reg)
+    ev.emit("submit", 7, prompt_len=12, max_new=8)
+    ev.emit("retire", 7, status="done", reason="eos", generated=3)
+    reg.close()
+    recs = [json.loads(line) for line in open(path)]
+    assert len(recs) == 2
+    for r in recs:
+        assert r["kind"] == "event" and r["name"] == "request"
+        d = r["data"]
+        assert d["rid"] == 7 and "seq" in d and "tm" in d
+    assert recs[0]["data"]["ev"] == "submit"
+    assert recs[0]["data"]["prompt_len"] == 12
+    assert recs[1]["data"]["ev"] == "retire"
+    assert recs[1]["data"]["status"] == "done"
+
+
+def test_seq_strictly_increasing_and_tm_monotonic():
+    ev = EventStream(MetricsRegistry())
+    datas = [ev.emit("decode", 1, n=1) for _ in range(32)]
+    seqs = [d["seq"] for d in datas]
+    assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+    tms = [d["tm"] for d in datas]
+    assert all(a <= b for a, b in zip(tms, tms[1:]))
+
+
+def test_disabled_without_taps_is_noop(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    reg = MetricsRegistry([JsonlSink(path)])
+    ev = EventStream(reg, enabled=False)
+    assert ev.emit("submit", 1) is None
+    reg.close()
+    import os
+
+    # lazy-open sink with nothing written leaves no artifact at all
+    assert not os.path.exists(path)
+
+
+def test_taps_receive_even_when_sink_stream_disabled(tmp_path):
+    """The flight-recorder contract: a crash dump has event context even
+    for runs that never turned the JSONL stream on."""
+    path = str(tmp_path / "m.jsonl")
+    reg = MetricsRegistry([JsonlSink(path)])
+    ev = EventStream(reg, enabled=False)
+    got = []
+    ev.add_tap(lambda name, data: got.append((name, data)))
+    ev.emit("submit", 3, prompt_len=2)
+    assert len(got) == 1 and got[0][0] == "request"
+    assert got[0][1]["rid"] == 3
+    reg.close()
+    import os
+
+    assert not os.path.exists(path)  # sink stream stayed off
+
+
+def test_broken_tap_is_counted_not_fatal():
+    ev = EventStream(MetricsRegistry())
+
+    def boom(name, data):
+        raise RuntimeError("tap exploded")
+
+    good = []
+    ev.add_tap(boom)
+    ev.add_tap(lambda n, d: good.append(d))
+    d = ev.emit("submit", 1)
+    assert d is not None and ev.tap_errors == 1
+    assert len(good) == 1  # later taps still ran

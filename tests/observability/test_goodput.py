@@ -1,0 +1,99 @@
+"""Goodput tracker (observability/goodput.py): category accumulation,
+checkpoint-payload persistence with restart-lost wall-gap accounting,
+gauge export, and the supervisor's backoff-wait receipt."""
+
+import pytest
+
+from hetu_galvatron_tpu.observability.goodput import (
+    CATEGORIES,
+    GoodputTracker,
+)
+from hetu_galvatron_tpu.observability.registry import MetricsRegistry
+
+pytestmark = pytest.mark.observability
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def test_measure_and_goodput_fraction():
+    clk = FakeClock()
+    gp = GoodputTracker(clock=clk, wall=clk)
+    with gp.measure("productive_step"):
+        clk.t += 8.0
+    with gp.measure("checkpoint_save"):
+        clk.t += 2.0
+    assert gp.totals["productive_step"] == pytest.approx(8.0)
+    assert gp.totals["checkpoint_save"] == pytest.approx(2.0)
+    assert gp.goodput() == pytest.approx(0.8)
+    assert gp.total() == pytest.approx(10.0)
+
+
+def test_empty_tracker_reports_goodput_one():
+    assert GoodputTracker().goodput() == 1.0
+
+
+def test_state_roundtrip_books_wall_gap_as_restart_lost():
+    """The persistence contract: totals survive through the checkpoint
+    payload, and the commit-to-resume wall gap (dead attempt's discarded
+    work + downtime + backoff) lands in restart_lost."""
+    wall = FakeClock(1000.0)
+    a = GoodputTracker(wall=wall)
+    a.add("productive_step", 30.0)
+    a.add("recompile", 5.0)
+    snap = a.state_dict()  # committed at wall 1000
+
+    wall.t = 1012.5  # 12.5 s later another process resumes
+    b = GoodputTracker(wall=wall)
+    b.load_state_dict(snap)
+    assert b.totals["productive_step"] == pytest.approx(30.0)
+    assert b.totals["recompile"] == pytest.approx(5.0)
+    assert b.totals["restart_lost"] == pytest.approx(12.5)
+    assert b.restarts_survived == 1
+    assert 0.0 < b.goodput() < 1.0
+
+    # a second preemption chains: survived count and lost time accumulate
+    snap2 = b.state_dict()
+    wall.t += 3.0
+    c = GoodputTracker(wall=wall)
+    c.load_state_dict(snap2)
+    assert c.totals["restart_lost"] == pytest.approx(15.5)
+    assert c.restarts_survived == 2
+
+
+def test_flush_exports_gauges():
+    reg = MetricsRegistry()
+    gp = GoodputTracker()
+    gp.add("productive_step", 9.0)
+    gp.add("restart_lost", 1.0)
+    gp.flush(reg)
+    for c in CATEGORIES:
+        assert reg.gauge(f"goodput/{c}_s").value >= 0.0
+    assert reg.gauge("goodput/productive_step_s").value == 9.0
+    assert reg.gauge("goodput/goodput_frac").value == pytest.approx(0.9)
+
+
+def test_supervisor_counts_backoff_wait():
+    from hetu_galvatron_tpu.runtime.supervisor import (
+        EXIT_CODE_CHECKPOINT_AND_EXIT,
+        run_with_restarts,
+    )
+
+    reg = MetricsRegistry()
+    codes = [EXIT_CODE_CHECKPOINT_AND_EXIT, 0]
+
+    rc = run_with_restarts(lambda: codes.pop(0), max_restarts=2,
+                           base_delay=0.5, sleep=lambda s: None,
+                           rng=__import__("random").Random(0),
+                           registry=reg, log=lambda m: None)
+    assert rc == 0
+    # one restart happened and its (jittered, positive) backoff was
+    # receipted for the goodput dashboards
+    assert reg.counter("supervisor/restarts",
+                       code=EXIT_CODE_CHECKPOINT_AND_EXIT).value == 1
+    assert reg.counter("supervisor/backoff_wait_s").value > 0.0

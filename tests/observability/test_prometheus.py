@@ -75,6 +75,89 @@ def test_http_server_scrape_smoke():
     srv.stop()  # idempotent after the context exit
 
 
+def test_healthz_endpoint():
+    """/healthz: 200 + JSON liveness payload (uptime, last-step age,
+    health_fn merge) without paying for the text exposition; /metrics
+    stays intact alongside."""
+    import json
+    import time
+
+    reg = MetricsRegistry()
+    reg.counter("serve/submitted").inc(1)
+    srv = MetricsHTTPServer(reg, port=0, host="127.0.0.1",
+                            health_fn=lambda: {"queue_depth": 3})
+    with srv:
+        url = f"http://127.0.0.1:{srv.port}/healthz"
+        with urllib.request.urlopen(url, timeout=5) as resp:
+            assert resp.status == 200
+            assert resp.headers["Content-Type"] == "application/json"
+            payload = json.loads(resp.read().decode())
+        assert payload["status"] == "ok"
+        assert payload["uptime_s"] >= 0.0
+        assert payload["last_step_age_s"] is None  # no step yet
+        assert payload["queue_depth"] == 3  # health_fn merged
+        srv.note_step()
+        time.sleep(0.01)
+        with urllib.request.urlopen(url, timeout=5) as resp:
+            payload = json.loads(resp.read().decode())
+        assert payload["last_step_age_s"] is not None
+        assert 0.0 < payload["last_step_age_s"] < 5.0
+        # both routes coexist
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics", timeout=5) as resp:
+            assert "serve_submitted_total 1.0" in resp.read().decode()
+
+
+def test_healthz_health_fn_failure_keeps_probe_alive():
+    import json
+
+    def broken():
+        raise RuntimeError("stats backend down")
+
+    reg = MetricsRegistry()
+    with MetricsHTTPServer(reg, port=0, health_fn=broken) as srv:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/healthz", timeout=5) as resp:
+            assert resp.status == 200  # the probe must not 500
+            payload = json.loads(resp.read().decode())
+        assert payload["status"] == "ok"
+        assert "RuntimeError" in payload["health_fn_error"]
+
+
+def test_serving_engine_healthz_wiring():
+    """The engine marks each step for /healthz (last-step age reflects
+    real engine progress)."""
+    import json
+
+    from hetu_galvatron_tpu.core.args_schema import ModelArgs, ServingArgs
+    from hetu_galvatron_tpu.models.builder import init_causal_lm
+    from hetu_galvatron_tpu.serving.engine import ServingEngine
+
+    cfg = ModelArgs(
+        hidden_size=32, num_hidden_layers=1, num_attention_heads=2,
+        vocab_size=64, max_position_embeddings=64, seq_length=16,
+        make_vocab_size_divisible_by=1, ffn_hidden_size=64,
+        tie_word_embeddings=False)
+    params, _ = init_causal_lm(jax.random.key(0), cfg)
+    params = jax.tree.map(lambda x: x.astype(jnp.float32), params)
+    sv = ServingArgs(max_batch_size=2, kv_block_size=8, max_seq_len=32,
+                     max_new_tokens=4, metrics_port=0)
+    eng = ServingEngine(params, cfg, sv, registry=MetricsRegistry(),
+                        compute_dtype=jnp.float32)
+    try:
+        url = f"http://127.0.0.1:{eng.metrics_port}/healthz"
+        with urllib.request.urlopen(url, timeout=5) as resp:
+            assert json.loads(resp.read())["last_step_age_s"] is None
+        h = eng.submit([1, 2, 3])
+        eng.run_until_idle()
+        assert h.status == "done"
+        with urllib.request.urlopen(url, timeout=5) as resp:
+            payload = json.loads(resp.read())
+        assert payload["last_step_age_s"] is not None
+    finally:
+        eng.close()
+
+
 def test_serving_engine_metrics_port_wiring():
     """serving.metrics_port=0 binds an ephemeral endpoint for the engine's
     registry; close() tears it down. Off (None) by default."""

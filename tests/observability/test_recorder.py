@@ -1,0 +1,155 @@
+"""Flight recorder (observability/recorder.py): bounded ring, atomic
+dump with exception context, the never-raises contract, and summarize's
+handling of whole and torn dumps."""
+
+import json
+import os
+
+import pytest
+
+from hetu_galvatron_tpu.cli.summarize import summarize
+from hetu_galvatron_tpu.observability.events import EventStream
+from hetu_galvatron_tpu.observability.recorder import FlightRecorder
+from hetu_galvatron_tpu.observability.registry import MetricsRegistry
+
+pytestmark = pytest.mark.observability
+
+
+def test_ring_is_bounded():
+    rec = FlightRecorder(capacity=8, registry=MetricsRegistry())
+    for i in range(50):
+        rec.note("tick", i=i)
+    evs = rec.events()
+    assert len(evs) == 8
+    assert evs[0]["data"]["i"] == 42 and evs[-1]["data"]["i"] == 49
+
+
+def test_dump_atomic_parseable_with_exception(tmp_path):
+    reg = MetricsRegistry()
+    reg.counter("serve/steps").inc(5)
+    ev = EventStream(reg)
+    rec = FlightRecorder(registry=reg, out_dir=str(tmp_path)).attach(ev)
+    ev.emit("submit", 1, prompt_len=3)
+    try:
+        raise ValueError("synthetic fault")
+    except ValueError as e:
+        path = rec.dump("crash", exc=e)
+    assert path is not None and os.path.exists(path)
+    assert os.path.basename(path).startswith("flight_")
+    # atomic: no .tmp residue
+    assert not [f for f in os.listdir(tmp_path) if f.endswith(".tmp")]
+    with open(path) as f:
+        obj = json.load(f)
+    assert obj["kind"] == "flight_recorder" and obj["reason"] == "crash"
+    assert obj["exception"]["type"] == "ValueError"
+    assert "synthetic fault" in obj["exception"]["traceback"]
+    assert any(e["data"].get("ev") == "submit" for e in obj["events"])
+    assert any(m["name"] == "serve/steps" and m["value"] == 5.0
+               for m in obj["metrics"])
+    assert rec.dumped == [path]
+
+
+def test_dump_without_out_dir_is_noop():
+    rec = FlightRecorder(registry=MetricsRegistry())
+    rec.note("tick")
+    assert rec.dump("whatever") is None
+    assert rec.dumped == []
+
+
+def test_dump_never_raises(tmp_path, monkeypatch):
+    """The PR-6 contract extended: a failing dump must never mask the
+    fault that triggered it."""
+    rec = FlightRecorder(registry=MetricsRegistry(),
+                         out_dir=str(tmp_path / "nope"))
+    import hetu_galvatron_tpu.observability.recorder as R
+
+    monkeypatch.setattr(R.json, "dump",
+                        lambda *a, **k: (_ for _ in ()).throw(
+                            OSError("disk full")))
+    assert rec.dump("crash") is None  # swallowed, not raised
+    assert isinstance(rec.last_error, OSError)
+
+
+def test_summarize_renders_dump_and_survives_torn_dump(tmp_path, capsys):
+    import io
+
+    reg = MetricsRegistry()
+    ev = EventStream(reg)
+    rec = FlightRecorder(registry=reg, out_dir=str(tmp_path)).attach(ev)
+    ev.emit("submit", 4, prompt_len=2)
+    ev.emit("retire", 4, status="done", reason="eos", generated=1)
+    path = rec.dump("signal:SIGTERM")
+    buf = io.StringIO()
+    head = summarize(path, out=buf)
+    text = buf.getvalue()
+    assert head["flight_reason"] == "signal:SIGTERM"
+    assert "flight recorder dump" in text and "submit" in text
+
+    # torn dump (crash mid-write of a pre-atomic copy): truncated JSON
+    # must degrade to a warning + empty summary, never a traceback
+    torn = tmp_path / "flight_torn.json"
+    torn.write_text(open(path).read()[: 40])
+    buf2 = io.StringIO()
+    head2 = summarize(str(torn), out=buf2)
+    err = capsys.readouterr().err
+    assert "warning" in err and "skipped" in err
+    assert head2.get("flight_reason") is None
+
+
+def test_summarize_skips_corrupt_request_events(tmp_path, capsys):
+    """Satellite hardening: torn event records in the metrics JSONL are
+    warned about and skipped; intact timelines still render."""
+    path = tmp_path / "m.jsonl"
+    lines = [
+        json.dumps({"t": 1.0, "kind": "event", "name": "request",
+                    "data": {"ev": "submit", "rid": 1, "seq": 0,
+                             "tm": 10.0, "prompt_len": 4, "max_new": 2}}),
+        # corrupt: data is not a dict
+        json.dumps({"t": 1.0, "kind": "event", "name": "request",
+                    "data": [1, 2]}),
+        # corrupt: missing rid/seq
+        json.dumps({"t": 1.0, "kind": "event", "name": "request",
+                    "data": {"ev": "admit"}}),
+        # corrupt: seq is a string (must not TypeError the sort)
+        json.dumps({"t": 1.0, "kind": "event", "name": "request",
+                    "data": {"ev": "decode", "rid": 1, "seq": "x",
+                             "tm": 11.0}}),
+        # stream-level (no rid): NOT corrupt, surfaced as ENGINE ERROR
+        json.dumps({"t": 1.0, "kind": "event", "name": "request",
+                    "data": {"ev": "engine_error", "seq": 2, "tm": 11.5,
+                             "error": "RuntimeError", "message": "boom"}}),
+        json.dumps({"t": 1.0, "kind": "event", "name": "request",
+                    "data": {"ev": "retire", "rid": 1, "seq": 1,
+                             "tm": 12.0, "status": "done",
+                             "reason": "eos", "generated": 2}}),
+        '{"half a reco',  # torn line
+    ]
+    path.write_text("\n".join(lines) + "\n")
+    import io
+
+    buf = io.StringIO()
+    head = summarize(str(path), out=buf)
+    err = capsys.readouterr().err
+    assert "corrupt request event" in err
+    assert head["requests_traced"] == 1
+    assert head["timelines_complete"] == 1
+    # the rid-less engine_error record is not "corrupt" — it renders
+    assert head["engine_error_events"] == 1
+    assert "ENGINE ERROR: RuntimeError: boom" in buf.getvalue()
+
+
+def test_summarize_cli_timeline_flag_parsing(tmp_path, capsys):
+    """--timeline must not eat the file path (flag-first invocation) and
+    a bare flag with no path prints usage instead of crashing."""
+    from hetu_galvatron_tpu.cli.summarize import main
+
+    path = tmp_path / "m.jsonl"
+    path.write_text(json.dumps(
+        {"t": 1.0, "kind": "counter", "name": "train/steps",
+         "value": 2.0}) + "\n")
+    assert main(["--timeline", str(path)]) == 0  # path not consumed
+    assert "run summary" in capsys.readouterr().out
+    assert main([str(path), "--timeline", "all"]) == 0
+    capsys.readouterr()
+    assert main(["--timeline"]) == 2  # usage, not IndexError
+    assert "usage:" in capsys.readouterr().out

@@ -258,10 +258,13 @@ def test_eviction_under_pool_pressure_stays_correct():
 @pytest.mark.parametrize("spec", [False, True], ids=["plain", "spec"])
 def test_shared_prefix_drill_mesh8(tmp_path, spec):
     """32 staggered requests sharing 3 system prompts under a tp2 plan on
-    the 8-device mesh: every stream bit-identical to offline generate()
-    (with and without speculative decoding), zero steady-state
-    recompiles, cache-hit TTFT strictly below cold TTFT, and the serving
-    gauges land in the JSONL sink."""
+    the 8-device mesh, rerun with request tracing ON: every stream
+    bit-identical to offline generate() (with and without speculative
+    decoding), zero steady-state recompiles (event emission is host-side
+    only), cache-hit TTFT strictly below cold TTFT, every request
+    yielding a complete ordered timeline whose TTFT components sum to
+    the measured TTFT, SLO attainment gauges exported, and the serving
+    gauges landing in the JSONL sink."""
     cfg = _cfg()
     args = CoreArgs(model=cfg.model_dump())
     args.parallel.global_tp_deg = 2
@@ -280,7 +283,9 @@ def test_shared_prefix_drill_mesh8(tmp_path, spec):
     reg = MetricsRegistry([JsonlSink(metrics_path)])
     sv = ServingArgs(max_batch_size=8, kv_block_size=8, max_seq_len=128,
                      max_new_tokens=24, flush_interval=8,
-                     prefix_cache=True, spec_decode=spec, spec_k=3)
+                     prefix_cache=True, spec_decode=spec, spec_k=3,
+                     trace_requests=True, slo_ttft_ms=120_000.0,
+                     slo_itl_ms=120_000.0)
     eng = ServingEngine(params, cfg, sv, mesh=mesh, hpc=hpc,
                         axes_tree=axes, registry=reg,
                         compute_dtype=jnp.float32)
@@ -335,16 +340,55 @@ def test_shared_prefix_drill_mesh8(tmp_path, spec):
     records = [json.loads(line) for line in open(metrics_path)]
     names = {(r.get("kind"), r.get("name")) for r in records}
     assert ("gauge", "serve/prefix_hit_rate") in names
+    assert ("gauge", "serve/slo_ttft_attainment") in names
+    assert ("gauge", "serve/slo_itl_attainment") in names
+    assert ("histogram", "serve/queue_wait_ms") in names
     if spec:
         assert ("gauge", "serve/spec_accept_rate") in names
         assert ("counter", "serve/drafted_tokens") in names
 
-    from hetu_galvatron_tpu.cli.summarize import summarize
+    # acceptance: every request (the 32 staggered + the 6 A/B probes)
+    # yields a complete, ordered timeline, and the TTFT component split
+    # is additive to the measured TTFT
+    from hetu_galvatron_tpu.cli.summarize import (
+        request_timelines,
+        summarize,
+        timeline_complete,
+        ttft_components,
+    )
+
+    timelines, bad = request_timelines(records)
+    assert bad == 0
+    want_rids = {h.request.rid for h in handles} | {
+        h.request.rid for h in (hc, hh)}
+    assert want_rids <= set(timelines)
+    for rid, evs in timelines.items():
+        assert timeline_complete(evs), (rid, [e["ev"] for e in evs])
+    comp = ttft_components(timelines)
+    assert len(comp["ttft"]) == len(timelines)
+    for q, p, d, t in zip(comp["queue"], comp["prefill"],
+                          comp["first_decode"], comp["ttft"]):
+        assert q + p + d == pytest.approx(t, abs=1e-6)
+    # shared-prefix hits really skipped the cached prefill: the A/B hit
+    # probe's admit shows the 11 matched blocks and its prefill dispatch
+    # covered only the 1-token uncached suffix (the cold probe paid the
+    # full 88-token prompt)
+    hit_evs = timelines[hh.request.rid]
+    admit = next(e for e in hit_evs if e["ev"] == "admit")
+    assert admit["cached_len"] == 88 and admit["hit_blocks"] == 11
+    hit_pf = next(e for e in hit_evs if e["ev"] == "prefill")
+    assert hit_pf["cached"] == 88 and hit_pf["suffix"] == 1
+    cold_evs = timelines[hc.request.rid]
+    cold_pf = next(e for e in cold_evs if e["ev"] == "prefill")
+    assert cold_pf["cached"] == 0 and cold_pf["suffix"] == 89
 
     buf = io.StringIO()
     headline = summarize(metrics_path, out=buf)
     text = buf.getvalue()
     assert "prefix hit rate" in text
     assert headline["prefix_hit_rate"] > 0.5
+    assert headline["timelines_complete"] == headline["requests_traced"]
+    assert "TTFT breakdown" in text and "SLO" in text
+    assert headline["serve/slo_ttft_attainment"] == 1.0
     if spec:
         assert "spec accept rate" in text
