@@ -1,7 +1,9 @@
-"""Static analysis suite: plan doctor, jaxpr collective census, AST lint.
+"""Static analysis suite: plan doctor, collective census, AST lint,
+memory doctor, sharding-flow analysis.
 
-Three passes that run on CPU with no devices and no training step, so a
-malformed or inexpressible plan is caught BEFORE any TPU time is burned
+Five passes that run on CPU with no devices and no training step, so a
+malformed, inexpressible, OOM-bound or byte-wasting plan is caught
+BEFORE any TPU time is burned
 (``python -m hetu_galvatron_tpu.cli.check``):
 
 * :mod:`~hetu_galvatron_tpu.analysis.eligibility` — the ONE home of every
@@ -18,8 +20,17 @@ malformed or inexpressible plan is caught BEFORE any TPU time is burned
   cross-check against the plan's predicted collective counts.
 * :mod:`~hetu_galvatron_tpu.analysis.lint` — Pass 3: stdlib-``ast`` lint
   passes (host sync in hot paths, jit-in-loop, mesh-axis canon, dynamic
-  named_scope, bare except) with a committed baseline so the CI gate is
-  zero-NEW-findings.
+  named_scope, bare except, env reads outside the schema) with a
+  committed baseline so the CI gate is zero-NEW-findings.
+* :mod:`~hetu_galvatron_tpu.analysis.memory_doctor` — Pass 4: static
+  per-device peak-HBM accounting (model states / activations / compiled
+  stage buffer / vocab replication / serving KV pool) cross-checked per
+  component against the search engine's memory cost model, with an
+  ``--hbm-gb`` budget gate the search engine prunes with too.
+* :mod:`~hetu_galvatron_tpu.analysis.sharding_flow` — Pass 5: the census
+  extended from counts to BYTES (exact cross-check against
+  ``telemetry.plan_collective_bytes``), reshard detection and the
+  donation audit, plus the slow-tier partition-time HLO collective walk.
 """
 
 from hetu_galvatron_tpu.analysis.eligibility import (  # noqa: F401

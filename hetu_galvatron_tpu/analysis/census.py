@@ -200,14 +200,18 @@ def _tiny_batch(cfg: Any, global_bsz: int, seed: int = 0):
             "labels": toks[:, 1:].astype(np.int32)}
 
 
-def census_compiled_step(cfg: Any, hpc: Any, train: Any, *,
-                         tp_overlap: bool = True,
-                         num_microbatches: Optional[int] = None,
-                         devices: Optional[list] = None) -> CensusResult:
-    """Trace the compiled single-program 1F1B step for a plan and census
-    it. Builds the engine on (virtual CPU) devices, splits freshly
-    initialized params, and calls ``CompiledPipelineEngine.step_jaxpr`` —
-    tracing only, nothing executes a training step."""
+def trace_compiled_step(cfg: Any, hpc: Any, train: Any, *,
+                        tp_overlap: bool = True,
+                        num_microbatches: Optional[int] = None,
+                        devices: Optional[list] = None,
+                        donate: bool = True):
+    """Build the compiled 1F1B engine on (virtual CPU) devices, split
+    freshly initialized params, and return
+    ``(step ClosedJaxpr, overlap-ineligibility note or None)`` via
+    ``CompiledPipelineEngine.step_jaxpr`` — tracing only, nothing executes
+    a training step. Shared by the collective census (Pass 2) and the
+    sharding-flow byte census (Pass 5); ``donate=False`` exists for the
+    undonated-buffer drill."""
     from hetu_galvatron_tpu.models.builder import init_causal_lm
     from hetu_galvatron_tpu.runtime.compiled_pipeline import (
         CompiledPipelineEngine,
@@ -218,16 +222,30 @@ def census_compiled_step(cfg: Any, hpc: Any, train: Any, *,
 
     eng = CompiledPipelineEngine(cfg, hpc, train, devices=devices,
                                  compute_dtype=jnp.float32,
-                                 tp_overlap=tp_overlap, donate=True)
+                                 tp_overlap=tp_overlap, donate=donate)
     params, axes = init_causal_lm(jax.random.key(0), cfg)
     sp = eng.split_params(params, axes)
     so = eng.init_opt(sp, axes)
     jaxpr = eng.step_jaxpr(sp, so, _tiny_batch(cfg, hpc.global_bsz),
                            num_microbatches)
-    out = census_jaxpr(jaxpr)
+    note = None
     if tp_overlap and not eng.tp_overlap:
-        out.notes.append(f"tp_overlap requested but ineligible: "
-                         f"{eng.overlap_reason}")
+        note = f"tp_overlap requested but ineligible: {eng.overlap_reason}"
+    return jaxpr, note
+
+
+def census_compiled_step(cfg: Any, hpc: Any, train: Any, *,
+                         tp_overlap: bool = True,
+                         num_microbatches: Optional[int] = None,
+                         devices: Optional[list] = None) -> CensusResult:
+    """Trace the compiled single-program 1F1B step for a plan and census
+    it (:func:`trace_compiled_step` + :func:`census_jaxpr`)."""
+    jaxpr, note = trace_compiled_step(
+        cfg, hpc, train, tp_overlap=tp_overlap,
+        num_microbatches=num_microbatches, devices=devices)
+    out = census_jaxpr(jaxpr)
+    if note is not None:
+        out.notes.append(note)
     return out
 
 
@@ -254,12 +272,12 @@ def census_spmd_step(cfg: Any, hpc: Any, train: Any, mesh: Any,
     return census_jaxpr(jaxpr)
 
 
-def census_serving_programs(cfg: Any, *, mesh: Any = None, hpc: Any = None,
-                            bucket: Optional[int] = None,
-                            serving: Any = None) -> Dict[str, CensusResult]:
-    """Trace the serving prefill + decode programs (``serving/engine.py``)
-    and census each — catches a host callback or an unmarked collective
-    creeping into the token-latency path."""
+def trace_serving_programs(cfg: Any, *, mesh: Any = None, hpc: Any = None,
+                           bucket: Optional[int] = None,
+                           serving: Any = None) -> Dict[str, Any]:
+    """ClosedJaxprs of every serving program family
+    (``ServingEngine.step_jaxprs``) on a throwaway engine — the shared
+    trace entry for the count census and the sharding-flow byte census."""
     import jax
 
     from hetu_galvatron_tpu.models.builder import init_causal_lm
@@ -271,10 +289,20 @@ def census_serving_programs(cfg: Any, *, mesh: Any = None, hpc: Any = None,
         kw = {"mesh": mesh, "hpc": hpc, "axes_tree": axes}
     eng = ServingEngine(params, cfg, serving, **kw)
     try:
-        jaxprs = eng.step_jaxprs(bucket=bucket)
-        return {name: census_jaxpr(j) for name, j in jaxprs.items()}
+        return eng.step_jaxprs(bucket=bucket)
     finally:
         eng.close()
+
+
+def census_serving_programs(cfg: Any, *, mesh: Any = None, hpc: Any = None,
+                            bucket: Optional[int] = None,
+                            serving: Any = None) -> Dict[str, CensusResult]:
+    """Trace the serving prefill + decode programs (``serving/engine.py``)
+    and census each — catches a host callback or an unmarked collective
+    creeping into the token-latency path."""
+    jaxprs = trace_serving_programs(cfg, mesh=mesh, hpc=hpc, bucket=bucket,
+                                    serving=serving)
+    return {name: census_jaxpr(j) for name, j in jaxprs.items()}
 
 
 # ---------------------------------------------------------------------------

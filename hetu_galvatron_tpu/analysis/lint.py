@@ -1,6 +1,6 @@
 """Pass 3 — custom AST lint over the package (stdlib ``ast`` only).
 
-Five rules encode repo invariants that no off-the-shelf linter knows:
+Six rules encode repo invariants that no off-the-shelf linter knows:
 
 * **GAL001 host-sync-in-hot-path** — ``.item()`` / ``np.asarray`` /
   ``jax.device_get`` in the step-path modules (trainer, both pipeline
@@ -21,6 +21,13 @@ Five rules encode repo invariants that no off-the-shelf linter knows:
 * **GAL005 silent exception swallowing** — bare ``except:`` anywhere, and
   ``except Exception`` whose body is only ``pass``/``continue``: the audit
   path (crash-path ``finally`` blocks) must log what it swallows.
+* **GAL006 env-read outside the schema** — ``os.environ[...]`` /
+  ``os.environ.get`` / ``os.getenv`` anywhere but ``core/args_schema.py``
+  and ``cli/``: configuration must flow through the validated schema, not
+  ambient process state a run cannot reproduce from its config file.
+  (Test/tool code is outside the package walk, so it is exempt by
+  construction; audited legitimate hits — retry knobs, launcher env
+  contracts — stay baselined with one-line justifications.)
 
 Findings are identified by a line-number-free fingerprint
 (rule:file:function:snippet#occurrence), so the committed baseline
@@ -57,6 +64,11 @@ HOT_PATH_MODULES = (
 
 # mesh axis-name canon (runtime/mesh.py build_mesh): 'pp' + binary d-axes
 _AXIS_CANON = re.compile(r"^(pp|d\d+)$")
+
+# modules where GAL006 permits ambient-environment reads: the schema is
+# where config is DEFINED, and cli/ is the process boundary that feeds it
+_ENV_EXEMPT_PREFIXES = ("cli/",)
+_ENV_EXEMPT_FILES = ("core/args_schema.py",)
 
 # collective calls whose axis-name argument is checked by GAL003:
 # {callee name: positional index of the axis-name arg}
@@ -114,6 +126,8 @@ class _Visitor(ast.NodeVisitor):
         self.path = path
         self.src_lines = src.splitlines()
         self.hot_path = hot_path
+        self.env_exempt = (path in _ENV_EXEMPT_FILES
+                           or path.startswith(_ENV_EXEMPT_PREFIXES))
         self.findings: List[Finding] = []
         self._func_stack: List[str] = []
         self._loop_depth = 0
@@ -189,6 +203,16 @@ class _Visitor(ast.NodeVisitor):
         elif short in _SPEC_CALLS:
             for a in node.args:
                 self._check_axis_literals(a)
+        # GAL006: ambient-environment reads outside the schema/CLI boundary
+        if not self.env_exempt:
+            if callee in ("os.getenv", "getenv"):
+                self._add("GAL006", node,
+                          "os.getenv outside core/args_schema.py / cli/ — "
+                          "config must flow through the schema")
+            elif callee in ("os.environ.get", "environ.get"):
+                self._add("GAL006", node,
+                          "os.environ.get outside core/args_schema.py / "
+                          "cli/ — config must flow through the schema")
         # GAL004: dynamic named_scope names
         if short == "named_scope" and node.args:
             a = node.args[0]
@@ -215,6 +239,19 @@ class _Visitor(ast.NodeVisitor):
                 self._add("GAL003", n,
                           f"mesh axis literal {v!r} is not in the "
                           "runtime/mesh.py canon (pp, d0..dk)")
+
+    def visit_Subscript(self, node: ast.Subscript):
+        # GAL006: os.environ["X"] reads (and writes — mutating the
+        # process environment outside the CLI boundary is worse)
+        if not self.env_exempt:
+            v = node.value
+            if (isinstance(v, ast.Attribute) and v.attr == "environ"
+                    and isinstance(v.value, ast.Name)
+                    and v.value.id == "os"):
+                self._add("GAL006", node,
+                          "os.environ[...] outside core/args_schema.py / "
+                          "cli/ — config must flow through the schema")
+        self.generic_visit(node)
 
     def visit_ExceptHandler(self, node: ast.ExceptHandler):
         if node.type is None:
@@ -285,7 +322,8 @@ def lint_package(root: Optional[str] = None) -> List[Finding]:
 # ---------------------------------------------------------------------------
 
 
-def load_baseline(path: str = DEFAULT_BASELINE) -> Dict[str, str]:
+def load_baseline(path: Optional[str] = None) -> Dict[str, str]:
+    path = path or DEFAULT_BASELINE  # resolved at call time (testable)
     if not os.path.exists(path):
         return {}
     with open(path, encoding="utf-8") as f:
@@ -293,12 +331,13 @@ def load_baseline(path: str = DEFAULT_BASELINE) -> Dict[str, str]:
     return {k: str(v) for k, v in obj.get("findings", obj).items()}
 
 
-def save_baseline(findings: List[Finding], path: str = DEFAULT_BASELINE,
+def save_baseline(findings: List[Finding], path: Optional[str] = None,
                   keep: Optional[Dict[str, str]] = None) -> None:
     """Write the baseline for the CURRENT findings, preserving existing
     justifications; new entries get a TODO placeholder a human must
     replace (the gate treats TODO entries as accepted — the review
     happens at commit time, on the diff)."""
+    path = path or DEFAULT_BASELINE
     keep = keep or {}
     out = {f.fingerprint: keep.get(f.fingerprint,
                                    "TODO: justify or fix")
@@ -319,3 +358,24 @@ def stale_baseline(findings: List[Finding],
     them so the baseline only ever shrinks in meaning)."""
     live = {f.fingerprint for f in findings}
     return [k for k in baseline if k not in live]
+
+
+def prune_baseline(findings: List[Finding], path: Optional[str] = None
+                   ) -> List[str]:
+    """Drop the stale entries from the committed baseline IN PLACE and
+    return the removed fingerprints. Unlike ``save_baseline`` (which
+    rewrites the file from the CURRENT findings, adding TODO entries for
+    new ones), this only ever REMOVES: live entries keep their
+    justifications untouched and no new finding is auto-accepted — the
+    safe way to clear a red stale-baseline gate after deleting code
+    (``cli/check.py --prune-baseline``)."""
+    path = path or DEFAULT_BASELINE
+    baseline = load_baseline(path)
+    stale = stale_baseline(findings, baseline)
+    if not stale:
+        return []
+    kept = {k: v for k, v in baseline.items() if k not in stale}
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"findings": dict(sorted(kept.items()))}, f, indent=1)
+        f.write("\n")
+    return stale

@@ -1,6 +1,6 @@
 """Static checks CLI: ``python -m hetu_galvatron_tpu.cli.check``.
 
-Run the three-pass static analysis suite (``analysis/``) on CPU — no TPU,
+Run the five-pass static analysis suite (``analysis/``) on CPU — no TPU,
 no training step — BEFORE burning accelerator time:
 
 * ``--plan plan.json [--model cfg.yaml] [--world N]`` — Pass 1, the plan
@@ -11,13 +11,26 @@ no training step — BEFORE burning accelerator time:
   collectives, verify named_scope marker coverage and the exact-count
   cross-check against the plan arithmetic
   (``telemetry.plan_collective_counts``).
-* ``--lint [--update-baseline]`` — Pass 3: the AST lint with the
-  committed baseline (``analysis/lint_baseline.json``); the gate is zero
-  NEW findings.
-* ``--all`` — every pass: the plan doctor over the committed example
-  plans, the census smoke, and the lint gate. This is the CI step
+* ``--lint [--update-baseline | --prune-baseline]`` — Pass 3: the AST
+  lint with the committed baseline (``analysis/lint_baseline.json``);
+  the gate is zero NEW findings. ``--prune-baseline`` auto-removes STALE
+  fingerprints only (no new finding is ever auto-accepted).
+* ``--memory [--hbm-gb N]`` — Pass 4, the memory doctor: static
+  per-device peak-HBM accounting for the committed example plans
+  (model states / activations / compiled-engine stage buffer / vocab
+  replication / serving KV pool), cross-checked per component against
+  the search engine's memory cost model; ``--hbm-gb`` rejects plans
+  whose predicted peak exceeds the budget — the SAME predicate the
+  search engine prunes with (``search.hbm_budget_gb``).
+* ``--flow`` — Pass 5, the sharding-flow analysis: the census extended
+  from counts to BYTES (per-collective megabytes cross-checked exactly
+  against ``telemetry.plan_collective_bytes``), plus reshard detection
+  (stray all-gathers, double-resharded values) and the donation audit
+  over the step + serving programs.
+* ``--all`` — every pass on the committed examples. This is the CI step
   (``__graft_entry__.dryrun_multichip`` runs it and tier-1 asserts it
-  green).
+  green). The partition-time HLO walk (``sharding_flow.hlo_collectives``)
+  compiles programs and rides the slow test tier instead.
 
 Exit code 0 = clean, 1 = findings/errors, 2 = usage.
 """
@@ -106,7 +119,6 @@ def run_census(verbose: bool = True) -> int:
         census_serving_programs,
         check_census,
     )
-    from hetu_galvatron_tpu.core.args_schema import ServingArgs
     from hetu_galvatron_tpu.observability.telemetry import (
         plan_collective_counts,
     )
@@ -139,9 +151,7 @@ def run_census(verbose: bool = True) -> int:
     # single-device tiny engine; the check is marker coverage + no host
     # callbacks in the token-latency path (prefix_cache/spec_decode on so
     # the new program families are censused too)
-    serving = ServingArgs(max_batch_size=2, kv_block_size=8,
-                          max_seq_len=32, num_kv_blocks=10,
-                          prefix_cache=True, spec_decode=True, spec_k=2)
+    serving = _census_serving_args()
     for name, sc in census_serving_programs(
             args.model, serving=serving).items():
         if verbose:
@@ -154,17 +164,128 @@ def run_census(verbose: bool = True) -> int:
     return 0 if not problems else 1
 
 
-def run_lint(update_baseline: bool = False, verbose: bool = True) -> int:
+def _census_serving_args():
+    """The serving shape every serving-program pass censuses (prefix
+    cache + spec decode on, so all program families are covered)."""
+    from hetu_galvatron_tpu.core.args_schema import ServingArgs
+
+    return ServingArgs(max_batch_size=2, kv_block_size=8,
+                       max_seq_len=32, num_kv_blocks=10,
+                       prefix_cache=True, spec_decode=True, spec_k=2)
+
+
+def run_memory(hbm_gb: Optional[float] = None, verbose: bool = True,
+               schedule_impl: str = "compiled") -> int:
+    """Pass 4: the memory doctor over every committed example plan, plus
+    a serving-mode row (KV pool + prefix budget) on the acceptance plan.
+    ``--hbm-gb`` turns the accounting into a gate; ``--schedule-impl``
+    picks the engine convention to account for (compiled — the
+    conservative default the search's HBM gate also uses — adds the
+    stage-input buffer and the vocab replication premium)."""
+    from hetu_galvatron_tpu.analysis.memory_doctor import diagnose_memory
+
+    model = _example_model().model
+    rc = 0
+    for plan in sorted(glob.glob(os.path.join(EXAMPLE_PLAN_DIR, "*.json"))):
+        report = diagnose_memory(plan, model, 8, hbm_gb=hbm_gb,
+                                 schedule_impl=schedule_impl)
+        if verbose:
+            report.render()
+            print()
+        rc |= 0 if report.ok else 1
+    serving = _census_serving_args()
+    report = diagnose_memory(ACCEPTANCE_PLAN, model, 8, hbm_gb=hbm_gb,
+                             serving=serving,
+                             schedule_impl=schedule_impl)
+    if verbose:
+        print("(serving mode: paged KV pool + prefix-cache budget)")
+        report.render()
+    rc |= 0 if report.ok else 1
+    print(f"memory doctor: {'OK' if rc == 0 else 'FAILED'} (all plans)")
+    return rc
+
+
+def run_flow(verbose: bool = True) -> int:
+    """Pass 5: the sharding-flow byte census on the acceptance plan's
+    compiled step (exact cross-check against
+    ``telemetry.plan_collective_bytes``, donation audit, reshard lint)
+    plus the serving program families (reshard lint; their params stay
+    undonated by design)."""
+    _force_cpu_devices()
+    from hetu_galvatron_tpu.analysis.sharding_flow import (
+        check_donation,
+        check_flow,
+        flow_compiled_step,
+        flow_serving_programs,
+    )
+    from hetu_galvatron_tpu.observability.telemetry import (
+        plan_collective_bytes,
+    )
+    from hetu_galvatron_tpu.runtime.hybrid_config import (
+        get_hybrid_parallel_config,
+    )
+
+    args = _example_model()
+    args.parallel.config_mode = "json"
+    args.parallel.galvatron_config_path = ACCEPTANCE_PLAN
+    hpc = get_hybrid_parallel_config(args, 8)
+    problems: List[str] = []
+
+    pf = flow_compiled_step(args.model, hpc, args.train, tp_overlap=True)
+    predicted = plan_collective_bytes(hpc, args.model, tp_overlap=True)
+    if verbose:
+        cats = {k: round(v, 6) for k, v in pf.flow.mb_by_cat.items()}
+        marks = {k: round(v, 6)
+                 for k, v in pf.flow.permute_mb_by_marker.items()}
+        pred = {k: round(v, 6) for k, v in predicted.items()}
+        print(f"flow: compiled 1F1B step [{hpc.describe()}] moves "
+              f"{cats} MB (markers {marks})")
+        print(f"flow: plan arithmetic predicts {pred} MB")
+        print(f"flow: donation — {pf.donation.donated_mb:.2f} MB donated, "
+              f"{pf.donation.undonated_mb:.2f} MB undonated")
+    problems += check_flow(pf.flow, predicted, program="compiled_step")
+    problems += check_donation(pf.donation, program="compiled_step")
+    problems += pf.reshard_problems
+    for n in pf.flow.notes:
+        print(f"flow note: {n}")
+
+    for name, spf in flow_serving_programs(
+            args.model, serving=_census_serving_args()).items():
+        if verbose:
+            scats = {k: round(v, 6)
+                     for k, v in spf.flow.mb_by_cat.items()} or "{}"
+            print(f"flow: serving {name} -> {scats} MB "
+                  f"(donated {spf.donation.donated_mb:.2f} MB)")
+        problems += spf.reshard_problems
+
+    for p in problems:
+        print(f"FLOW FAILURE: {p}")
+    print(f"flow: {'OK' if not problems else 'FAILED'}")
+    return 0 if not problems else 1
+
+
+def run_lint(update_baseline: bool = False, prune_stale: bool = False,
+             verbose: bool = True) -> int:
     from hetu_galvatron_tpu.analysis.lint import (
         lint_package,
         load_baseline,
         new_findings,
+        prune_baseline,
         save_baseline,
         stale_baseline,
     )
 
     findings = lint_package()
     baseline = load_baseline()
+    if prune_stale:
+        removed = prune_baseline(findings)
+        print(f"lint: pruned {len(removed)} stale baseline entr"
+              f"{'y' if len(removed) == 1 else 'ies'}")
+        for k in removed[:10]:
+            print(f"  pruned: {k}")
+        baseline = load_baseline()
+        # fall through: the gate still runs, so a prune that leaves NEW
+        # findings behind stays red (pruning never accepts new findings)
     if update_baseline:
         save_baseline(findings, keep=baseline)
         print(f"lint: baseline rewritten with {len(findings)} finding(s); "
@@ -195,15 +316,21 @@ def run_lint(update_baseline: bool = False, verbose: bool = True) -> int:
     return 0 if not new and not stale else 1
 
 
-def run_all() -> int:
+def run_all(hbm_gb: Optional[float] = None,
+            schedule_impl: str = "compiled") -> int:
     """The CI gate: plan doctor over every committed example plan, the
-    census smoke, the lint baseline gate."""
+    census smoke, the memory doctor with its cost-model cross-check, the
+    sharding-flow byte census, and the lint baseline gate."""
     _force_cpu_devices()
     rc = 0
     for plan in sorted(glob.glob(os.path.join(EXAMPLE_PLAN_DIR, "*.json"))):
-        rc |= run_doctor(plan, None, 8)
+        rc |= run_doctor(plan, None, 8, schedule_impl=schedule_impl)
         print()
     rc |= run_census()
+    print()
+    rc |= run_memory(hbm_gb=hbm_gb, schedule_impl=schedule_impl)
+    print()
+    rc |= run_flow()
     print()
     rc |= run_lint()
     print()
@@ -235,13 +362,29 @@ def main(argv=None) -> int:
     p.add_argument("--update-baseline", action="store_true",
                    help="rewrite the lint baseline from current findings, "
                    "preserving existing justifications")
+    p.add_argument("--prune-baseline", action="store_true",
+                   help="remove STALE lint-baseline fingerprints only "
+                   "(never accepts new findings), then run the gate")
+    p.add_argument("--memory", action="store_true",
+                   help="run the memory doctor (Pass 4): static "
+                   "per-device peak-HBM accounting + cost-model "
+                   "cross-check on the committed example plans")
+    p.add_argument("--hbm-gb", type=float, default=None,
+                   help="per-device HBM budget in GB: the memory doctor "
+                   "REJECTS plans whose predicted peak exceeds it (the "
+                   "same predicate search.hbm_budget_gb prunes with)")
+    p.add_argument("--flow", action="store_true",
+                   help="run the sharding-flow analysis (Pass 5): "
+                   "byte-level collective census with the exact "
+                   "plan_collective_bytes cross-check, reshard "
+                   "detection, and the donation audit")
     p.add_argument("--all", action="store_true",
                    help="every pass on the committed examples (the CI "
                    "step)")
     a = p.parse_args(argv)
 
     if a.all:
-        return run_all()
+        return run_all(hbm_gb=a.hbm_gb, schedule_impl=a.schedule_impl)
     rc = None
     if a.plan:
         _force_cpu_devices()
@@ -250,8 +393,14 @@ def main(argv=None) -> int:
                         tp_overlap=not a.no_tp_overlap)
     if a.census:
         rc = (rc or 0) | run_census()
-    if a.lint or a.update_baseline:
-        rc = (rc or 0) | run_lint(update_baseline=a.update_baseline)
+    if a.memory:
+        rc = (rc or 0) | run_memory(hbm_gb=a.hbm_gb,
+                                    schedule_impl=a.schedule_impl)
+    if a.flow:
+        rc = (rc or 0) | run_flow()
+    if a.lint or a.update_baseline or a.prune_baseline:
+        rc = (rc or 0) | run_lint(update_baseline=a.update_baseline,
+                                  prune_stale=a.prune_baseline)
     if rc is None:
         p.print_help()
         return 2

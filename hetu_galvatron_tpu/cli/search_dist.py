@@ -23,6 +23,9 @@ def main(argv=None) -> int:
         mixed_precision=args.search.mixed_precision,
         default_dp_type=args.search.default_dp_type,
         pipeline_type=args.search.pipeline_type,
+        # the static HBM gate (search.hbm_budget_gb) accounts the actual
+        # model shapes, so the searcher gets the resolved config
+        model_cfg=args.model,
     )
     engine.set_model_info(model_layer_configs(args.model),
                           model_name(args.model),

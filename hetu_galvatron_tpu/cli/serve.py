@@ -35,10 +35,15 @@ skip that prefill entirely; ``serve/prefix_hit_rate`` lands in the
 metrics). ``serving.spec_decode=1`` adds lossless speculative decoding
 (``serving.spec_k`` drafted tokens per step via n-gram prompt-lookup,
 verified in one batched pass; greedy output is bit-identical, and
-``serve/spec_accept_rate`` reports how often drafts paid off). A small
-draft model (``serving.spec_draft=model``) is an engine-API feature
-(pass ``draft_params``/``draft_cfg`` to ``ServingEngine``); this CLI
-serves the n-gram draft.
+``serve/spec_accept_rate`` reports how often drafts paid off).
+
+A small DRAFT MODEL instead of the n-gram draft:
+``serving.spec_draft=model`` with ``draft_model=<model.yaml>`` (the
+draft architecture — its vocab must match the target's) and optionally
+``draft_ckpt=<framework ckpt root>`` for the draft weights; without a
+checkpoint the draft serves random weights (smoke mode, warned). The
+engine already took ``draft_params``/``draft_cfg`` — this is the CLI
+path to it.
 
 With more than one visible device the decode runs under the plan's GSPMD
 shardings exactly like ``cli/generate.py`` (pure-TP submesh unless explicit
@@ -70,10 +75,69 @@ def _read_requests(kv):
     return [req]
 
 
+def _ckpt_params(ckdir: str, params_target):
+    """Load a framework checkpoint (a step_* dir or a root holding them)
+    into the given eval_shape target; returns (params, resolved dir,
+    step). Shared by the target-model and draft-model load paths."""
+    import os
+
+    from hetu_galvatron_tpu.runtime.checkpoint import (
+        latest_checkpoint,
+        load_checkpoint,
+    )
+
+    if not os.path.basename(ckdir).startswith("step_"):
+        found = latest_checkpoint(ckdir)
+        if found is None:
+            raise FileNotFoundError(
+                f"no step_* checkpoint found under {ckdir}")
+        ckdir = found
+    params, _, step = load_checkpoint(ckdir, params_target)
+    return params, ckdir, step
+
+
+def _load_draft(kv, serving):
+    """The draft-model checkpoint path (serving.spec_draft=model):
+    resolve ``draft_model=<yaml>`` to a ModelArgs, load ``draft_ckpt``
+    weights when given (random smoke weights otherwise), and return
+    (draft_params, draft_cfg) for ``ServingEngine``. Returns (None, None)
+    when the n-gram draft (or no spec decode) is configured."""
+    if not (serving.spec_decode and serving.spec_draft == "model"):
+        return None, None
+    import jax
+
+    from hetu_galvatron_tpu.core.arguments import args_from_cli
+    from hetu_galvatron_tpu.models.builder import init_causal_lm
+    from hetu_galvatron_tpu.utils.hf_config_adapter import (
+        resolve_model_config,
+    )
+
+    if not kv.get("draft_model"):
+        raise ValueError(
+            "serving.spec_draft=model needs draft_model=<model.yaml> "
+            "(the draft architecture); pass draft_ckpt=<ckpt root> for "
+            "its weights")
+    dargs = args_from_cli([kv["draft_model"]], mode="train_dist")
+    draft_cfg = resolve_model_config(dargs).model
+    key = jax.random.key(int(kv.get("seed", 0)) + 1)
+    if kv.get("draft_ckpt"):
+        target = jax.eval_shape(
+            lambda k: init_causal_lm(k, draft_cfg)[0], key)
+        draft_params, ckdir, step = _ckpt_params(kv["draft_ckpt"], target)
+        print(f"loaded draft {ckdir} (step {step})", file=sys.stderr)
+    else:
+        print("warning: no draft_ckpt given; drafting with RANDOM "
+              "weights (smoke mode — accept rate will be ~0)",
+              file=sys.stderr)
+        draft_params = init_causal_lm(key, draft_cfg)[0]
+    return draft_params, draft_cfg
+
+
 def main(argv=None) -> int:
     argv = list(argv if argv is not None else sys.argv[1:])
     kv_keys = ("prompt", "requests", "max_new_tokens", "temperature", "seed",
-               "tokenizer", "ckpt", "hf_path", "metrics", "stream")
+               "tokenizer", "ckpt", "hf_path", "metrics", "stream",
+               "draft_model", "draft_ckpt")
     kv = {}
     passthrough = []
     for a in argv:
@@ -114,21 +178,7 @@ def main(argv=None) -> int:
     params_target = jax.eval_shape(_shapes, init_key)
     axes = box["axes"]
     if kv.get("ckpt"):
-        import os
-
-        from hetu_galvatron_tpu.runtime.checkpoint import (
-            latest_checkpoint,
-            load_checkpoint,
-        )
-
-        ckdir = kv["ckpt"]
-        if not os.path.basename(ckdir).startswith("step_"):
-            found = latest_checkpoint(ckdir)
-            if found is None:
-                raise FileNotFoundError(
-                    f"no step_* checkpoint found under {ckdir}")
-            ckdir = found
-        params, _, step = load_checkpoint(ckdir, params_target)
+        params, ckdir, step = _ckpt_params(kv["ckpt"], params_target)
         print(f"loaded {ckdir} (step {step})", file=sys.stderr)
     elif kv.get("hf_path"):
         from hetu_galvatron_tpu.cli.checkpoint_convert import (
@@ -188,9 +238,11 @@ def main(argv=None) -> int:
         serving = serving.model_copy(
             update={"eos_id": getattr(tok, "eod_id", None)})
     stream = kv.get("stream", "1") not in ("0", "false", "False")
+    draft_params, draft_cfg = _load_draft(kv, serving)
     engine = ServingEngine(params, cfg, serving, mesh=mesh, hpc=hpc,
                            axes_tree=axes if mesh is not None else None,
-                           registry=registry)
+                           registry=registry,
+                           draft_params=draft_params, draft_cfg=draft_cfg)
     if engine.metrics_port is not None:
         # serving.metrics_port: Prometheus text endpoint over the serve/*
         # registry (observability/prometheus.py); port 0 binds ephemeral,

@@ -530,6 +530,15 @@ class SearchArgs(BaseModel):
     # cranking dispatch_us pushes the host-impl search away from deep pp.
     dispatch_us: float = 0.0
     pipeline_schedule_impl: Literal["host", "compiled"] = "host"
+    # Static HBM gate (analysis/memory_doctor.py): > 0 prunes candidate
+    # plans whose statically-accounted per-device peak exceeds this many
+    # GB — the EXACT predicate `cli/check.py --memory --hbm-gb` applies
+    # to plan JSONs (search == check parity), evaluated on the analytic
+    # model shapes rather than the profiled memory the DP knapsack uses.
+    # 0 (default) keeps the search's profiled-memory-only behavior.
+    # Needs the searcher to know the model config (SearchEngine
+    # model_cfg; cli/search_dist.py passes it).
+    hbm_budget_gb: float = 0.0
     # Overlapped-TP pricing (ops/overlap.py + the α-β collective model):
     # 1 prices eligible Megatron-TP layers with the max(comm, compute)-style
     # overlap discount (cost_model/cost.py layer_time_cost), mirroring a

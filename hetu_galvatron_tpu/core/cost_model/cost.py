@@ -386,16 +386,21 @@ def layer_time_components(s: "SearchStrategy", ctx: CostContext,
 # ---------------------------------------------------------------------------
 
 
-def layer_memory_cost(
+def layer_memory_components(
     s: "SearchStrategy",
     ctx: CostContext,
     gbsz: int,
     chunks: int,
     stage_idx: int = 0,
     pipeline_type: Optional[str] = None,
-) -> float:
-    """Per-layer memory in MB: model states + activations
-    (MemoryCostModelBase, layer_cost.py:261-328)."""
+) -> Dict[str, float]:
+    """Per-layer memory in MB, decomposed into the model-states and
+    activation terms (MemoryCostModelBase, layer_cost.py:261-328). The
+    memory doctor (``analysis/memory_doctor.py``) cross-checks its own
+    first-principles accounting against each component separately, so the
+    split is part of the contract; :func:`layer_memory_cost` folds the
+    same dict into the scalar the search optimizes — one arithmetic, two
+    views (the ``layer_time_components`` pattern)."""
     pipeline_type = pipeline_type or ctx.pipeline_type
     lbsz = gbsz // chunks // s.dp
     if s.pp == 1:
@@ -426,7 +431,22 @@ def layer_memory_cost(
     # model states do not (weights replicate over cp, but ZeRO already
     # shards states over sdp = dp*sp*cp above)
     activation /= s.cp
-    return model_states + activation
+    return {"model_states_mb": model_states, "activation_mb": activation,
+            "total_mb": model_states + activation}
+
+
+def layer_memory_cost(
+    s: "SearchStrategy",
+    ctx: CostContext,
+    gbsz: int,
+    chunks: int,
+    stage_idx: int = 0,
+    pipeline_type: Optional[str] = None,
+) -> float:
+    """Per-layer memory in MB: model states + activations
+    (MemoryCostModelBase, layer_cost.py:261-328)."""
+    return layer_memory_components(
+        s, ctx, gbsz, chunks, stage_idx, pipeline_type)["total_mb"]
 
 
 # ---------------------------------------------------------------------------
@@ -523,15 +543,18 @@ def embed_time_cost(
 # ---------------------------------------------------------------------------
 
 
-def embed_memory_cost(
+def embed_memory_components(
     s: "SearchStrategy",
     ctx: CostContext,
     gbsz: int,
     chunks: int,
     pipeline_type: Optional[str] = None,
-) -> List[float]:
-    """Per-stage vocab-layer memory in MB (EmbeddingLMHeadMemoryCostModel,
-    embedding_lmhead_cost.py:187-313)."""
+) -> Dict[str, List[float]]:
+    """Per-stage vocab-layer memory in MB, decomposed
+    (EmbeddingLMHeadMemoryCostModel, embedding_lmhead_cost.py:187-313) —
+    the cross-checkable view of :func:`embed_memory_cost`, which sums the
+    same three per-stage vectors (model states, activation, the flat
+    allocator-context reserve)."""
     pipeline_type = pipeline_type or ctx.pipeline_type
     lbsz = gbsz // chunks // s.dp
     pp = s.pp
@@ -568,8 +591,22 @@ def embed_memory_cost(
         activation[-1] = (ctx.other_memory_pp_on["last_stage"]["activation"]
                           [s.tp_sp] * cum_last * lbsz / s.cp)
 
-    return [m + a + ctx.pytorch_context_mem
-            for m, a in zip(model_states, activation)]
+    return {"model_states_mb": model_states, "activation_mb": activation,
+            "context_mb": [ctx.pytorch_context_mem] * pp}
+
+
+def embed_memory_cost(
+    s: "SearchStrategy",
+    ctx: CostContext,
+    gbsz: int,
+    chunks: int,
+    pipeline_type: Optional[str] = None,
+) -> List[float]:
+    """Per-stage vocab-layer memory in MB (EmbeddingLMHeadMemoryCostModel,
+    embedding_lmhead_cost.py:187-313)."""
+    comp = embed_memory_components(s, ctx, gbsz, chunks, pipeline_type)
+    return [m + a + c for m, a, c in zip(
+        comp["model_states_mb"], comp["activation_mb"], comp["context_mb"])]
 
 
 # ---------------------------------------------------------------------------

@@ -108,7 +108,8 @@ class SearchEngine:
 
     def __init__(self, args: SearchArgs, *, mixed_precision: str = "bf16",
                  default_dp_type: Optional[str] = None,
-                 pipeline_type: Optional[str] = None):
+                 pipeline_type: Optional[str] = None,
+                 model_cfg: Any = None):
         self.args = args
         self.world_size = args.num_nodes * args.num_devices_per_node
         self.memory_constraint = int(args.memory_constraint * 1024)  # MB
@@ -118,6 +119,11 @@ class SearchEngine:
         self.model_name: Optional[str] = None
         self.hardware: Optional[HardwareProfile] = None
         self.profile: Optional[ModelProfile] = None
+        # ModelArgs for the static HBM gate (args.hbm_budget_gb): the
+        # profiled memory the DP enforces and the doctor's analytic
+        # accounting are independent models, and the gate makes the
+        # search reject exactly what `check --memory --hbm-gb` would
+        self.model_cfg = model_cfg
 
     # ---------------- setup ----------------
 
@@ -258,6 +264,7 @@ class SearchEngine:
                 results = list(ex.map(solve, tasks))
         else:
             results = list(map(solve, tasks))
+        results = [self._hbm_gate(r) for r in results]
         best = TaskResult()
         for r in results:
             if r.throughput > best.throughput:
@@ -301,6 +308,49 @@ class SearchEngine:
         sink.write({"t": _time.time(), "kind": "event",
                     "name": "search_best", "data": win})
         sink.close()
+
+    def _hbm_gate(self, r: TaskResult) -> TaskResult:
+        """Static HBM gate (``args.hbm_budget_gb`` > 0, model config
+        known): prune a feasible candidate whose memory-doctor peak
+        busts the budget — the SAME predicate ``cli/check.py --memory
+        --hbm-gb`` applies to the written plan
+        (``analysis/memory_doctor.py::search_result_hbm_reason``).
+        Always accounted under the COMPILED-engine convention (the
+        checker's default, and the strict upper bound: it adds the
+        stage-input buffer and the vocab replication premium the host
+        engine doesn't pay), so a plan the search emits can never be one
+        ``check --memory --hbm-gb`` rejects — regardless of which
+        schedule impl the search was pricing time for.
+
+        Known altitude limitation: the gate runs POST-DP, on each
+        (bsz, chunks, pp) task's time-optimal winner — a pruned task may
+        still have a slower within-budget runner-up the DP never
+        surfaced (the DP's own memory constraint is the PROFILED
+        ``memory_constraint``, not this analytic one). Folding the
+        analytic predicate into candidate filtering is future work; the
+        gate's contract today is a backstop, not an optimizer."""
+        a = self.args
+        if (a.hbm_budget_gb <= 0 or self.model_cfg is None
+                or r.strategy_list is None):
+            return r
+        from hetu_galvatron_tpu.analysis.memory_doctor import (
+            search_result_hbm_reason,
+        )
+
+        reason = search_result_hbm_reason(
+            r.strategy_list, r.pp_stage_list, self.model_cfg,
+            global_bsz=r.bsz, chunks=r.chunks,
+            pipeline_type=self.pipeline_type,
+            schedule_impl="compiled",
+            hbm_gb=a.hbm_budget_gb,
+            vocab_tp_sp=r.vocab_tp_sp, vocab_sp=bool(r.vocab_sp),
+            vocab_sdp=bool(r.vocab_sdp),
+            mixed_precision=self.mixed_precision != "fp32")
+        if reason is None:
+            return r
+        print(f"hbm gate: pruned candidate (bsz {r.bsz} chunks {r.chunks} "
+              f"pp {r.pp_size}): {reason}")
+        return TaskResult(bsz=r.bsz, chunks=r.chunks)
 
     # ---------------- per-task DP ----------------
 

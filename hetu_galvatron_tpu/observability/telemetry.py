@@ -366,6 +366,70 @@ def plan_collective_counts(
     return out
 
 
+def plan_collective_bytes(
+    hpc,
+    model,
+    *,
+    num_microbatches: Optional[int] = None,
+    tp_overlap: bool = True,
+    elem_bytes: int = 4,
+) -> Dict[str, float]:
+    """Predicted per-device EXECUTED explicit-collective megabytes for the
+    compiled single-program 1F1B step — the byte-side companion of
+    :func:`plan_collective_counts` (counts) and :func:`plan_comm_volume`
+    (per-microbatch message megabytes), consumed by the sharding-flow
+    byte census (``analysis/sharding_flow.py``).
+
+    Derivation (same message arithmetic as :func:`plan_comm_volume`'s
+    ``act_mb = lbsz * seq * h * elem``, re-expressed in the executed
+    schedule's units):
+
+    * **tp rings** — each of :func:`plan_collective_counts`'s
+      ``T * lps * rings_per_tick * (tp-1)`` ppermute hops carries one
+      per-device sequence chunk ``act_mb / tp`` (``ops/overlap.py`` rings
+      rotate ``[lbsz, seq/tp, hidden]`` blocks; every fwd/bwd/recompute
+      ring's hop payload is that same chunk shape).
+    * **pp rotations** — ``2 * T`` stage rotations, each moving one
+      per-device slice of the stacked activation: ``act_mb / tp`` under
+      Megatron-SP (the boundary activation is sequence-sharded over tp),
+      the full ``act_mb`` at tp = 1.
+
+    Counts include the masked bubble ticks (T = m + 2(pp-1)), exactly as
+    the traced program executes them — so traced bytes == predicted bytes
+    with no tolerance. ``elem_bytes`` must match the traced compute dtype
+    (the census traces in f32 → 4; note a bf16 program would ALSO move
+    f32 ring accumulators, which this arithmetic does not model — trace
+    in f32 to cross-check).
+
+    Raises ValueError for plan shapes the prediction does not model, the
+    same gate as :func:`plan_collective_counts` (non-uniform strategies,
+    Ulysses/cp layers).
+    """
+    s = hpc.layers[0]
+    if any(l != s for l in hpc.layers):
+        raise ValueError("collective-byte prediction needs a uniform "
+                         "per-layer strategy (the compiled engine's gate)")
+    if s.sp or s.cp_size > 1:
+        raise ValueError("collective-byte prediction models Megatron-TP "
+                         "plans only (no Ulysses / cp ring layers)")
+    m = max(num_microbatches if num_microbatches is not None
+            else hpc.chunks, 1)
+    pp = max(hpc.pp_deg, 1)
+    tp = max(s.tp_size, 1)
+    T = m + 2 * (pp - 1)
+    lps = hpc.pp_division[0] if hpc.pp_division else len(hpc.layers)
+    lbsz = max(hpc.global_bsz // m // max(s.dp_size, 1), 1)
+    act_mb = lbsz * model.seq_length * model.hidden_size * elem_bytes / MB
+    out: Dict[str, float] = {}
+    if pp > 1:
+        out["ppermute_pp"] = 2 * T * act_mb / tp
+    if tp_overlap and tp > 1:
+        rings_per_tick = 4 + 8 + (4 if s.checkpoint else 0)
+        out["ppermute_tp"] = (T * lps * rings_per_tick * (tp - 1)
+                              * act_mb / tp)
+    return out
+
+
 def plan_tp_overlap_hidden_frac(hpc, model, overlapped: Sequence[int],
                                 mixed_precision: bool = True) -> float:
     """Predicted fraction of the plan's TP collective traffic hidden under

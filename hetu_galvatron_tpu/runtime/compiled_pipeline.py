@@ -894,13 +894,13 @@ class CompiledPipelineEngine:
             self._eval_jits[m] = self._build_eval(m)
         return {"loss": float(self._eval_jits[m](sp, batch))}
 
-    def step_jaxpr(self, sp: Params, opt: Any, batch: Dict[str, np.ndarray],
-                   num_microbatches: Optional[int] = None):
-        """ClosedJaxpr of the fused step program — the static-analysis hook
-        (``analysis/census.py``). Tracing never executes and never consumes
-        donated buffers, so this is safe before (or instead of) any real
-        step; the traced fn is cached in the step-jit cache, so a later
-        ``train_step`` at the same microbatch count reuses it."""
+    def _prep_trace(self, sp: Params, opt: Any,
+                    batch: Dict[str, np.ndarray],
+                    num_microbatches: Optional[int]):
+        """Shared trace-entry prep for :meth:`step_jaxpr` /
+        :meth:`step_lowered`: resolve the microbatch count, validate and
+        pop the dropout rng, stage the batch, and fill the step-jit
+        cache. Returns ``(fn, args)`` ready to trace or lower."""
         m = self._resolve_m(num_microbatches)
         batch = dict(batch)
         step_rng = batch.pop("dropout_rng", None)
@@ -914,9 +914,31 @@ class CompiledPipelineEngine:
         if m not in self._step_jits:
             self._step_jits[m] = self._build_step(m, self._use_dropout)
         fn = self._step_jits[m]
-        if self._use_dropout:
-            return jax.make_jaxpr(fn)(sp, opt, batch, step_rng)
-        return jax.make_jaxpr(fn)(sp, opt, batch)
+        args = (sp, opt, batch, step_rng) if self._use_dropout \
+            else (sp, opt, batch)
+        return fn, args
+
+    def step_jaxpr(self, sp: Params, opt: Any, batch: Dict[str, np.ndarray],
+                   num_microbatches: Optional[int] = None):
+        """ClosedJaxpr of the fused step program — the static-analysis hook
+        (``analysis/census.py``). Tracing never executes and never consumes
+        donated buffers, so this is safe before (or instead of) any real
+        step; the traced fn is cached in the step-jit cache, so a later
+        ``train_step`` at the same microbatch count reuses it."""
+        fn, args = self._prep_trace(sp, opt, batch, num_microbatches)
+        return jax.make_jaxpr(fn)(*args)
+
+    def step_lowered(self, sp: Params, opt: Any,
+                     batch: Dict[str, np.ndarray],
+                     num_microbatches: Optional[int] = None):
+        """``jax.stages.Lowered`` of the fused step — the partition-time
+        static-analysis hook (``analysis/sharding_flow.py`` compiles it
+        and scans the HLO for GSPMD-inserted collectives). Lowering reads
+        avals only; nothing executes and no donated buffer is consumed.
+        Compiling the returned object is the expensive part — callers on
+        the fast path should stick to :meth:`step_jaxpr`."""
+        fn, args = self._prep_trace(sp, opt, batch, num_microbatches)
+        return fn.lower(*args)
 
     def compile_count(self) -> int:
         """Total compiled executables across the engine's jit caches — the

@@ -76,6 +76,7 @@ from hetu_galvatron_tpu.serving.kv_cache import (
     paged_sdpa,
     paged_sdpa_window,
     scatter_prefill,
+    resolve_num_blocks,
     scatter_token,
     scatter_window,
 )
@@ -182,11 +183,10 @@ class ServingEngine:
         self.S = int(serving.max_batch_size)
 
         max_seq_len = serving.max_seq_len or cfg.max_position_embeddings
-        num_blocks = serving.num_kv_blocks
-        if not num_blocks:
-            # default pool: every lane can hold a full-length sequence
-            per_seq = -(-max_seq_len // serving.kv_block_size)
-            num_blocks = 1 + self.S * per_seq
+        # pool sizing is shared with the static memory doctor
+        # (kv_cache.resolve_num_blocks), so `check --memory --serving`
+        # predicts exactly the pool this engine allocates
+        num_blocks = resolve_num_blocks(serving, cfg)
 
         layer_shards = None
         self._pspecs = None

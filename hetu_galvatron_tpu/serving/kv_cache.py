@@ -323,6 +323,35 @@ def pool_pspecs(layer_shardings: Optional[Sequence[Any]],
     return specs
 
 
+def resolve_num_blocks(serving: Any, cfg: ModelArgs) -> int:
+    """The pool size an engine with these args will actually allocate:
+    ``serving.num_kv_blocks`` verbatim, or the default pool where every
+    decode lane can hold one full-length sequence (+ the reserved scratch
+    block). Pure arithmetic, shared by :class:`ServingEngine` and the
+    static memory doctor (``analysis/memory_doctor.py``) so the doctor's
+    HBM accounting can never drift from what the engine allocates."""
+    if serving.num_kv_blocks:
+        return int(serving.num_kv_blocks)
+    max_seq_len = serving.max_seq_len or cfg.max_position_embeddings
+    per_seq = -(-max_seq_len // serving.kv_block_size)
+    return 1 + int(serving.max_batch_size) * per_seq
+
+
+def kv_pool_mb(serving: Any, cfg: ModelArgs, *, kv_elem_bytes: int = 2,
+               tp: int = 1) -> float:
+    """Per-device megabytes of the preallocated paged KV pool under these
+    serving args: ``num_blocks`` blocks of
+    ``2 (k+v) * layers * block_size * kv_heads * head_dim`` elements, the
+    kv-head axis sharded over ``tp`` exactly when tp divides the kv-head
+    count (:func:`pool_pspecs`; replicated otherwise). ``kv_elem_bytes``
+    defaults to bf16 — the engine's default ``kv_dtype``."""
+    num_blocks = resolve_num_blocks(serving, cfg)
+    shard = tp if (tp > 1 and cfg.kv_heads % tp == 0) else 1
+    per_block = (2 * cfg.num_hidden_layers * serving.kv_block_size
+                 * cfg.kv_heads * cfg.head_dim * kv_elem_bytes)
+    return num_blocks * per_block / shard / (1024 * 1024)
+
+
 class PagedKVCache:
     """The pool + allocator pair one engine owns.
 

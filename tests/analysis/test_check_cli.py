@@ -17,15 +17,36 @@ pytestmark = [pytest.mark.staticcheck, pytest.mark.core]
 
 def test_check_all_is_green_at_head(capsys):
     """Every pass — plan doctor over the committed example plans, the
-    census with the exact-count cross-check, the lint baseline gate —
-    exits clean at HEAD."""
+    census with the exact-count cross-check, the memory doctor with the
+    cost-model cross-check, the sharding-flow byte census, the lint
+    baseline gate — exits clean at HEAD."""
     rc = check_cli.run_all()
     out = capsys.readouterr().out
     assert rc == 0, out
     assert "plan doctor: OK" in out
     assert "census: OK" in out
+    assert "memory doctor: OK (all plans)" in out
+    assert "flow: OK" in out
     assert "lint: OK" in out
     assert "check --all: OK" in out
+    # the memory pass prints a per-device peak and unit ratios
+    assert "per-device peak" in out
+    assert "cross-check ratios" in out
+    # the flow pass prints the exact byte prediction it matched
+    assert "plan arithmetic predicts" in out
+
+
+def test_check_memory_hbm_gate_rejects_oversized_plan(capsys):
+    """--memory --hbm-gb: a budget below the predicted peak turns the
+    pass red with the OOM diagnostic; a roomy budget stays green."""
+    rc = check_cli.main(["--memory", "--hbm-gb", "1e-05"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "exceeds the --hbm-gb budget" in out
+    rc = check_cli.main(["--memory", "--hbm-gb", "16"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "memory doctor: OK (all plans)" in out
 
 
 def test_check_doctor_flags_a_corrupted_plan(tmp_path, capsys):
@@ -47,6 +68,37 @@ def test_check_doctor_flags_a_corrupted_plan(tmp_path, capsys):
 
 def test_check_usage_exit_code():
     assert check_cli.main([]) == 2
+
+
+def test_prune_baseline_cli_clears_stale_gate(monkeypatch, capsys,
+                                              tmp_path):
+    """--prune-baseline end to end on a COPY of the committed baseline
+    (the real file stays untouched): a stale entry fails the gate, the
+    prune removes exactly it, and the gate goes green."""
+    import json
+    import shutil
+
+    from hetu_galvatron_tpu.analysis import lint as lint_mod
+
+    copy = tmp_path / "baseline.json"
+    shutil.copy(lint_mod.DEFAULT_BASELINE, copy)
+    obj = json.loads(copy.read_text())
+    obj["findings"]["GAL001:gone.py:f:x#0"] = "fixed code"
+    copy.write_text(json.dumps(obj))
+    # redirect every default-path read/write in run_lint to the copy
+    monkeypatch.setattr(lint_mod, "DEFAULT_BASELINE", str(copy))
+
+    rc = check_cli.run_lint()
+    out = capsys.readouterr().out
+    assert rc == 1 and "stale" in out
+
+    rc = check_cli.run_lint(prune_stale=True)
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "pruned 1 stale baseline entry" in out
+    assert "lint: OK" in out
+    after = json.loads(copy.read_text())["findings"]
+    assert "GAL001:gone.py:f:x#0" not in after
 
 
 def test_stale_baseline_fails_the_lint_gate(monkeypatch, capsys):

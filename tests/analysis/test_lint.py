@@ -226,6 +226,56 @@ def test_package_has_zero_new_findings():
     assert all(j and not j.startswith("TODO") for j in baseline.values())
 
 
+def test_gal006_env_read_outside_schema(tmp_path):
+    """Every os.environ read form is flagged outside the schema/CLI
+    boundary — and exempt inside it."""
+    src = """
+    import os
+    def conf():
+        a = os.environ.get("MY_KNOB")
+        b = os.environ["MY_KNOB"]
+        c = os.getenv("MY_KNOB", "1")
+        return a, b, c
+    """
+    fs = lint_src(tmp_path, src, rel="runtime/newmod.py", hot_path=False)
+    assert rules(fs) == ["GAL006", "GAL006", "GAL006"]
+    # the schema and the CLI boundary are exempt
+    for exempt in ("core/args_schema.py", "cli/serve.py"):
+        assert lint_src(tmp_path, src, rel=exempt, hot_path=False) == []
+
+
+def test_prune_baseline_roundtrip(tmp_path):
+    """--prune-baseline: stale fingerprints are removed IN PLACE, live
+    justifications survive untouched, and new findings are never
+    auto-accepted — the committed baseline round-trips."""
+    import json
+
+    from hetu_galvatron_tpu.analysis.lint import prune_baseline
+
+    src = """
+    import os
+    def conf():
+        return os.getenv("X")
+    """
+    fs = lint_src(tmp_path, src, rel="runtime/m.py", hot_path=False)
+    assert len(fs) == 1
+    live = fs[0].fingerprint
+    bl = tmp_path / "baseline.json"
+    bl.write_text(json.dumps({"findings": {
+        live: "audited: reason",
+        "GAL006:runtime/gone.py:f:os.getenv('Y')#0": "stale entry",
+    }}))
+    removed = prune_baseline(fs, str(bl))
+    assert removed == ["GAL006:runtime/gone.py:f:os.getenv('Y')#0"]
+    after = json.loads(bl.read_text())["findings"]
+    assert after == {live: "audited: reason"}
+    # idempotent: nothing stale left, file untouched
+    assert prune_baseline(fs, str(bl)) == []
+    assert json.loads(bl.read_text())["findings"] == after
+    # a NEW finding (not in the baseline) is NOT added by pruning
+    assert live in after and len(after) == 1
+
+
 def test_injected_hot_path_item_fails_the_gate(tmp_path):
     """The acceptance drill: an injected .item() in step code is a NEW
     finding naming the file."""

@@ -1,0 +1,341 @@
+"""Memory doctor (Pass 4): malformed corpus, the hand-math HBM pin on
+the acceptance plan, cost-model cross-check sweeps, and search==check
+budget parity.
+
+The hand-math test recomputes every component of the tp2 x dp2 x pp2
+acceptance plan from raw integers — params, optimizer states, 1F1B
+activation accumulation, the compiled engine's stage buffer, vocab
+replication, and the serving KV pool — so the doctor's arithmetic is
+pinned to something a reviewer can check with a pencil, not to itself.
+"""
+
+import io
+import json
+import os
+
+import pytest
+
+from hetu_galvatron_tpu.analysis.memory_doctor import (
+    cross_check_cost_model,
+    diagnose_memory,
+    hbm_budget_reason,
+    search_result_hbm_reason,
+)
+from hetu_galvatron_tpu.core.args_schema import ModelArgs, ServingArgs
+from hetu_galvatron_tpu.utils.strategy import config2strategy
+
+pytestmark = [pytest.mark.staticcheck, pytest.mark.utils]
+
+MB = 1024 * 1024
+PLAN_DIR = os.path.join(
+    os.path.dirname(__file__), "..", "..", "hetu_galvatron_tpu",
+    "profiles", "example_plans")
+ACCEPTANCE = os.path.join(PLAN_DIR,
+                          "galvatron_config_acceptance_tp2dp2pp2.json")
+
+
+def tiny_model(**kw) -> ModelArgs:
+    base = dict(hidden_size=64, num_hidden_layers=4, num_attention_heads=4,
+                vocab_size=256, seq_length=16, max_position_embeddings=32,
+                hidden_act="swiglu", normalization="rmsnorm",
+                position_embedding_type="rope", tie_word_embeddings=False,
+                add_bias_linear=False, add_qkv_bias=False,
+                make_vocab_size_divisible_by=1, ffn_hidden_size=128)
+    base.update(kw)
+    return ModelArgs(**base)
+
+
+def good_plan(**kw):
+    plan = {
+        "pp_deg": 2, "tp_sizes_enc": "2,2,2,2",
+        "tp_consecutive_flags": "1,1,1,1", "dp_types_enc": "0,0,0,0",
+        "use_sp": "0,0,0,0", "cp_sizes_enc": "1,1,1,1",
+        "checkpoint": "0,0,0,0", "global_bsz": 4, "chunks": 2,
+        "pp_division": "2,2", "pipeline_type": "pipedream_flush",
+        "default_dp_type": "ddp", "vtp": 2, "vsp": 0, "embed_sdp": 0,
+    }
+    plan.update(kw)
+    return plan
+
+
+# ---------------------------------------------------------------------------
+# malformed corpus: diagnostics, never tracebacks
+# ---------------------------------------------------------------------------
+
+MALFORMED_CORPUS = [
+    ("zero_layer_stage", good_plan(pp_division="0,4"), "zero-layer"),
+    ("missing_vocab_config", good_plan(vtp=0), "vtp"),
+    ("missing_pp_deg",
+     {k: v for k, v in good_plan().items() if k != "pp_deg"}, "pp_deg"),
+    ("wrong_length_vector", good_plan(cp_sizes_enc="1,1"), "cp_sizes_enc"),
+    ("non_object_plan", ["not", "a", "plan"], "object"),
+    ("division_sum_mismatch", good_plan(pp_division="3,2"), "pp_division"),
+    ("chunks_cannot_fill_pipeline", good_plan(chunks=1, global_bsz=4),
+     "chunks"),
+]
+
+
+@pytest.mark.parametrize("name,plan,needle",
+                         [(n, p, s) for n, p, s in MALFORMED_CORPUS])
+def test_malformed_plan_yields_diagnostic_not_traceback(name, plan, needle):
+    report = diagnose_memory(plan, tiny_model(), 8)
+    assert not report.ok, name
+    assert report.errors, name
+    joined = " | ".join(report.errors)
+    assert needle in joined, f"{name}: {joined!r} lacks {needle!r}"
+    report.render(io.StringIO())  # renders even when broken
+
+
+def test_negative_hbm_budget_is_a_diagnostic():
+    for bad in (-4.0, 0.0):
+        report = diagnose_memory(good_plan(), tiny_model(), 8, hbm_gb=bad)
+        assert not report.ok
+        assert any("hbm-gb" in e for e in report.errors)
+        report.render(io.StringIO())
+
+
+def test_unreadable_plan_file_is_diagnosed(tmp_path):
+    report = diagnose_memory(str(tmp_path / "nope.json"), tiny_model(), 8)
+    assert not report.ok and report.errors
+
+
+# ---------------------------------------------------------------------------
+# the hand-math HBM pin (acceptance plan, raw-integer arithmetic)
+# ---------------------------------------------------------------------------
+
+
+def test_hand_math_pin_acceptance_plan():
+    """tp2 x dp2 x pp2, chunks 2, gbsz 4, bf16 activations, fp32-unit
+    states; model h=64 L=4 heads=4 kv=4 ffn=128 swiglu vocab=256 seq=16
+    rope untied. Every expected number below is hand-derived."""
+    model = tiny_model()
+    serving = ServingArgs(max_batch_size=2, kv_block_size=8,
+                          max_seq_len=32, num_kv_blocks=10)
+    report = diagnose_memory(ACCEPTANCE, model, 8, serving=serving)
+    assert report.ok, report.errors
+    s0, s1 = report.stages
+
+    # params/opt row: per-layer fp32 params = qkv+out (4*h*h=16384) +
+    # gated mlp (3*h*f=24576) + two norms (2*h=128) = 41088 elems.
+    # states = 4x (param+grad+2 moments) / tp2; 2 layers per stage.
+    param_elems = 4 * 64 * 64 + 3 * 64 * 128 + 2 * 64
+    states_b = 2 * (4 * param_elems * 4 // 2)
+    assert s0.components["model_states_mb"] * MB == pytest.approx(states_b)
+    assert s1.components["model_states_mb"] * MB == pytest.approx(states_b)
+
+    # activation row: per-sample saved set (bf16, flash-style) =
+    # attn 7168 + mlp 9216 = 16384 elems; / tp_sp 2; lbsz = 4/2/2 = 1;
+    # 1F1B in-flight microbatches: stage0 holds pp-0 = 2, stage1 holds 1.
+    act_elems = (16 * 64 * 4 + 16 * (64 + 2 * 64)) \
+        + (16 * 64 * 2 + 16 * 128 * 2 + 16 * 128 + 16 * 64)
+    assert act_elems == 16384
+    per_layer_b = act_elems * 2 // 2
+    assert s0.components["activation_mb"] * MB == \
+        pytest.approx(2 * 2 * per_layer_b)
+    assert s1.components["activation_mb"] * MB == \
+        pytest.approx(2 * 1 * per_layer_b)
+
+    # compiled stage buffer: depth (2pp-1) + 2 carries = 5 slices of
+    # [lbsz=1, seq/tp=8, h=64] bf16.
+    slice_b = 1 * 8 * 64 * 2
+    assert s0.components["stage_buffer_mb"] * MB == \
+        pytest.approx(5 * slice_b)
+
+    # vocab states: embed table 256*64 fp32 (rope: no position table),
+    # head untied 256*64, prenorm 64; 4x states over vtp=2 — REPLICATED
+    # on both stages by the compiled engine.
+    v_first_b = 4 * (256 * 64 * 4) // 2
+    v_last_b = 4 * ((256 * 64 + 64) * 4) // 2
+    for st in (s0, s1):
+        assert st.components["vocab_states_mb"] * MB == \
+            pytest.approx(v_first_b + v_last_b)
+
+    # KV pool row: 10 blocks x 2(k+v) x 4 layers x 8 tokens x 4 kv-heads
+    # x 16 head_dim x bf16, kv-head axis sharded over tp2.
+    kv_b = 10 * 2 * 4 * 8 * 4 * 16 * 2 // 2
+    assert s0.components["kv_pool_mb"] * MB == pytest.approx(kv_b)
+
+    # and the peak is the stage-0 total, exactly
+    total0 = (states_b + 2 * 2 * per_layer_b + 5 * slice_b
+              + v_first_b + v_last_b
+              + (16 * 64 // 2) * 2 * 2  # first-stage vocab act, 2 in flight
+              + kv_b)
+    assert report.peak_mb * MB == pytest.approx(total0)
+
+
+def test_vocab_first_stage_activation_hand_math():
+    """The one fiddly row the peak test folds in: first-stage vocab
+    activation = embed output [seq, h]/vtp in bf16, times the pipedream
+    in-flight count (pp=2) at lbsz 1."""
+    model = tiny_model()
+    report = diagnose_memory(ACCEPTANCE, model, 8)
+    s0, s1 = report.stages
+    first_b = (16 * 64 // 2) * 2 * 2 * 1
+    last_b = ((16 * 64 // 2) + (16 * 256 // 2)) * 2 * 1 * 1
+    assert s0.components["vocab_activation_mb"] * MB == pytest.approx(
+        first_b)
+    assert s1.components["vocab_activation_mb"] * MB == pytest.approx(
+        last_b)
+
+
+# ---------------------------------------------------------------------------
+# cost-model cross-check: ratio 1.0 across a plan sweep
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("mutation", [
+    {},                                     # the acceptance shape
+    {"dp_types_enc": "1,1,1,1"},            # zero3 layers
+    {"checkpoint": "1,1,1,1"},              # remat layers
+    {"default_dp_type": "zero2"},           # zero2 default
+    {"use_sp": "1,1,1,1", "vsp": 1},        # ulysses layers
+    {"pp_deg": 1, "pp_division": "4", "chunks": 1, "global_bsz": 8,
+     "tp_sizes_enc": "2,2,2,2"},            # pp=1 SPMD
+    {"tp_sizes_enc": "1,1,1,1", "cp_sizes_enc": "2,2,2,2", "vtp": 1},
+])
+def test_cross_check_ratio_is_one(mutation):
+    plan = good_plan(**mutation)
+    layers, vocab, extras = config2strategy(plan, world_size=8)
+    ratios, problems = cross_check_cost_model(
+        layers, vocab, tiny_model(),
+        global_bsz=extras["global_bsz"], chunks=max(extras["chunks"], 1),
+        pp_division=extras["pp_division"],
+        pipeline_type=extras["pipeline_type"], world_size=8)
+    assert problems == [], problems
+    for name, r in ratios.items():
+        assert r == pytest.approx(1.0, abs=1e-9), (name, r)
+
+
+def test_cross_check_catches_drifted_component(monkeypatch):
+    """Simulated arithmetic drift: scale the doctor's activation model
+    and the cross-check must name the activation component."""
+    import hetu_galvatron_tpu.analysis.memory_doctor as md
+
+    real = md.activation_per_sample_mb
+    calls = {"n": 0}
+
+    def skewed(model, elem_bytes=2):
+        # the CostContext side is built FIRST (call 1, unskewed); the
+        # doctor's accounting side (call 2+) drifts by 10%
+        calls["n"] += 1
+        return real(model, elem_bytes) * (1.1 if calls["n"] >= 2 else 1.0)
+
+    monkeypatch.setattr(md, "activation_per_sample_mb", skewed)
+    plan = good_plan()
+    layers, vocab, extras = config2strategy(plan, world_size=8)
+    _, problems = md.cross_check_cost_model(
+        layers, vocab, tiny_model(), global_bsz=4, chunks=2,
+        pp_division=[2, 2], pipeline_type="pipedream_flush", world_size=8)
+    assert problems and "activation" in problems[0]
+
+
+# ---------------------------------------------------------------------------
+# budget gate + search == check parity
+# ---------------------------------------------------------------------------
+
+
+def test_budget_gate_rejects_oversized_plan():
+    model = tiny_model()
+    peak_gb = diagnose_memory(ACCEPTANCE, model, 8).peak_mb / 1024.0
+    tight = diagnose_memory(ACCEPTANCE, model, 8, hbm_gb=peak_gb * 0.5)
+    assert not tight.ok
+    assert any("OOM" in e or "exceeds" in e for e in tight.errors)
+    roomy = diagnose_memory(ACCEPTANCE, model, 8, hbm_gb=peak_gb * 2.0)
+    assert roomy.ok, roomy.errors
+
+
+def test_search_gate_matches_check_gate():
+    """search == check parity: the SearchStrategy-shaped predicate the
+    engine prunes with and the plan-JSON doctor agree at both sides of
+    the budget boundary."""
+    from hetu_galvatron_tpu.core.search_engine.strategies import (
+        SearchStrategy,
+    )
+    from hetu_galvatron_tpu.utils.strategy import DPType
+
+    model = tiny_model()
+    peak_gb = diagnose_memory(ACCEPTANCE, model, 8).peak_mb / 1024.0
+    strategies = [SearchStrategy(pp=2, tp=2, dp=2, dp_type=DPType.DDP)] * 4
+    for budget, fits in ((peak_gb * 0.5, False), (peak_gb * 2.0, True)):
+        reason = search_result_hbm_reason(
+            strategies, [2, 2], model, global_bsz=4, chunks=2,
+            pipeline_type="pipedream_flush", schedule_impl="compiled",
+            hbm_gb=budget, vocab_tp_sp=2)
+        check = diagnose_memory(ACCEPTANCE, model, 8, hbm_gb=budget)
+        assert (reason is None) == fits
+        assert check.ok == fits
+        if not fits:
+            assert reason == check.errors[-1]
+
+
+def test_search_engine_hbm_gate_prunes(capsys):
+    """The engine-level hook: a feasible TaskResult is replaced by an
+    infeasible one (and logged) when the budget is busted, untouched
+    when it fits or the gate is off."""
+    from hetu_galvatron_tpu.core.args_schema import SearchArgs
+    from hetu_galvatron_tpu.core.search_engine.engine import (
+        SearchEngine,
+        TaskResult,
+    )
+    from hetu_galvatron_tpu.core.search_engine.strategies import (
+        SearchStrategy,
+    )
+
+    model = tiny_model()
+    peak_gb = diagnose_memory(ACCEPTANCE, model, 8).peak_mb / 1024.0
+    r = TaskResult(throughput=1.0, time_cost=1.0,
+                   strategy_list=[SearchStrategy(pp=2, tp=2, dp=2)] * 4,
+                   pp_size=2, pp_stage_list=[2, 2], vocab_tp_sp=2,
+                   bsz=4, chunks=2)
+
+    def engine_with(budget):
+        args = SearchArgs(num_nodes=1, num_devices_per_node=8,
+                          hbm_budget_gb=budget,
+                          pipeline_type="pipedream_flush",
+                          pipeline_schedule_impl="compiled")
+        return SearchEngine(args, model_cfg=model)
+
+    pruned = engine_with(peak_gb * 0.5)._hbm_gate(r)
+    assert pruned.strategy_list is None
+    assert "hbm gate: pruned" in capsys.readouterr().out
+    kept = engine_with(peak_gb * 2.0)._hbm_gate(r)
+    assert kept is r
+    off = engine_with(0.0)._hbm_gate(r)
+    assert off is r
+
+
+# ---------------------------------------------------------------------------
+# serving-mode sizing parity with the live engine
+# ---------------------------------------------------------------------------
+
+
+def test_kv_pool_sizing_matches_live_engine():
+    """resolve_num_blocks IS the engine's pool sizing: a default-pool
+    engine allocates exactly what the doctor predicts."""
+    import jax
+
+    from hetu_galvatron_tpu.models.builder import init_causal_lm
+    from hetu_galvatron_tpu.serving.engine import ServingEngine
+    from hetu_galvatron_tpu.serving.kv_cache import resolve_num_blocks
+
+    model = tiny_model()
+    serving = ServingArgs(max_batch_size=2, kv_block_size=8,
+                          max_seq_len=32, num_kv_blocks=0)
+    params, _ = init_causal_lm(jax.random.key(0), model)
+    eng = ServingEngine(params, model, serving)
+    try:
+        assert eng.kv.num_blocks == resolve_num_blocks(serving, model)
+    finally:
+        eng.close()
+
+
+def test_plan_file_report_roundtrips_through_json(tmp_path):
+    """A plan dict and the same plan on disk produce identical numbers."""
+    p = tmp_path / "plan.json"
+    p.write_text(json.dumps(good_plan()))
+    model = tiny_model()
+    a = diagnose_memory(good_plan(), model, 8)
+    b = diagnose_memory(str(p), model, 8)
+    assert a.ok and b.ok
+    assert [s.components for s in a.stages] == \
+        [s.components for s in b.stages]

@@ -128,6 +128,12 @@ _SLOW_TESTS = {
     # single-device hit-parity, spec-losslessness, and eviction tests
     # stay fast-tier
     "test_shared_prefix_drill_mesh8",
+    # sharding-flow heavy leg: compiles the whole fused 1F1B program to
+    # walk its partitioned HLO (the jaxpr-level byte census stays fast)
+    "test_hlo_walk_full_compiled_step",
+    # draft-model serve smoke trains a real draft checkpoint first (the
+    # fast tier keeps the draft_model= usage-error path)
+    "test_serve_cli_draft_model_smoke",
     "test_serve_bench_ab_legs_importable",
     "test_serve_bench_shared_prefix_trace",
     "test_prefix_engine_defrag_mid_serving",
