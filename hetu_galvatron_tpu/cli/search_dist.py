@@ -1,9 +1,195 @@
 """Strategy-search launcher: ``python -m hetu_galvatron_tpu.cli.search_dist
-<config.yaml> [key=value ...]`` (reference models/gpt/search_dist.py:11-33)."""
+<config.yaml> [key=value ...]`` (reference models/gpt/search_dist.py:11-33).
+
+Also home of the ELASTIC re-plan internals: when a resume finds the live
+world differs from the checkpoint's recorded one, ``replan_for_world``
+re-runs this offline-fast search for the new topology (or, with no search
+profiles configured, degree-adapts the stored plan), gates the winner
+through the memory doctor's HBM budget predicate, and points
+``args.parallel`` at the result — the "re-search" leg of
+detect -> re-search -> budget-gate -> reshard -> replay."""
 
 from __future__ import annotations
 
+import glob
+import os
 import sys
+from typing import Any, Callable, Dict, Optional
+
+
+def search_plan_for_world(args, world: int, out_dir: str,
+                          *, log: Callable[[str], None] = print
+                          ) -> Optional[str]:
+    """Run the offline strategy search for ``world`` devices using the
+    search profiles configured on ``args`` (a resolved CoreArgs); returns
+    the written plan path, or None when no feasible plan exists. The
+    global batch size is ALWAYS settled to
+    ``args.parallel.global_train_batch_size`` (which ``replan_for_world``
+    pins to the checkpoint's stored value): an elastic resume must keep
+    the data schedule, so the batch geometry is never up for re-search —
+    a conflicting ``search.settle_bsz`` is ignored with a warning."""
+    from hetu_galvatron_tpu.core.search_engine.engine import SearchEngine
+    from hetu_galvatron_tpu.utils.hf_config_adapter import (
+        model_layer_configs,
+        model_name,
+    )
+
+    sa = args.search
+    os.makedirs(out_dir, exist_ok=True)
+    settled = args.parallel.global_train_batch_size
+    if sa.settle_bsz > 0 and sa.settle_bsz != settled:
+        log(f"elastic re-search: ignoring search.settle_bsz="
+            f"{sa.settle_bsz} — the checkpoint's batch geometry "
+            f"(global_bsz {settled}) must survive the topology change "
+            "for the exact data-position replay")
+    s2 = sa.model_copy(update={
+        "num_nodes": 1, "num_devices_per_node": int(world),
+        "settle_bsz": int(settled), "output_config_path": out_dir})
+    engine = SearchEngine(
+        s2, mixed_precision=s2.mixed_precision,
+        default_dp_type=s2.default_dp_type, pipeline_type=s2.pipeline_type,
+        model_cfg=args.model)
+    engine.set_model_info(model_layer_configs(args.model),
+                          model_name(args.model),
+                          model_type=args.model.model_type)
+    engine.initialize()
+    throughput = engine.optimize()
+    if throughput <= 0:
+        return None
+    plans = sorted(glob.glob(os.path.join(out_dir, "galvatron_config_*.json")),
+                   key=os.path.getmtime)
+    log(f"elastic re-search: plan for {world} devices -> {plans[-1]} "
+        f"(predicted throughput {throughput:.6f} samples/s)")
+    return plans[-1]
+
+
+def _adapt_degrees(args, world: int, stored_plan: Dict[str, Any],
+                   *, log: Callable[[str], None] = print) -> Optional[str]:
+    """No search profiles at hand: deterministically adapt the stored
+    plan's degrees to the new world (keep tp/cp, shrink dp, then pp, then
+    tp) and write them into ``args.parallel`` as a GLOBAL-mode plan.
+    Returns None on success, else the reason no adaptation fits."""
+    from hetu_galvatron_tpu.utils.strategy import config2strategy
+
+    stored_world = int(stored_plan.get("world_size") or 0)
+    try:
+        layers, vocab, extras = config2strategy(
+            stored_plan, world_size=stored_world or None)
+    except Exception as e:  # noqa: BLE001 — plan fingerprint may be legacy
+        return f"stored plan fingerprint is unreadable ({e})"
+    base = layers[0]
+    cp = max(base.cp_size, 1)
+    n_layers = len(layers)
+    for tp in _halvings(max(base.tp_size, 1)):
+        for pp in _halvings(min(max(base.pp_deg, 1), n_layers)):
+            grain = pp * tp * cp
+            if grain <= world and world % grain == 0:
+                par = args.parallel
+                par.config_mode = "global"
+                par.galvatron_config_path = None
+                par.pp_deg = pp
+                par.global_tp_deg = tp
+                par.global_cp_deg = cp
+                par.use_ulysses = bool(base.sp)
+                par.global_tp_consec = int(base.tp_consecutive)
+                par.global_checkpoint = int(base.checkpoint)
+                par.default_dp_type = base.dp_type.short
+                stage_world = world // pp
+                vtp = max(vocab.vtp, 1)
+                while vtp > 1 and stage_world % vtp:
+                    vtp //= 2
+                par.vocab_tp = vtp
+                par.vocab_sp = int(vocab.vsp)
+                par.embed_sdp = int(vocab.embed_sdp)
+                if extras.get("pipeline_type"):
+                    par.pipeline_type = extras["pipeline_type"]
+                log("elastic re-plan (no search profiles configured): "
+                    f"degree-adapted the stored plan to pp{pp} tp{tp} "
+                    f"cp{cp} dp{stage_world // (tp * cp)} vtp{vtp} for "
+                    f"{world} devices")
+                return None
+    return (f"no pp x tp x cp adaptation of the stored plan (pp"
+            f"{base.pp_deg} tp{base.tp_size} cp{cp}) divides the live "
+            f"world of {world} devices")
+
+
+def _halvings(n: int):
+    while n >= 1:
+        yield n
+        if n == 1:
+            break
+        n //= 2
+
+
+def replan_for_world(args, world: int, stored_plan: Dict[str, Any],
+                     *, log: Callable[[str], None] = print
+                     ) -> Optional[str]:
+    """Point ``args.parallel`` at a plan for ``world`` devices: re-run the
+    offline search when profiles are configured, else degree-adapt the
+    stored plan — then gate the winner through the memory doctor's HBM
+    budget predicate (``analysis/memory_doctor.py::hbm_budget_reason``,
+    the exact predicate ``check --memory --hbm-gb`` and the search's own
+    pruning hook evaluate). Returns None on success; a TERMINAL reason
+    string otherwise (an infeasible or OOM-rejected target plan reproduces
+    on every restart — callers exit 17, they do not retry)."""
+    sa = args.search
+    # the data schedule must survive the topology change: pin the batch
+    # geometry to what the checkpoint was trained with before any
+    # re-planning — THE one place this invariant lives (the searched
+    # plan's own global_bsz/chunks then come from the settled search; the
+    # degree-adapt path reads these args directly)
+    if stored_plan.get("global_bsz"):
+        args.parallel.global_train_batch_size = int(
+            stored_plan["global_bsz"])
+    if stored_plan.get("chunks"):
+        args.parallel.chunks = int(stored_plan["chunks"])
+    if sa.time_profiling_path and sa.memory_profiling_path:
+        out_dir = os.path.join(
+            os.path.abspath(args.ckpt.load or sa.output_config_path
+                            or "configs"),
+            f"elastic_plan_{world}dev")
+        try:
+            plan = search_plan_for_world(args, world, out_dir, log=log)
+        except Exception as e:  # noqa: BLE001 — search failure is terminal
+            return f"elastic re-search failed for {world} devices: {e}"
+        if plan is None:
+            return (f"elastic re-search found no feasible plan for "
+                    f"{world} devices")
+        args.parallel.config_mode = "json"
+        args.parallel.galvatron_config_path = plan
+    else:
+        reason = _adapt_degrees(args, world, stored_plan, log=log)
+        if reason is not None:
+            return reason
+
+    # validate + HBM-gate the winner BEFORE committing to resharding
+    from hetu_galvatron_tpu.runtime.hybrid_config import (
+        get_hybrid_parallel_config,
+    )
+
+    try:
+        hpc = get_hybrid_parallel_config(args, world)
+    except Exception as e:  # noqa: BLE001 — structural rejection is terminal
+        return (f"re-planned configuration is invalid for {world} "
+                f"devices: {e}")
+    if sa.hbm_budget_gb > 0:
+        from hetu_galvatron_tpu.analysis.memory_doctor import (
+            hbm_budget_reason,
+            peak_mb,
+            plan_stage_memory,
+        )
+
+        stages = plan_stage_memory(
+            hpc.layers, hpc.vocab, args.model,
+            global_bsz=hpc.global_bsz, chunks=hpc.chunks,
+            pp_division=hpc.pp_division, pipeline_type=hpc.pipeline_type,
+            schedule_impl="compiled",
+            mixed_precision=args.parallel.mixed_precision != "fp32")
+        reason = hbm_budget_reason(peak_mb(stages), sa.hbm_budget_gb)
+        if reason is not None:
+            return ("elastic target plan rejected by the HBM budget "
+                    f"gate: {reason}")
+    return None
 
 
 def main(argv=None) -> int:

@@ -8,7 +8,7 @@ concurrent requests::
     python -m hetu_galvatron_tpu.cli.serve <model.yaml> \
         requests=<requests.jsonl> [tokenizer=byte|<hf-name-or-path>] \
         [ckpt=<framework ckpt root>] [hf_path=<hf checkpoint dir>] \
-        [metrics=<metrics.jsonl>] [stream=1] \
+        [metrics=<metrics.jsonl>] [stream=1] [watch=<poll seconds>] \
         [serving.* / model.* / parallel.* overrides]
 
     # one-shot form (single request):
@@ -44,6 +44,14 @@ draft architecture — its vocab must match the target's) and optionally
 checkpoint the draft serves random weights (smoke mode, warned). The
 engine already took ``draft_params``/``draft_cfg`` — this is the CLI
 path to it.
+
+Zero-downtime weight rolls: ``watch=<seconds>`` (with ``ckpt=<root>``)
+polls the checkpoint root and hot-swaps every newly COMMITTED step into
+the live engine via ``ServingEngine.swap_weights`` — no request is
+dropped, the jitted programs never recompile, and the stall lands in the
+``serve/swap_stall_ms`` histogram (``serve/weight_swaps`` counts rolls).
+A training run writing checkpoints into the same root therefore serves
+its own freshest weights continuously.
 
 With more than one visible device the decode runs under the plan's GSPMD
 shardings exactly like ``cli/generate.py`` (pure-TP submesh unless explicit
@@ -137,7 +145,7 @@ def main(argv=None) -> int:
     argv = list(argv if argv is not None else sys.argv[1:])
     kv_keys = ("prompt", "requests", "max_new_tokens", "temperature", "seed",
                "tokenizer", "ckpt", "hf_path", "metrics", "stream",
-               "draft_model", "draft_ckpt")
+               "draft_model", "draft_ckpt", "watch")
     kv = {}
     passthrough = []
     for a in argv:
@@ -168,6 +176,12 @@ def main(argv=None) -> int:
             f"tokenizer vocab {tok.vocab_size} exceeds model vocab "
             f"{cfg.vocab_size}; pass a matching model config")
 
+    watch_s = float(kv.get("watch", 0) or 0)
+    if watch_s > 0 and not kv.get("ckpt"):
+        print("watch=<seconds> needs ckpt=<checkpoint root> to poll",
+              file=sys.stderr)
+        return 2
+
     init_key = jax.random.key(int(kv.get("seed", 0)))
     box = {}
 
@@ -177,9 +191,10 @@ def main(argv=None) -> int:
 
     params_target = jax.eval_shape(_shapes, init_key)
     axes = box["axes"]
+    served_step = -1
     if kv.get("ckpt"):
-        params, ckdir, step = _ckpt_params(kv["ckpt"], params_target)
-        print(f"loaded {ckdir} (step {step})", file=sys.stderr)
+        params, ckdir, served_step = _ckpt_params(kv["ckpt"], params_target)
+        print(f"loaded {ckdir} (step {served_step})", file=sys.stderr)
     elif kv.get("hf_path"):
         from hetu_galvatron_tpu.cli.checkpoint_convert import (
             _load_hf_state_dict,
@@ -278,6 +293,72 @@ def main(argv=None) -> int:
     print("warmup: compiling decode + prefill buckets ...", file=sys.stderr)
     engine.warmup()
     engine.start()
+
+    # watch mode: poll the checkpoint root and hot-swap every newly
+    # committed step into the live engine (zero dropped requests, zero
+    # recompiles; the stall rides serve/swap_stall_ms)
+    watcher = None
+    watch_stop = None
+    if watch_s > 0:
+        import os
+        import threading
+
+        from hetu_galvatron_tpu.runtime.checkpoint import latest_checkpoint
+
+        watch_stop = threading.Event()
+
+        def _watch(cur_step=served_step):
+            # a step that keeps failing (wrong architecture, torn shards,
+            # flaky mount) must not re-download the whole tree every poll
+            # forever — but a TRANSIENT fault must not strand the watcher
+            # on stale weights either: after 3 consecutive failures the
+            # step backs off to one retry per ~30 polls (a newer commit
+            # always tries immediately; success clears the slate)
+            fails: dict = {}
+            skip = 0
+            bad_step = None
+            while not watch_stop.wait(watch_s):
+                step_n = None
+                try:
+                    found = latest_checkpoint(kv["ckpt"])
+                    if not found:
+                        continue
+                    # advance by the DIRECTORY step (what latest_checkpoint
+                    # orders by), never the loaded meta step — a dir whose
+                    # name and meta disagree must not re-swap every poll
+                    step_n = int(os.path.basename(found)[len("step_"):])
+                    if step_n <= cur_step:
+                        continue
+                    if step_n == bad_step and skip > 0:
+                        skip -= 1
+                        continue
+                    new_params, ckd, _ = _ckpt_params(found, params_target)
+                    stall = engine.swap_weights(new_params)
+                    print(f"weight swap: step {cur_step} -> {step_n} "
+                          f"({ckd}, stall {stall:.1f} ms)",
+                          file=sys.stderr)
+                    cur_step = step_n
+                    fails.pop(step_n, None)
+                    bad_step = None
+                except Exception as e:  # noqa: BLE001 — keep serving
+                    print(f"warning: weight-swap watch failed: {e}",
+                          file=sys.stderr)
+                    if step_n is not None:
+                        fails[step_n] = fails.get(step_n, 0) + 1
+                        if fails[step_n] >= 3:
+                            bad_step = step_n
+                            skip = 30
+                            print(f"warning: step {step_n} failed "
+                                  f"{fails[step_n]} swap attempts; "
+                                  "backing off (retry roughly every "
+                                  "30 polls; a newer checkpoint swaps "
+                                  "immediately)", file=sys.stderr)
+
+        watcher = threading.Thread(target=_watch, daemon=True,
+                                   name="ckpt-watch")
+        watcher.start()
+        print(f"watching {kv['ckpt']} every {watch_s:g}s for new "
+              "committed checkpoints (hot swap)", file=sys.stderr)
     t0 = time.monotonic()
     handles = []
     try:
@@ -319,6 +400,9 @@ def main(argv=None) -> int:
                             else round(h.ttft_s() * 1000.0, 3)),
                 "text": tok.decode(out)}), flush=True)
     finally:
+        if watch_stop is not None:
+            watch_stop.set()
+            watcher.join(timeout=5.0)
         engine.close()
         registry.close()
     print(f"metrics written to {metrics_path} "

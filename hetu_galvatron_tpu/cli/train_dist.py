@@ -17,6 +17,40 @@ from typing import Any, Dict
 import numpy as np
 
 
+def _flight_dir_of(args):
+    """THE flight-dump directory resolution (explicit flight_dir, else
+    the metrics stream's directory) — shared by the crash-path recorder
+    construction in train() and the elastic postmortem below, so both
+    kinds of dump land in the same place."""
+    import os as _os
+
+    fdir = args.observability.flight_dir
+    if fdir is None:
+        fdir = _os.path.dirname(_os.path.abspath(
+            args.observability.metrics_path or _os.path.join(
+                args.logging.tensorboard_dir or ".", "metrics.jsonl")))
+    return fdir
+
+
+def _flight_dump_elastic(args, reason: str, live_world: int,
+                         stored_world: int, kind: str):
+    """Leave a flight-recorder postmortem for a terminal elastic failure
+    (rejected re-plan or reshard error — the run exits 17; the dump is
+    the operator's first artifact). Returns the dump path, or None when
+    no dump directory is configured/derivable. Never raises (the
+    recorder's own contract)."""
+    if args.observability.flight_dir is None \
+            and not args.observability.enabled:
+        return None
+    from hetu_galvatron_tpu.observability.recorder import FlightRecorder
+
+    rec = FlightRecorder(registry=None, out_dir=_flight_dir_of(args),
+                         capacity=args.observability.flight_events)
+    rec.note("elastic_replan", reason=reason, live_world=live_world,
+             stored_world=stored_world, ckpt_load=args.ckpt.load)
+    return rec.dump(kind)
+
+
 def train(args) -> Dict[str, Any]:
     import jax
     import jax.numpy as jnp
@@ -50,6 +84,65 @@ def train(args) -> Dict[str, Any]:
     from hetu_galvatron_tpu.utils.hf_config_adapter import resolve_model_config
 
     args = resolve_model_config(args)
+
+    # goodput accounting (observability/goodput.py): wall-clock
+    # partitioned into productive / recompile / save / resume-replay /
+    # reshard / restart-lost; snapshots ride every checkpoint's
+    # train_state, so the goodput/* gauges survive preemption with the
+    # model state. Constructed before the elastic pre-pass so topology
+    # changes bill their re-search + reshard wall into the new bucket.
+    from hetu_galvatron_tpu.observability.goodput import GoodputTracker
+
+    goodput = GoodputTracker()
+
+    # ----- elastic pre-pass: detect a topology-changed resume -----------
+    # BEFORE initialize/plan construction: the preserved CLI plan (or the
+    # checkpoint's JSON plan) describes the OLD world and may not even
+    # validate on the new one. When the live world differs from the
+    # checkpoint's recorded world_size, re-search a plan for the new
+    # topology (cli/search_dist.py internals), gate it through the memory
+    # doctor's HBM budget, and remember to reshard instead of plain-load.
+    elastic = None
+    if args.ckpt.load:
+        from hetu_galvatron_tpu.runtime.initialize import (
+            visible_world_size,
+        )
+
+        live_world = visible_world_size(args)
+        ckdir0 = latest_checkpoint(args.ckpt.load)
+        stored_plan = (read_checkpoint_meta(ckdir0)
+                       .get("hybrid_parallel_config") if ckdir0 else None)
+        stored_world = (stored_plan or {}).get("world_size")
+        if stored_world and int(stored_world) != live_world:
+            from hetu_galvatron_tpu.cli.search_dist import replan_for_world
+            from hetu_galvatron_tpu.runtime.rerun_machine import (
+                EXIT_CODE_FAILED_ON_RESULT_VALIDATION,
+            )
+
+            print(f"elastic resume: {ckdir0} was committed by a "
+                  f"{stored_world}-device world; live world is "
+                  f"{live_world} — re-planning", flush=True)
+            with goodput.measure("reshard"):
+                reason = replan_for_world(args, live_world, stored_plan)
+            if reason is not None:
+                # terminal by contract: an infeasible or OOM-rejected
+                # target plan reproduces on every restart — exit 17 with
+                # a flight-recorder postmortem, never a restart loop
+                print(f"elastic resume failed terminally: {reason}",
+                      flush=True)
+                dump = _flight_dump_elastic(args, reason, live_world,
+                                            stored_world,
+                                            "elastic_plan_rejected")
+                return {"losses": [], "val_losses": [], "test_loss": None,
+                        "iter_ms": 0.0, "rerun": None,
+                        "goodput": {"totals": dict(goodput.totals),
+                                    "frac": goodput.goodput(),
+                                    "restarts_survived":
+                                        goodput.restarts_survived},
+                        "flight_dumps": [dump] if dump else [],
+                        "exit_code": EXIT_CODE_FAILED_ON_RESULT_VALIDATION}
+            elastic = {"ckdir": ckdir0, "stored_world": int(stored_world)}
+
     state = initialize(args)
     world = state.world_size
     hpc = get_hybrid_parallel_config(args, world)
@@ -81,13 +174,6 @@ def train(args) -> Dict[str, Any]:
         emit_plan_telemetry(
             telemetry.registry, hpc, cfg,
             mixed_precision=args.parallel.mixed_precision != "fp32")
-    # goodput accounting (observability/goodput.py): wall-clock
-    # partitioned into productive / recompile / save / resume-replay /
-    # restart-lost; snapshots ride every checkpoint's train_state, so the
-    # goodput/* gauges survive preemption with the model state
-    from hetu_galvatron_tpu.observability.goodput import GoodputTracker
-
-    goodput = GoodputTracker()
     # crash-forensics flight recorder (observability/recorder.py): dumps
     # flight_<ts>.json on crash / trapped signal / rerun halt. Directory:
     # observability.flight_dir, else (when telemetry owns a stream) the
@@ -95,19 +181,13 @@ def train(args) -> Dict[str, Any]:
     recorder = None
     if jax.process_index() == 0 and (telemetry is not None
                                      or args.observability.flight_dir):
-        import os as _os
-
         from hetu_galvatron_tpu.observability.recorder import FlightRecorder
 
-        fdir = args.observability.flight_dir
-        if fdir is None:
-            fdir = _os.path.dirname(_os.path.abspath(
-                args.observability.metrics_path or _os.path.join(
-                    args.logging.tensorboard_dir or ".", "metrics.jsonl")))
         recorder = FlightRecorder(
             registry=(telemetry.registry if telemetry is not None
                       else None),
-            out_dir=fdir, capacity=args.observability.flight_events)
+            out_dir=_flight_dir_of(args),
+            capacity=args.observability.flight_events)
         recorder.note("run_start", plan=hpc.describe(), world=world)
     profiler = RuntimeProfiler(args, world_size=world,
                                rank=jax.process_index())
@@ -266,15 +346,63 @@ def train(args) -> Dict[str, Any]:
         equality unconditionally)."""
         import math as _math
 
+        nonlocal exit_code
+
         start = 0
         if args.ckpt.load:
             ckdir = latest_checkpoint(args.ckpt.load)
             if ckdir:
-                with goodput.measure("resume_replay"):
-                    sp, so, start = load_checkpoint(
-                        ckdir, sp, so, hpc=hpc,
-                        strict_plan=args.ckpt.distributed_checkpoint)
-                state.log(f"resumed from {ckdir} at iter {start}")
+                if elastic is not None:
+                    # topology-changed resume: the checkpoint's arrays are
+                    # laid out for the OLD plan — gather to canonical and
+                    # re-lay them onto the new engine's templates
+                    # (runtime/reshard.py), billed to the reshard bucket
+                    from hetu_galvatron_tpu.runtime.reshard import (
+                        ReshardError,
+                        resume_elastic,
+                    )
+                    from hetu_galvatron_tpu.runtime.rerun_machine import (
+                        EXIT_CODE_FAILED_ON_RESULT_VALIDATION,
+                    )
+
+                    try:
+                        with goodput.measure("reshard"):
+                            sp, so, start = resume_elastic(
+                                ckdir, sp, so,
+                                tie_word_embeddings=cfg.tie_word_embeddings,
+                                num_experts=cfg.num_experts or 0)
+                    except ReshardError as e:
+                        # same terminal contract as a rejected re-plan: a
+                        # deterministic reshard failure reproduces on
+                        # every restart — exit 17 with a postmortem, do
+                        # NOT hand the supervisor a crash to loop on.
+                        # start = train_iters runs zero iterations and
+                        # the normal result path carries the code out.
+                        state.log(f"elastic resume failed terminally: {e}")
+                        if recorder is not None:
+                            recorder.note(
+                                "elastic_replan", reason=str(e),
+                                live_world=world,
+                                stored_world=elastic["stored_world"])
+                            recorder.dump("elastic_reshard_failed")
+                        else:
+                            _flight_dump_elastic(
+                                args, str(e), world,
+                                elastic["stored_world"],
+                                "elastic_reshard_failed")
+                        exit_code = EXIT_CODE_FAILED_ON_RESULT_VALIDATION
+                        return sp, so, args.train.train_iters
+                    state.log(
+                        f"elastic resume: resharded {ckdir} "
+                        f"({elastic['stored_world']} -> {world} devices) "
+                        f"onto plan [{hpc.describe()}] at iter {start}")
+                else:
+                    with goodput.measure("resume_replay"):
+                        sp, so, start = load_checkpoint(
+                            ckdir, sp, so, hpc=hpc,
+                            strict_plan=args.ckpt.distributed_checkpoint,
+                            expected_world=world)
+                    state.log(f"resumed from {ckdir} at iter {start}")
                 meta = read_checkpoint_meta(ckdir)
                 stored = meta.get("hybrid_parallel_config") or {}
                 ts = meta.get("train_state") or {}
@@ -742,6 +870,12 @@ def main(argv=None) -> int:
         last["out"] = out
         return out.get("exit_code") or 0
 
+    # Within ONE process the device list is fixed at backend init, so
+    # this probe observes a fleet change only when the supervisor wraps
+    # relaunches across processes (drills inject it directly; a real
+    # preemption kills the process, whose relaunch re-reads the fleet).
+    from hetu_galvatron_tpu.runtime.initialize import visible_world_size
+
     rc = run_with_restarts(
         attempt, max_restarts=sup.max_restarts,
         base_delay=sup.backoff_base_s, max_delay=sup.backoff_max_s,
@@ -750,7 +884,12 @@ def main(argv=None) -> int:
         # new checkpoint, the restart counter resets, so a long run on a
         # preemptible fleet survives unbounded preemptions
         progress_fn=((lambda: latest_checkpoint(args.ckpt.save))
-                     if args.ckpt.save else None))
+                     if args.ckpt.save else None),
+        # ... and a TOPOLOGY change is progress too: a restart that sees a
+        # different world re-searches and reshards (the elastic pre-pass
+        # in train()), so it must get a fresh budget, not inherit the old
+        # world's crash count
+        world_fn=lambda: visible_world_size(args))
     if rc != 0:
         return rc
     return _finish(last["out"])

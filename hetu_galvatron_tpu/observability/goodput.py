@@ -8,6 +8,10 @@ tracker partitions elapsed time into:
 * ``recompile``       — first-step jit compilation per attempt,
 * ``checkpoint_save`` — blocking save time at commit points,
 * ``resume_replay``   — checkpoint restore + data-stream fast-forward,
+* ``reshard``         — elastic topology changes: the re-search for the
+  new world plus the cross-plan checkpoint reshard
+  (``runtime/reshard.py``), so "what did losing half the fleet cost"
+  is a gauge, not a guess,
 * ``restart_lost``    — everything a restart threw away: post-commit
   steps of the dead attempt, downtime, supervisor backoff.
 
@@ -36,7 +40,7 @@ from contextlib import contextmanager
 from typing import Any, Callable, Dict, Optional
 
 CATEGORIES = ("productive_step", "recompile", "checkpoint_save",
-              "resume_replay", "restart_lost")
+              "resume_replay", "reshard", "restart_lost")
 
 
 class GoodputTracker:

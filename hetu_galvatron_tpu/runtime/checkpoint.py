@@ -32,6 +32,31 @@ from hetu_galvatron_tpu.utils.retrying import retry_call
 
 Params = Dict[str, Any]
 
+
+class WorldSizeMismatchError(ValueError):
+    """The checkpoint's recorded world_size differs from the live world.
+
+    Before this error existed a topology-changed resume surfaced as a
+    shape error deep inside orbax/device_put; now it surfaces at load with
+    both worlds named. The elastic resume path (``cli/train_dist.py``)
+    catches exactly this condition to trigger re-search + reshard
+    (``runtime/reshard.py``)."""
+
+    def __init__(self, ckpt_dir: str, stored_world: int, live_world: int,
+                 stored_plan: Optional[Dict[str, Any]] = None):
+        self.ckpt_dir = ckpt_dir
+        self.stored_world = int(stored_world)
+        self.live_world = int(live_world)
+        self.stored_plan = stored_plan
+        super().__init__(
+            f"checkpoint {ckpt_dir} was committed by a "
+            f"{stored_world}-device world but the live world has "
+            f"{live_world} devices: its arrays are laid out for the old "
+            "plan and will not restore here. Re-search a plan for the "
+            "live topology and reshard (runtime/reshard.py) — "
+            "cli/train_dist.py does this automatically on resume when "
+            "ckpt.load is set.")
+
 # Atomic-commit protocol: a step directory is materialized under
 # ``step_<n>.tmp``, fully written (params/opt_state shards + meta.json),
 # stamped with the marker file below, and only then renamed to
@@ -241,6 +266,19 @@ def _in_flight_dirs() -> set:
     return {p.tmp_dir for p in _PENDING} | {p.final_dir for p in _PENDING}
 
 
+# The step dir a live resume just selected, per checkpoint root: retention
+# pruning racing a concurrent resume (an async save committing keep_last
+# GC between latest_checkpoint() and the meta/shard reads) must never
+# delete it out from under the restore. latest_checkpoint() records its
+# selection here; the NEXT selection on the same root releases the
+# previous one, so a long run retains at most one extra step dir.
+# SCOPE: process-local — it closes the in-process race (the async-save
+# commit GC and maybe_resume share this process). A SEPARATE process
+# reading the root (cli/serve.py watch=) still relies on the shared
+# retry/backoff policies; cross-process leases are future work.
+_RESUME_PROTECTED: Dict[str, str] = {}
+
+
 def _recover_orphaned_old(path: str) -> None:
     """Roll back a crash mid-overwrite: if ``step_<n>.old`` (the previous
     committed payload renamed aside by :func:`_commit`) exists without a
@@ -270,6 +308,7 @@ def gc_checkpoints(path: str, *, keep_last: int = 0) -> List[str]:
         return []
     _recover_orphaned_old(path)
     busy = _in_flight_dirs()
+    protected = _RESUME_PROTECTED.get(os.path.abspath(path))
     removed: List[str] = []
     committed: List[tuple] = []
     for entry in sorted(os.listdir(path)):
@@ -294,6 +333,11 @@ def gc_checkpoints(path: str, *, keep_last: int = 0) -> List[str]:
     if keep_last > 0 and len(committed) > keep_last:
         committed.sort()
         for _, full in committed[:-keep_last]:
+            if protected and os.path.abspath(full) == protected:
+                # a live resume selected this step: hold it out of the
+                # prune set until the next selection releases it
+                _count("gc_protected")
+                continue
             shutil.rmtree(full, ignore_errors=True)
             removed.append(full)
             _count("gc_removed", kind="retention")
@@ -319,6 +363,13 @@ def latest_checkpoint(path: str) -> Optional[str]:
             continue
         if step > best_step:
             best_step, best = step, full
+    root = os.path.abspath(path)
+    if best is not None:
+        # shield the selection from retention pruning until the next
+        # selection on this root (see _RESUME_PROTECTED)
+        _RESUME_PROTECTED[root] = os.path.abspath(best)
+    else:
+        _RESUME_PROTECTED.pop(root, None)
     return best
 
 
@@ -346,18 +397,29 @@ def load_checkpoint(
     hpc=None,
     *,
     strict_plan: bool = False,
+    expected_world: Optional[int] = None,
 ):
     """Restore into the target sharding/shape tree. ``strict_plan`` asserts
     the stored plan matches (the reference asserts equality on resume,
     hybrid_parallel_config.py:132-144); by default a mismatch is allowed —
-    orbax reshards into the new plan's shardings. Restores retry transient
-    I/O errors with jittered backoff (preemptible fleets resume through
-    flaky object-store reads)."""
+    orbax reshards into the new plan's shardings. ``expected_world``
+    validates the checkpoint's recorded world_size against the live world
+    and raises the typed :class:`WorldSizeMismatchError` naming both
+    (instead of a shape error deep in device_put) — the condition the
+    elastic resume path catches to trigger re-search + reshard. Restores
+    retry transient I/O errors with jittered backoff (preemptible fleets
+    resume through flaky object-store reads)."""
     ckpt_dir = os.path.abspath(ckpt_dir)  # orbax rejects relative paths
     meta = read_checkpoint_meta(ckpt_dir)
     if "step" not in meta:
         raise FileNotFoundError(
             f"{ckpt_dir} has no meta.json — not a committed checkpoint")
+    if expected_world is not None:
+        stored_plan = meta.get("hybrid_parallel_config") or {}
+        sw = stored_plan.get("world_size")
+        if sw is not None and int(sw) != int(expected_world):
+            raise WorldSizeMismatchError(ckpt_dir, int(sw),
+                                         int(expected_world), stored_plan)
     if strict_plan and hpc is not None:
         stored = meta.get("hybrid_parallel_config")
         current = _plan_fingerprint(hpc)

@@ -148,6 +148,24 @@ def initialize_distributed(args: CoreArgs) -> bool:
     return True
 
 
+def visible_world_size(args: CoreArgs) -> int:
+    """The effective world size a run of ``args`` would see: every
+    visible chip, clamped by ``parallel.num_devices`` — the SAME
+    derivation :func:`initialize` records in ``RunState.world_size``.
+    Joins the coordination service first on multi-host pods (the backend
+    must not be probed before ``jax.distributed.initialize``). THE
+    helper for every pre-``initialize`` world probe (the elastic resume
+    pre-pass, the supervisor's ``world_fn``), so the elastic trigger and
+    the actual run state can never disagree about the world."""
+    import jax
+
+    initialize_distributed(args)
+    world = len(jax.devices())
+    if args.parallel.num_devices > 0:
+        world = min(args.parallel.num_devices, world)
+    return world
+
+
 def initialize(args: CoreArgs, devices: Optional[List[Any]] = None
                ) -> RunState:
     """Validate + seed + discover devices; returns (and stores) the run

@@ -1,0 +1,527 @@
+"""Elastic resharding: move a committed checkpoint between hybrid-parallel
+plans and worlds.
+
+Galvatron's whole premise is that the optimal plan is a function of the
+topology — so when preemption changes the topology, the correct response
+is not "resume the same world" but "re-search, reshard, resume". This
+module is the reshard leg: a committed checkpoint written under plan A
+(by ANY of the three engine layouts) becomes arrays laid out for plan B's
+PartitionSpecs, exactly — a generalized ``split_params``.
+
+The three on-disk layouts a checkpoint may carry:
+
+* **spmd** — the pp=1 SPMD path: one plain full-model tree
+  (``models/builder.init_causal_lm`` structure).
+* **stacked** — the compiled 1F1B engine
+  (``runtime/compiled_pipeline.py::split_params``): decoder layer
+  ``s*lps + j`` is row ``s`` of ``stages[j]`` (a leading ``[pp]`` axis on
+  every layer leaf); embed/prenorm/head replicated.
+* **stages** — the host pipeline engine
+  (``runtime/pipeline.py::split_params``): a list of per-stage trees,
+  embed on the first stage, prenorm/head on the last (the tied head
+  carrying a transposed ``whead = wte.T`` copy).
+
+Everything funnels through one canonical form — the full host tree — and
+back out through structure-driven placement: the DESTINATION template (the
+new engine's freshly initialized, sharded ``(sp, so)``) tells us both the
+target layout and the target shardings, so the reshard is
+``canonicalize -> re-split -> device_put`` per leaf (gather-to-host per
+leaf is the first implementation, per the SNIPPETS NamedSharding +
+``device_put`` idiom; a device-to-device path can land later without
+changing callers).
+
+Optimizer state rides the same transformations: every params-shaped
+subtree inside the optax state (adam mu/nu) is located by pytree-structure
+match (:func:`map_params_like`) and re-laid-out with the identical
+canonicalize/split functions, so the resumed trajectory is bit-for-bit the
+checkpoint's. The optax chain's scalar states (step counts) pass through
+untouched. Placement onto the destination optimizer template goes by FLAT
+LEAF ORDER with per-leaf shape checks rather than structure equality: the
+engines build slightly different optax chains (the SPMD path carries
+``clip_by_global_norm``, the pipeline engines clip outside optax), but
+the differing states are empty — zero leaves — so the moment/count leaf
+sequence is identical across engines while the pytree structures are not.
+
+This module is resume-path code (cold), not step-path code: host syncs
+are the point, not a bug.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+Params = Dict[str, Any]
+
+LAYOUT_SPMD = "spmd"
+LAYOUT_STACKED = "stacked"
+LAYOUT_STAGES = "stages"
+
+# the full-model tree's vocab-row keys (everything that is not a layer)
+_VOCAB_KEYS = ("embed", "prenorm", "head", "enc_norm")
+
+
+class ReshardError(RuntimeError):
+    """A checkpoint cannot be resharded onto the target plan (layer-count
+    mismatch, unrecognized layout, shape drift) — actionable, names both
+    sides."""
+
+
+# ---------------------------------------------------------------------------
+# layout detection + normalization
+# ---------------------------------------------------------------------------
+
+
+def _normalize_raw(tree: Any) -> Any:
+    """Orbax raw (target-less) restores may surface sequence pytrees as
+    dicts keyed '0','1',...; fold those back into lists so layout
+    detection and canonicalization see the structure the engine saved."""
+    if isinstance(tree, dict):
+        keys = list(tree.keys())
+        if keys and all(isinstance(k, str) and k.isdigit() for k in keys) \
+                and sorted(int(k) for k in keys) == list(range(len(keys))):
+            return [_normalize_raw(tree[str(i)]) for i in range(len(keys))]
+        return {k: _normalize_raw(v) for k, v in tree.items()}
+    if isinstance(tree, (list, tuple)):
+        return [_normalize_raw(v) for v in tree]
+    return tree
+
+
+def detect_layout(tree: Any) -> str:
+    """Which engine layout a params tree (raw-restored or live) carries."""
+    if isinstance(tree, (list, tuple)):
+        if tree and isinstance(tree[0], dict) and "layers" in tree[0]:
+            return LAYOUT_STAGES
+        raise ReshardError(
+            f"unrecognized checkpoint params layout: sequence of "
+            f"{type(tree[0]).__name__ if tree else 'nothing'}")
+    if isinstance(tree, dict):
+        if "stages" in tree:
+            return LAYOUT_STACKED
+        if "layers" in tree:
+            return LAYOUT_SPMD
+    raise ReshardError(
+        "unrecognized checkpoint params layout: expected a full-model "
+        "tree, a compiled stage-stacked tree, or a per-stage list "
+        f"(got {type(tree).__name__} with keys "
+        f"{sorted(tree) if isinstance(tree, dict) else '?'})")
+
+
+def _np(tree: Any) -> Any:
+    return jax.tree.map(lambda x: np.asarray(x), tree)
+
+
+def _tuple_layers(tree: Params) -> Params:
+    out = dict(tree)
+    for k in ("layers", "enc_layers"):
+        if k in out:
+            out[k] = tuple(out[k])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# canonicalize: any layout -> the full host tree
+# ---------------------------------------------------------------------------
+
+
+def _unstack_compiled(tree: Params) -> Params:
+    """Compiled stacked layout -> full tree (mirrors
+    ``CompiledPipelineEngine.merge_params``: layer ``s*lps + j`` is row
+    ``s`` of ``stages[j]``)."""
+    stages = list(tree["stages"])
+    lps = len(stages)
+    leaves = jax.tree.leaves(stages[0])
+    if not leaves:
+        raise ReshardError("stacked checkpoint has no layer leaves")
+    pp = int(np.shape(leaves[0])[0])
+    layers: List[Params] = []
+    for s in range(pp):
+        for j in range(lps):
+            layers.append(jax.tree.map(lambda x: np.asarray(x)[s],
+                                       stages[j]))
+    out: Params = {"layers": tuple(layers)}
+    for k in _VOCAB_KEYS:
+        if k in tree:
+            out[k] = _np(tree[k])
+    return out
+
+
+def _merge_stages(stage_list: Sequence[Params], *, tie: bool) -> Params:
+    """Host per-stage layout -> full tree (mirrors
+    ``PipelineEngine.merge_params``; the tied head's transposed ``whead``
+    copy is dropped — ``wte`` carries the canonical value)."""
+    layers: List[Params] = []
+    enc: List[Params] = []
+    out: Params = {}
+    for sp in stage_list:
+        layers.extend(_np(list(sp["layers"])))
+        if "enc_layers" in sp:
+            enc.extend(_np(list(sp["enc_layers"])))
+        for k in ("embed", "prenorm", "enc_norm"):
+            if k in sp:
+                out[k] = _np(sp[k])
+        if "head" in sp:
+            head = {k: v for k, v in sp["head"].items()
+                    if not (tie and k == "whead")}
+            out["head"] = _np(head)
+    out["layers"] = tuple(layers)
+    if enc:
+        out["enc_layers"] = tuple(enc)
+    return out
+
+
+def canonicalize_params(tree: Any, *, tie_word_embeddings: bool = False,
+                        layout: Optional[str] = None) -> Params:
+    """Any engine layout -> the canonical full host tree (numpy leaves)."""
+    tree = _normalize_raw(tree)
+    layout = layout or detect_layout(tree)
+    if layout == LAYOUT_SPMD:
+        return _np(_tuple_layers(tree))
+    if layout == LAYOUT_STACKED:
+        return _unstack_compiled(tree)
+    return _merge_stages(tree, tie=tie_word_embeddings)
+
+
+def _fill_empty(canonical: Params, template: Params) -> Params:
+    """Orbax drops empty containers at save (a tied model's ``head: {}``
+    never lands on disk); recreate whatever empty vocab-row keys the
+    destination template expects so structural placement lines up."""
+    out = dict(canonical)
+    for k in _VOCAB_KEYS:
+        if k in template and k not in out:
+            out[k] = {}
+    return out
+
+
+# ---------------------------------------------------------------------------
+# re-split: canonical tree -> the destination template's layout
+# ---------------------------------------------------------------------------
+
+
+def _check_layer_count(canonical: Params, want: int, what: str) -> None:
+    have = len(canonical["layers"])
+    if have != want:
+        raise ReshardError(
+            f"checkpoint has {have} decoder layers but the target "
+            f"{what} expects {want}: the plans describe different models")
+
+
+def _stack_like(canonical: Params, template: Params) -> Params:
+    """Canonical -> compiled stacked layout shaped like ``template``."""
+    lps = len(template["stages"])
+    leaves = jax.tree.leaves(template["stages"][0])
+    pp = int(leaves[0].shape[0])
+    _check_layer_count(canonical, pp * lps, "compiled plan")
+    stages = tuple(
+        jax.tree.map(lambda *rows: np.stack([np.asarray(r) for r in rows]),
+                     *[canonical["layers"][s * lps + j] for s in range(pp)])
+        for j in range(lps))
+    out: Params = {"stages": stages}
+    for k in _VOCAB_KEYS:
+        if k in template:
+            out[k] = canonical.get(k, {})
+    return out
+
+
+def _split_stages_like(canonical: Params,
+                       template: Sequence[Params]) -> List[Params]:
+    """Canonical -> host per-stage layout shaped like ``template`` (the
+    engine's placed per-stage trees): layer slices by each stage's count,
+    vocab rows by key presence, the tied ``whead`` recreated as the
+    transpose of the canonical ``wte``-shaped leaf (for adam moments this
+    transposes the moment — exactly what the engine's symmetric tied-grad
+    exchange maintains)."""
+    total = sum(len(st["layers"]) for st in template)
+    _check_layer_count(canonical, total, "pipeline plan")
+    out: List[Params] = []
+    lo = elo = 0
+    for st in template:
+        n = len(st["layers"])
+        sp: Params = {"layers": tuple(canonical["layers"][lo:lo + n])}
+        lo += n
+        if "enc_layers" in st:
+            ne = len(st["enc_layers"])
+            sp["enc_layers"] = tuple(canonical["enc_layers"][elo:elo + ne])
+            elo += ne
+        for k in ("embed", "prenorm", "enc_norm"):
+            if k in st:
+                sp[k] = canonical.get(k, {})
+        if "head" in st:
+            head = canonical.get("head", {})
+            if "whead" in st["head"] and "whead" not in head:
+                head = {**head,
+                        "whead": np.asarray(canonical["embed"]["wte"]).T}
+            sp["head"] = head
+        out.append(sp)
+    return out
+
+
+def _relayout(canonical: Params, template: Any) -> Any:
+    """Canonical tree -> a host tree in the template's layout."""
+    layout = detect_layout(template)
+    if layout == LAYOUT_SPMD:
+        _check_layer_count(canonical, len(template["layers"]), "plan")
+        return _fill_empty(canonical, template)
+    if layout == LAYOUT_STACKED:
+        return _stack_like(canonical, template)
+    return _split_stages_like(canonical, template)
+
+
+def _put(t, s):
+    s = np.asarray(s)
+    if tuple(t.shape) != tuple(s.shape):
+        raise ReshardError(
+            f"reshard shape mismatch: checkpoint leaf {s.shape} vs "
+            f"target {tuple(t.shape)}")
+    if s.dtype != t.dtype:
+        s = s.astype(t.dtype)
+    return jax.device_put(s, t.sharding)
+
+
+def place_like(template: Any, host_tree: Any) -> Any:
+    """device_put every host leaf under the matching template leaf's
+    sharding (the destination engine's freshly initialized tree IS the
+    spec sheet). Raises :class:`ReshardError` on any structure or shape
+    disagreement."""
+    try:
+        return jax.tree.map(_put, template, host_tree)
+    except ReshardError:
+        raise
+    except (ValueError, TypeError, KeyError) as e:
+        raise ReshardError(
+            f"reshard structure mismatch between the checkpoint and the "
+            f"target plan's tree: {e}") from e
+
+
+def place_like_flat(template: Any, host_tree: Any) -> Any:
+    """Flat-order placement for OPTIMIZER state: the engines' optax chains
+    differ only by zero-leaf empty states (the SPMD chain carries
+    ``clip_by_global_norm``; the pipeline engines clip outside optax) and
+    by container flavor after a raw restore (namedtuples come back as
+    dicts), so the leaf SEQUENCE is the invariant — pair leaves in order,
+    check every shape, and rebuild with the template's structure. A
+    count/moment misalignment surfaces as a shape mismatch, not silent
+    corruption (every adjacent leaf pair in these chains differs in
+    shape)."""
+    tleaves, tdef = jax.tree_util.tree_flatten(template)
+    hleaves = jax.tree.leaves(host_tree)
+    if len(tleaves) != len(hleaves):
+        raise ReshardError(
+            f"optimizer state leaf count mismatch: checkpoint has "
+            f"{len(hleaves)}, target optimizer expects {len(tleaves)} — "
+            "resume with the optimizer the checkpoint was trained with")
+    return jax.tree_util.tree_unflatten(
+        tdef, [_put(t, h) for t, h in zip(tleaves, hleaves)])
+
+
+# ---------------------------------------------------------------------------
+# optimizer state: map the params-shaped subtrees through the same moves
+# ---------------------------------------------------------------------------
+
+
+def map_params_like(tree: Any, params_treedef: Any,
+                    fn: Callable[[Any], Any]) -> Any:
+    """Replace every subtree of ``tree`` whose pytree structure equals
+    ``params_treedef`` with ``fn(subtree)`` — how the adam mu/nu clones of
+    the params tree inside an optax state get the same layout moves as the
+    params themselves. Walks dicts / lists / tuples / namedtuples; every
+    other node (arrays, scalars, optax sentinels) passes through."""
+    def walk(node):
+        try:
+            if jax.tree.structure(node) == params_treedef:
+                return fn(node)
+        except (ValueError, TypeError):
+            pass
+        if isinstance(node, dict):
+            return {k: walk(v) for k, v in node.items()}
+        if isinstance(node, tuple) and hasattr(node, "_fields"):
+            return type(node)(*(walk(getattr(node, f))
+                                for f in node._fields))
+        if isinstance(node, (list, tuple)):
+            return type(node)(walk(c) for c in node)
+        return node
+
+    return walk(tree)
+
+
+def _merge_opt_stages(stage_opts: Sequence[Any], stage_defs: Sequence[Any],
+                      merge_fn: Callable[[List[Any]], Any]) -> Any:
+    """Lockstep walk over the host engine's per-stage optimizer states
+    (one ``tx.init`` per stage, identical outer chain): wherever every
+    branch matches its stage's params structure, merge the per-stage trees
+    into one canonical tree. Scalar chain state (step counts) is identical
+    across stages — the first stage's value is kept."""
+    def walk(nodes):
+        try:
+            if all(jax.tree.structure(n) == d
+                   for n, d in zip(nodes, stage_defs)):
+                return merge_fn(list(nodes))
+        except (ValueError, TypeError):
+            pass
+        n0 = nodes[0]
+        if isinstance(n0, dict):
+            return {k: walk([n[k] for n in nodes]) for k in n0}
+        if isinstance(n0, tuple) and hasattr(n0, "_fields"):
+            return type(n0)(*(walk([getattr(n, f) for n in nodes])
+                              for f in n0._fields))
+        if isinstance(n0, (list, tuple)):
+            return type(n0)(walk([n[i] for n in nodes])
+                            for i in range(len(n0)))
+        return n0
+
+    return walk(list(stage_opts))
+
+
+def _host_target(tree: Any) -> Any:
+    """Shape/dtype targets pinned to ONE local device, so orbax never
+    consults the checkpoint's saved sharding file — which names the OLD
+    world's devices and cannot resolve after a topology change (the
+    exact situation this module exists for)."""
+    from jax.sharding import SingleDeviceSharding
+
+    shd = SingleDeviceSharding(jax.devices()[0])
+    return jax.tree.map(
+        lambda m: jax.ShapeDtypeStruct(
+            tuple(m.shape), m.dtype, sharding=shd),
+        tree, is_leaf=lambda x: hasattr(x, "shape") and hasattr(x, "dtype")
+        and not isinstance(x, dict))
+
+
+# ---------------------------------------------------------------------------
+# checkpoint -> canonical
+# ---------------------------------------------------------------------------
+
+
+def load_checkpoint_canonical(
+    ckpt_dir: str,
+    *,
+    tie_word_embeddings: bool = False,
+    with_opt: bool = True,
+) -> Tuple[Params, Any, int, Dict[str, Any]]:
+    """Restore a committed checkpoint written under ANY engine layout into
+    the canonical full host tree. Returns ``(params, opt_state, step,
+    meta)``; ``opt_state`` is the raw-restored optax state (saved
+    structure, dict-flavored containers) with every params-shaped subtree
+    canonicalized — None when absent or ``with_opt`` is off. The restore
+    needs no target tree and no optimizer from the caller: the
+    checkpoint's own recorded metadata drives both structure and layout
+    detection, and the single-device restore targets keep orbax away from
+    the saved sharding file (it names the OLD world's devices)."""
+    import orbax.checkpoint as ocp
+
+    from hetu_galvatron_tpu.runtime.checkpoint import read_checkpoint_meta
+
+    ckpt_dir = os.path.abspath(ckpt_dir)
+    meta = read_checkpoint_meta(ckpt_dir)
+    if "step" not in meta:
+        raise FileNotFoundError(
+            f"{ckpt_dir} has no meta.json — not a committed checkpoint")
+    ckptr = ocp.StandardCheckpointer()
+    params_dir = os.path.join(ckpt_dir, "params")
+    raw = _normalize_raw(ckptr.restore(
+        params_dir, _host_target(ckptr.metadata(params_dir))))
+    layout = detect_layout(raw)
+    canonical = canonicalize_params(raw, layout=layout,
+                                    tie_word_embeddings=tie_word_embeddings)
+    opt = None
+    opt_dir = os.path.join(ckpt_dir, "opt_state")
+    if with_opt and os.path.isdir(opt_dir):
+        raw_opt = _normalize_raw(ckptr.restore(
+            opt_dir, _host_target(ckptr.metadata(opt_dir))))
+        canon = lambda t: canonicalize_params(
+            t, layout=layout, tie_word_embeddings=tie_word_embeddings)
+        if layout == LAYOUT_STAGES:
+            stage_defs = [jax.tree.structure(st) for st in raw]
+            opt = _merge_opt_stages(
+                raw_opt, stage_defs,
+                lambda trees: _merge_stages(trees,
+                                            tie=tie_word_embeddings))
+        else:
+            opt = map_params_like(raw_opt, jax.tree.structure(raw), canon)
+    return canonical, opt, int(meta["step"]), meta
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+
+def reshard_params(params: Params, src_plan: Any, dst_plan: Any, mesh: Any,
+                   *, axes_tree: Params) -> Params:
+    """Re-lay a full-model params tree from plan A onto plan B's
+    PartitionSpecs over ``mesh`` — the generalized ``split_params``.
+    ``params`` may be sharded under ``src_plan`` or live on the host; each
+    leaf is gathered to host and ``device_put`` under the destination
+    NamedSharding (the SNIPPETS idiom). ``src_plan`` may be None (host
+    trees); when given it is validated against the model's layer count so
+    a wrong-model checkpoint fails here, not deep in XLA."""
+    from jax.sharding import NamedSharding
+
+    from hetu_galvatron_tpu.parallel.spmd import layer_shardings, param_specs
+
+    n_layers = len(params["layers"]) + len(params.get("enc_layers", ()))
+    for plan, name in ((src_plan, "source"), (dst_plan, "destination")):
+        if plan is not None and len(plan.layers) != n_layers:
+            raise ReshardError(
+                f"{name} plan describes {len(plan.layers)} layers but the "
+                f"params tree has {n_layers}")
+    host = jax.device_get(params)
+    per_layer_all, vocab = layer_shardings(dst_plan, mesh)
+    n_enc = dst_plan.num_encoder_layers
+    pspecs = param_specs(axes_tree, per_layer_all[n_enc:], vocab,
+                         enc_per_layer=per_layer_all[:n_enc] or None)
+    return jax.tree.map(
+        lambda p, s: jax.device_put(np.asarray(p), NamedSharding(mesh, s)),
+        _tuple_layers(host), pspecs)
+
+
+def resume_elastic(
+    ckpt_dir: str,
+    dst_params: Any,
+    dst_opt: Any,
+    *,
+    tie_word_embeddings: bool = False,
+    num_experts: int = 0,
+) -> Tuple[Any, Any, int]:
+    """The elastic-resume restore: a committed checkpoint written under
+    plan A (any engine layout, any world) lands on the NEW engine's
+    freshly initialized ``(dst_params, dst_opt)`` templates — same values,
+    new layout, new shardings. Returns ``(params, opt_state, step)``.
+
+    ``dst_params``/``dst_opt`` carry both the target layout and the target
+    shardings (they are the new engine's ``split_params``/``init_opt``
+    output); the destination optimizer must be the one the checkpoint was
+    trained with (the flat leaf pairing in :func:`place_like_flat` is
+    checked per leaf, so a different optimizer fails loudly)."""
+    if num_experts:
+        # multi_transform's masked expert-bias lane replaces leaves with
+        # optax.MaskedNode, so the moment trees no longer structure-match
+        # the params tree and the subtree mapping would silently skip them
+        raise ReshardError(
+            "elastic reshard of MoE optimizer state is not supported yet "
+            "(the expert-bias optimizer lane masks the moment trees); "
+            "resume MoE runs on the original topology")
+    canonical, canonical_opt, step, _ = load_checkpoint_canonical(
+        ckpt_dir, tie_word_embeddings=tie_word_embeddings,
+        with_opt=dst_opt is not None)
+    sp = place_like(dst_params, _relayout(canonical, dst_params))
+    so = dst_opt
+    if canonical_opt is not None and dst_opt is not None:
+        canon_def = jax.tree.structure(canonical)
+        layout = detect_layout(dst_params)
+        if layout == LAYOUT_STAGES:
+            so = [place_like_flat(
+                dst_opt[s],
+                map_params_like(
+                    canonical_opt, canon_def,
+                    lambda t, s=s: _split_stages_like(t, dst_params)[s]))
+                for s in range(len(dst_params))]
+        else:
+            so = place_like_flat(
+                dst_opt,
+                map_params_like(canonical_opt, canon_def,
+                                lambda t: _relayout(t, dst_params)))
+    return sp, so, step

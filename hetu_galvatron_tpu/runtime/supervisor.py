@@ -171,6 +171,7 @@ def run_with_restarts(
     restart_codes: Iterable[int] = RESTARTABLE_EXIT_CODES,
     restart_on_error: bool = True,
     progress_fn: Optional[Callable[[], Any]] = None,
+    world_fn: Optional[Callable[[], Any]] = None,
     sleep: Optional[Callable[[float], None]] = None,
     rng: Optional[random.Random] = None,
     registry=None,
@@ -192,22 +193,42 @@ def run_with_restarts(
     changes between attempts (the attempt checkpointed new progress) the
     restart counter resets, so a healthy multi-day run on a preemptible
     fleet survives arbitrarily many preemptions while a run that loops
-    without advancing still stops after ``max_restarts``."""
+    without advancing still stops after ``max_restarts``.
+
+    ``world_fn`` (e.g. ``lambda: len(jax.devices())``) makes a TOPOLOGY
+    change progress too: when the visible world differs between attempts
+    (half the fleet preempted away, or capacity returned) the next attempt
+    re-searches and reshards rather than repeating the fault, so the
+    restart counter resets exactly as a committed checkpoint would reset
+    it — the budget bounds same-world crash loops, never elasticity.
+    World changes are counted (``supervisor/world_changes``) so dashboards
+    see fleet churn distinctly from crash churn."""
     if sleep is None:
         from hetu_galvatron_tpu.utils.retrying import _default_sleep as sleep
     restart_codes = tuple(restart_codes)
     reg = _registry(registry)
     restarts = 0
     last_progress = progress_fn() if progress_fn is not None else None
+    last_world = world_fn() if world_fn is not None else None
 
     def note_progress() -> None:
-        nonlocal restarts, last_progress
-        if progress_fn is None:
-            return
-        cur = progress_fn()
-        if cur != last_progress:
+        nonlocal restarts, last_progress, last_world
+        advanced = False
+        if world_fn is not None:
+            world = world_fn()
+            if world != last_world:
+                reg.counter("supervisor/world_changes").inc()
+                log(f"supervisor: world changed {last_world} -> {world}; "
+                    "topology change is progress (restart budget reset)")
+                last_world = world
+                advanced = True
+        if progress_fn is not None:
+            cur = progress_fn()
+            if cur != last_progress:
+                advanced = True
+                last_progress = cur
+        if advanced:
             restarts = 0  # forward progress: this is not a crash loop
-            last_progress = cur
 
     while True:
         try:

@@ -58,6 +58,10 @@ class RadixNode:
     children: Dict[BlockKey, "RadixNode"] = field(default_factory=dict)
     ref: int = 0
     stamp: int = 0
+    # detached by invalidate() while still pinned by a live request: the
+    # node no longer matches (its k/v was computed under superseded
+    # weights) but its blocks stay live until the last pin releases
+    zombie: bool = False
 
 
 class PrefixCache:
@@ -80,6 +84,9 @@ class PrefixCache:
         self.root = RadixNode(tokens=(), blocks=[], parent=None)
         self._clock = itertools.count(1)
         self.blocks_held = 0
+        # nodes detached by invalidate() while pinned: kept only so
+        # release() can drop their blocks when the last pin goes
+        self._zombies: List[RadixNode] = []
         # telemetry: lookups/hits/tokens served from cache/evicted blocks
         self.lookups = 0
         self.hits = 0
@@ -148,12 +155,19 @@ class PrefixCache:
 
     def release(self, path: Sequence[RadixNode]) -> None:
         """Drop a request's pins (retirement). Idempotence is the
-        caller's job — each match() pin is released exactly once."""
+        caller's job — each match() pin is released exactly once. A
+        zombie node (detached by :meth:`invalidate` while pinned) drops
+        its block references when its last pin goes."""
         for node in path:
             if node.ref < 1:
                 raise ValueError("release of an unpinned radix node")
             node.ref -= 1
             self._touch(node)
+            if node.zombie and node.ref == 0:
+                self.allocator.decref(node.blocks)
+                self.blocks_held -= len(node.blocks)
+                self.evicted_blocks += len(node.blocks)
+                self._zombies.remove(node)
 
     # -- insertion ----------------------------------------------------------
 
@@ -265,13 +279,17 @@ class PrefixCache:
             del victim.parent.children[victim.tokens[:self.block_size]]
         return freed
 
-    # -- defrag support ------------------------------------------------------
+    # -- invalidation (weight swap) ------------------------------------------
 
-    def export_tables(self) -> Tuple[List[RadixNode], List[List[int]]]:
-        """Every node's block list, for compaction: the scheduler passes
-        these alongside the sequences' tables so ``defrag_plan`` renames
-        EVERY referencing view (satellite contract: a radix node's table
-        is a first-class block table)."""
+    def invalidate(self) -> int:
+        """Drop every cached prefix — the weight-swap contract: pooled
+        k/v was computed under the OLD weights, so a post-swap request
+        must never splice it (its stream would not match a cold engine on
+        the new checkpoint). Unpinned nodes free their blocks now; nodes
+        pinned by in-flight requests detach as ZOMBIES whose blocks free
+        at their last :meth:`release` (the in-flight request keeps its own
+        allocator refs and finishes under the mixed-context contract).
+        Returns how many blocks left the tree immediately."""
         nodes: List[RadixNode] = []
         stack = [self.root]
         while stack:
@@ -279,6 +297,37 @@ class PrefixCache:
             if n is not self.root:
                 nodes.append(n)
             stack.extend(n.children.values())
+        dropped = 0
+        for n in nodes:
+            n.children = {}
+            if n.ref > 0:
+                n.zombie = True
+                self._zombies.append(n)
+            else:
+                self.allocator.decref(n.blocks)
+                dropped += len(n.blocks)
+                self.blocks_held -= len(n.blocks)
+                self.evicted_blocks += len(n.blocks)
+        self.root = RadixNode(tokens=(), blocks=[], parent=None)
+        return dropped
+
+    # -- defrag support ------------------------------------------------------
+
+    def export_tables(self) -> Tuple[List[RadixNode], List[List[int]]]:
+        """Every node's block list, for compaction: the scheduler passes
+        these alongside the sequences' tables so ``defrag_plan`` renames
+        EVERY referencing view (satellite contract: a radix node's table
+        is a first-class block table). Zombie nodes (detached by
+        :meth:`invalidate`, blocks still live until their pins release)
+        are included — their blocks are pool blocks like any other."""
+        nodes: List[RadixNode] = []
+        stack = [self.root]
+        while stack:
+            n = stack.pop()
+            if n is not self.root:
+                nodes.append(n)
+            stack.extend(n.children.values())
+        nodes.extend(self._zombies)
         return nodes, [list(n.blocks) for n in nodes]
 
     def adopt_tables(self, nodes: Sequence[RadixNode],

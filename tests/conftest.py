@@ -140,6 +140,17 @@ _SLOW_TESTS = {
     "test_suffix_bucket_overshoot_at_table_capacity",
     "test_spec_eos_and_budget_mid_window",
     "test_spec_sampled_lanes_match_plain_engine",
+    # elastic topology-change drills (all train real checkpoints): the
+    # N -> N/2 SIGTERM-kill resume through the real search, the
+    # degree-adapt replay-parity leg, the cross-engine reshard exactness
+    # matrix, and the load-test-across-weight-swap drill. Fast tier keeps
+    # the reshard layout units, the exit-17 gate, and the quiet-engine
+    # swap contract.
+    "test_elastic_drill_kill8_resume4_searched",
+    "test_elastic_resume_degree_adapt_replays_exactly",
+    "test_reshard_exact_across_engines",
+    "test_weight_swap_load_drill",
+    "test_swap_invalidates_prefix_cache",
 }
 
 

@@ -344,6 +344,67 @@ def test_hf_bert_roundtrip_and_forward():
     np.testing.assert_allclose(np.asarray(ours2), np.asarray(ours), atol=1e-6)
 
 
+@pytest.mark.robustness
+@pytest.mark.elastic
+def test_world_mismatch_raises_typed_error(tmp_path):
+    """A topology-changed resume must fail AT LOAD with both worlds named
+    (not as a shape error deep in device_put) — the exact condition the
+    elastic resume path catches to trigger re-search + reshard."""
+    from hetu_galvatron_tpu.runtime.checkpoint import WorldSizeMismatchError
+
+    params, _ = init_causal_lm(jax.random.key(0), TINY)
+    args = CoreArgs.model_validate({"model": TINY.model_dump()})
+    hpc = get_hybrid_parallel_config(args, 2)
+    save_checkpoint(str(tmp_path), 3, params, hpc=hpc)
+    d = latest_checkpoint(str(tmp_path))
+
+    # same world: loads fine with the check armed
+    p2, _, step = load_checkpoint(d, params, expected_world=2)
+    assert step == 3
+
+    with pytest.raises(WorldSizeMismatchError) as ei:
+        load_checkpoint(d, params, expected_world=1)
+    err = ei.value
+    assert err.stored_world == 2 and err.live_world == 1
+    assert "2-device" in str(err) and "1 devices" in str(err)
+    assert "reshard" in str(err)  # actionable: names the remedy
+
+    # legacy checkpoints (no plan fingerprint) stay loadable
+    save_checkpoint(str(tmp_path / "legacy"), 1, params)
+    d2 = latest_checkpoint(str(tmp_path / "legacy"))
+    load_checkpoint(d2, params, expected_world=1)
+
+
+@pytest.mark.robustness
+@pytest.mark.elastic
+def test_gc_never_reaps_live_resume_selection(tmp_path):
+    """keep_last pruning racing a concurrent resume must never delete the
+    step latest_checkpoint() just selected — the selection is held out of
+    the prune set until the next selection releases it."""
+    import os
+
+    from hetu_galvatron_tpu.runtime.checkpoint import gc_checkpoints
+
+    params, _ = init_causal_lm(jax.random.key(0), TINY)
+    for s in (1, 2, 3):
+        save_checkpoint(str(tmp_path), s, params)
+    sel = latest_checkpoint(str(tmp_path))
+    assert sel.endswith("step_3")
+
+    # a newer save commits and prunes aggressively while the resume is
+    # between its latest_checkpoint() and the shard/meta reads
+    save_checkpoint(str(tmp_path), 4, params, keep_last=1)
+    kept = sorted(d for d in os.listdir(tmp_path) if d.startswith("step_"))
+    assert kept == ["step_3", "step_4"]  # selection survived; 1/2 pruned
+    load_checkpoint(sel, params)  # the resume still completes
+
+    # the NEXT selection releases the old protection
+    assert latest_checkpoint(str(tmp_path)).endswith("step_4")
+    gc_checkpoints(str(tmp_path), keep_last=1)
+    kept = sorted(d for d in os.listdir(tmp_path) if d.startswith("step_"))
+    assert kept == ["step_4"]
+
+
 def test_hf_t5_roundtrip():
     """T5 h2g/g2h: every projection/norm tensor round-trips exactly (position
     scheme intentionally differs — models/encdec.py is RoPE/learned by

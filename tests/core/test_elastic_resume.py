@@ -1,0 +1,186 @@
+"""Elastic topology-change resume, end to end through cli/train_dist:
+detect (world mismatch at the committed checkpoint) -> re-search (or
+degree-adapt) -> HBM budget-gate -> reshard -> replay the exact data
+position. The acceptance drill kills an 8-device tp2 x dp2 x pp2 run with
+a REAL SIGTERM and resumes it on 4 devices through the offline search."""
+
+import glob
+import json
+import os
+
+import numpy as np
+import pytest
+
+pytestmark = [pytest.mark.distributed, pytest.mark.robustness,
+              pytest.mark.elastic]
+
+ZOO = os.path.join(os.path.dirname(__file__), "..", "..",
+                   "hetu_galvatron_tpu", "models", "configs")
+FIXTURES = os.path.join(os.path.dirname(__file__), "..", "fixtures")
+
+TINY = [
+    "model.hidden_size=32", "model.num_hidden_layers=4",
+    "model.num_attention_heads=2", "model.vocab_size=64",
+    "model.seq_length=8", "model.max_position_embeddings=16",
+    "model.make_vocab_size_divisible_by=1",
+    "train.train_iters=6", "parallel.mixed_precision=fp32",
+    "parallel.global_train_batch_size=8",
+]
+
+SEARCH_FIXTURES = [
+    "search.memory_constraint=36", "search.default_dp_type=zero2",
+    "search.pipeline_type=pipedream_flush",
+    "search.async_grad_reduce=false", "search.sequence_parallel=true",
+    "search.time_profile_mode=sequence",
+    "search.memory_profile_mode=sequence",
+    "search.max_tp_deg=2", "search.disable_ulysses=1",
+    f"search.time_profiling_path={FIXTURES}/computation_profiling_bf16_llama2-7b_all.json",
+    f"search.memory_profiling_path={FIXTURES}/memory_profiling_bf16_llama2-7b_all.json",
+    f"search.allreduce_bandwidth_config_path={FIXTURES}/allreduce_bandwidth_1nodes_8gpus_per_node.json",
+    f"search.p2p_bandwidth_config_path={FIXTURES}/p2p_bandwidth_1nodes_8gpus_per_node.json",
+    f"search.overlap_coe_path={FIXTURES}/overlap_coefficient.json",
+    f"search.sp_time_path={FIXTURES}/sp_time_1nodes_8gpus_per_node.json",
+]
+
+
+def _args(extra):
+    from hetu_galvatron_tpu.core.arguments import args_from_cli
+
+    return args_from_cli([os.path.join(ZOO, "gpt2-small.yaml")] + TINY +
+                         extra, mode="train_dist")
+
+
+def test_elastic_resume_degree_adapt_replays_exactly(tmp_path):
+    """2 -> 1 device resume with NO search profiles configured: the
+    stored plan's degrees adapt (dp2 -> dp1), the checkpoint reshards,
+    and the resumed trajectory is deterministic — a second fresh resume
+    from the same committed checkpoint reproduces it step for step (the
+    exact data position replayed)."""
+    from hetu_galvatron_tpu.cli.train_dist import train
+
+    save = str(tmp_path / "ckpt")
+    out2 = train(_args([f"ckpt.save={save}", "ckpt.save_interval=2",
+                        "train.train_iters=4", "parallel.num_devices=2"]))
+    assert out2["exit_code"] is None and len(out2["losses"]) == 4
+    assert os.path.isdir(os.path.join(save, "step_4"))
+
+    resume_extra = [f"ckpt.load={save}", "parallel.num_devices=1",
+                    "train.train_iters=6"]
+    outA = train(_args(resume_extra))
+    assert outA["exit_code"] is None
+    assert len(outA["losses"]) == 2  # resumed at 4, ran 4..5
+    assert outA["goodput"]["totals"]["reshard"] > 0.0
+
+    outB = train(_args(resume_extra))  # the "fresh run from the same ckpt"
+    np.testing.assert_allclose(outA["losses"], outB["losses"],
+                               rtol=0, atol=0)
+    assert all(np.isfinite(outA["losses"]))
+
+
+def test_elastic_rejected_plan_exits_17_with_flight_dump(tmp_path):
+    """An OOM-rejected elastic target plan is TERMINAL: train() returns
+    exit code 17 (failed-result-validation — it reproduces on every
+    restart, so the supervisor must not loop) and leaves a parseable
+    flight-recorder dump naming the rejection."""
+    from hetu_galvatron_tpu.cli.train_dist import train
+    from hetu_galvatron_tpu.runtime.rerun_machine import (
+        EXIT_CODE_FAILED_ON_RESULT_VALIDATION,
+    )
+
+    save = str(tmp_path / "ckpt")
+    train(_args([f"ckpt.save={save}", "ckpt.save_interval=1",
+                 "train.train_iters=1", "parallel.num_devices=2"]))
+    fdir = str(tmp_path / "flight")
+    out = train(_args([
+        f"ckpt.load={save}", "parallel.num_devices=1",
+        # an impossibly small budget: every adapted plan is OOM-rejected
+        "search.hbm_budget_gb=0.0001",
+        f"observability.flight_dir={fdir}"]))
+    assert out["exit_code"] == EXIT_CODE_FAILED_ON_RESULT_VALIDATION
+    assert out["losses"] == []
+    assert out["flight_dumps"], "no flight dump for the rejected re-plan"
+    with open(out["flight_dumps"][0]) as f:
+        dump = json.load(f)
+    assert dump["reason"] == "elastic_plan_rejected"
+    events = [e for e in dump["events"] if e.get("name") == "elastic_replan"]
+    assert events and "HBM budget" in events[0]["data"]["reason"]
+
+
+def test_elastic_reshard_failure_exits_17_not_crash(tmp_path, monkeypatch):
+    """A deterministic RESHARD failure (typed ReshardError — MoE opt
+    state, shape drift, wrong optimizer) gets the same terminal contract
+    as a rejected re-plan: exit 17 + a flight dump, never an exception
+    the supervisor would crash-restart-loop on."""
+    from hetu_galvatron_tpu.cli.train_dist import train
+    from hetu_galvatron_tpu.runtime import reshard
+    from hetu_galvatron_tpu.runtime.rerun_machine import (
+        EXIT_CODE_FAILED_ON_RESULT_VALIDATION,
+    )
+
+    save = str(tmp_path / "ckpt")
+    train(_args([f"ckpt.save={save}", "ckpt.save_interval=1",
+                 "train.train_iters=1", "parallel.num_devices=2"]))
+
+    def boom(*a, **k):
+        raise reshard.ReshardError("injected reshard failure")
+
+    monkeypatch.setattr(reshard, "resume_elastic", boom)
+    fdir = str(tmp_path / "flight")
+    out = train(_args([f"ckpt.load={save}", "parallel.num_devices=1",
+                       f"observability.flight_dir={fdir}"]))
+    assert out["exit_code"] == EXIT_CODE_FAILED_ON_RESULT_VALIDATION
+    assert out["losses"] == []  # zero iterations ran on untrusted state
+    assert out["flight_dumps"]
+    with open(out["flight_dumps"][0]) as f:
+        dump = json.load(f)
+    assert dump["reason"] == "elastic_reshard_failed"
+
+
+def test_elastic_drill_kill8_resume4_searched(tmp_path):
+    """THE acceptance drill: SIGTERM-kill an 8-device tp2 x dp2 x pp2 run
+    mid-training; resume on 4 devices. The supervisor-driven resume
+    re-searches a plan for the new topology (the real offline search over
+    the profiled fixtures), memory-gates it, reshards the committed
+    checkpoint, and its loss trajectory is step-for-step equal to a fresh
+    4-device run started from the same committed checkpoint."""
+    from hetu_galvatron_tpu.cli.train_dist import train
+    from hetu_galvatron_tpu.runtime.supervisor import (
+        EXIT_CODE_CHECKPOINT_AND_EXIT,
+    )
+
+    save = str(tmp_path / "ckpt")
+    plan8 = ["parallel.pp_deg=2", "parallel.global_tp_deg=2",
+             "parallel.chunks=2", "parallel.pipeline_type=pipedream_flush",
+             "parallel.vocab_tp=2"]
+    out8 = train(_args(plan8 + [
+        f"ckpt.save={save}",
+        "rerun.inject_kind=preempt", "rerun.inject_at_iter=2"]))
+    assert out8["exit_code"] == EXIT_CODE_CHECKPOINT_AND_EXIT
+    assert len(out8["losses"]) == 3  # iters 0..2, then the kill
+    assert os.path.isdir(os.path.join(save, "step_3"))
+
+    # the restarted attempt sees HALF the world: detect -> re-search ->
+    # gate -> reshard -> replay (what run_with_restarts would invoke; the
+    # world change itself resets its budget, pinned in test_supervisor)
+    resume_extra = plan8 + [f"ckpt.load={save}", "parallel.num_devices=4"
+                            ] + SEARCH_FIXTURES
+    outA = train(_args(resume_extra))
+    assert outA["exit_code"] is None
+    assert len(outA["losses"]) == 3  # resumed at 3, finished 3..5
+    assert all(np.isfinite(outA["losses"]))
+    assert outA["goodput"]["totals"]["reshard"] > 0.0
+
+    # the re-searched plan landed next to the checkpoint root and
+    # describes a 4-device world
+    plans = glob.glob(os.path.join(save, "elastic_plan_4dev",
+                                   "galvatron_config_*.json"))
+    assert plans, "elastic re-search wrote no plan"
+    plan = json.load(open(plans[0]))
+    assert plan["pp_deg"] * int(str(plan["tp_sizes_enc"]).split(",")[0]) \
+        <= 4
+
+    # fresh 4-device run from the SAME committed checkpoint: step-for-step
+    # equal (exact data position replayed; same searched plan)
+    outB = train(_args(resume_extra))
+    np.testing.assert_allclose(outA["losses"], outB["losses"],
+                               rtol=0, atol=0)

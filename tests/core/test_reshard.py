@@ -1,0 +1,253 @@
+"""Cross-plan checkpoint resharding (runtime/reshard.py): layout
+detection, canonicalization from all three engine layouts, structure-
+driven re-split onto destination templates, and the EXACTNESS contract —
+resharding moves bytes, never values."""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from hetu_galvatron_tpu.core.args_schema import CoreArgs
+from hetu_galvatron_tpu.models.builder import init_causal_lm
+from hetu_galvatron_tpu.runtime import reshard as R
+from hetu_galvatron_tpu.runtime.checkpoint import save_checkpoint
+from hetu_galvatron_tpu.runtime.dataloader import make_batch
+from hetu_galvatron_tpu.runtime.hybrid_config import get_hybrid_parallel_config
+
+pytestmark = [pytest.mark.distributed, pytest.mark.robustness,
+              pytest.mark.elastic]
+
+
+def _args(pp=2, tp=2, chunks=2, gbsz=8):
+    return CoreArgs.model_validate({
+        "model": {"hidden_size": 32, "num_hidden_layers": 4,
+                  "num_attention_heads": 2, "vocab_size": 64,
+                  "seq_length": 8, "max_position_embeddings": 16,
+                  "make_vocab_size_divisible_by": 1},
+        "parallel": {"pp_deg": pp, "global_tp_deg": tp, "chunks": chunks,
+                     "pipeline_type": "pipedream_flush",
+                     "mixed_precision": "fp32",
+                     "global_train_batch_size": gbsz, "vocab_tp": tp},
+    })
+
+
+def _leaves_equal(a, b):
+    la = jax.tree.leaves(jax.tree.map(np.asarray, a))
+    lb = jax.tree.leaves(jax.tree.map(np.asarray, b))
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        assert np.array_equal(np.asarray(x), np.asarray(y))
+
+
+# -- pure-host layout mechanics (no engines, no jit) ------------------------
+
+
+def test_detect_layout():
+    cfg = _args().model
+    params, _ = init_causal_lm(jax.random.key(0), cfg)
+    assert R.detect_layout(params) == R.LAYOUT_SPMD
+    assert R.detect_layout([{"layers": ()}, {"layers": ()}]) \
+        == R.LAYOUT_STAGES
+    assert R.detect_layout({"stages": (), "embed": {}}) == R.LAYOUT_STACKED
+    with pytest.raises(R.ReshardError):
+        R.detect_layout({"nope": 1})
+    with pytest.raises(R.ReshardError):
+        R.detect_layout([1, 2])
+
+
+def test_normalize_raw_folds_indexed_dicts():
+    """Orbax raw restores surface tuples/lists as '0','1'-keyed dicts;
+    canonicalization must see the saved sequence structure."""
+    tree = {"layers": {"0": {"w": np.ones(2)}, "1": {"w": np.zeros(2)}}}
+    norm = R.canonicalize_params(tree)
+    assert isinstance(norm["layers"], tuple) and len(norm["layers"]) == 2
+    assert np.array_equal(norm["layers"][1]["w"], np.zeros(2))
+
+
+def test_canonicalize_stacked_roundtrip():
+    """Hand-stack the compiled layout (layer s*lps+j -> row s of
+    stages[j]) and canonicalize back — exact, order-preserving."""
+    cfg = _args().model
+    params, _ = init_causal_lm(jax.random.key(0), cfg)
+    pp, lps = 2, 2
+    stages = tuple(
+        jax.tree.map(lambda *rows: np.stack([np.asarray(r) for r in rows]),
+                     *[params["layers"][s * lps + j] for s in range(pp)])
+        for j in range(lps))
+    stacked = {"stages": stages, "embed": params["embed"],
+               "prenorm": params["prenorm"], "head": params["head"]}
+    canonical = R.canonicalize_params(stacked)
+    _leaves_equal(canonical, params)
+    assert len(canonical["layers"]) == 4
+
+
+def test_canonicalize_stages_drops_tied_whead():
+    """The host layout's transposed tied-head copy is derived state: the
+    merge drops it (wte is canonical) and the re-split recreates it as
+    the transpose — exactly what the engine's symmetric tied-grad
+    exchange maintains."""
+    cfg = _args().model
+    assert cfg.tie_word_embeddings
+    params, _ = init_causal_lm(jax.random.key(0), cfg)
+    st0 = {"layers": tuple(params["layers"][:2]), "embed": params["embed"]}
+    st1 = {"layers": tuple(params["layers"][2:]),
+           "prenorm": params["prenorm"],
+           "head": {**params["head"],
+                    "whead": np.asarray(params["embed"]["wte"]).T}}
+    canonical = R.canonicalize_params([st0, st1], tie_word_embeddings=True)
+    assert "whead" not in canonical["head"]
+    _leaves_equal(canonical, params)
+
+    # re-split recreates whead = wte.T on the head stage
+    back = R._split_stages_like(canonical, [st0, st1])
+    assert np.array_equal(np.asarray(back[1]["head"]["whead"]),
+                          np.asarray(params["embed"]["wte"]).T)
+    _leaves_equal(back[0]["layers"], params["layers"][:2])
+
+
+def test_layer_count_mismatch_is_typed():
+    cfg = _args().model
+    params, _ = init_causal_lm(jax.random.key(0), cfg)
+    short = {**params, "layers": params["layers"][:3]}
+    with pytest.raises(R.ReshardError, match="3 decoder layers"):
+        R._relayout(short, params)
+
+
+def test_map_params_like_hits_moment_subtrees():
+    """The structure-match walker must transform adam mu/nu (params
+    clones) and leave chain scalars (counts) untouched."""
+    import optax
+
+    cfg = _args().model
+    params, _ = init_causal_lm(jax.random.key(0), cfg)
+    tx = optax.chain(optax.scale_by_adam(), optax.scale(1.0))
+    opt = tx.init(jax.tree.map(jnp.asarray, params))
+    pdef = jax.tree.structure(jax.tree.map(jnp.asarray, params))
+    hits = []
+    out = R.map_params_like(opt, pdef, lambda t: (hits.append(1) or t))
+    assert len(hits) == 2  # mu and nu
+    assert len(jax.tree.leaves(out)) == len(jax.tree.leaves(opt))
+
+
+# -- the exactness contract through real engines + checkpoints --------------
+
+
+def test_reshard_params_api(cpu_devices):
+    """reshard_params: full tree under plan A -> plan B PartitionSpecs
+    over a new mesh; values exact, shardings the destination plan's."""
+    from hetu_galvatron_tpu.runtime.mesh import build_mesh
+
+    args = _args(pp=1, tp=2, chunks=1)
+    cfg = args.model
+    params, axes = init_causal_lm(jax.random.key(0), cfg)
+    src_plan = get_hybrid_parallel_config(args, 4)
+    dst_args = _args(pp=1, tp=1, chunks=1)
+    dst_plan = get_hybrid_parallel_config(dst_args, 2)
+    mesh2 = build_mesh(2, 1, devices=cpu_devices[:2])
+    out = R.reshard_params(params, src_plan, dst_plan, mesh2,
+                           axes_tree=axes)
+    _leaves_equal(out, params)
+
+    bad = get_hybrid_parallel_config(
+        _args(pp=1, tp=1, chunks=1).model_copy(
+            update={"model": cfg.model_copy(
+                update={"num_hidden_layers": 2})}), 2)
+    with pytest.raises(R.ReshardError):
+        R.reshard_params(params, src_plan, bad, mesh2, axes_tree=axes)
+
+
+def test_reshard_exact_across_engines(tmp_path, cpu_devices):
+    """The full matrix on real checkpoints: a host-pipeline (stages)
+    checkpoint reshards onto the 4-device SPMD plan and the compiled
+    (stacked) plan; a compiled checkpoint reshards onto the host plan.
+    Params AND adam moments are bit-equal to the source in every
+    direction, and each destination engine takes a live step on the
+    resharded state."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from hetu_galvatron_tpu.parallel.spmd import (
+        make_spmd_train_step,
+        shard_params,
+    )
+    from hetu_galvatron_tpu.runtime.compiled_pipeline import (
+        CompiledPipelineEngine,
+    )
+    from hetu_galvatron_tpu.runtime.mesh import build_mesh
+    from hetu_galvatron_tpu.runtime.optimizer import make_optimizer
+    from hetu_galvatron_tpu.runtime.pipeline import PipelineEngine
+
+    args = _args()
+    cfg = args.model
+    hpc8 = get_hybrid_parallel_config(args, 8)
+    tx = make_optimizer(args.train)
+    params, axes = init_causal_lm(jax.random.key(0), cfg)
+    data = np.random.RandomState(0).randint(
+        0, cfg.padded_vocab_size, (8, cfg.seq_length + 1))
+
+    # source A: host pipeline, 2 real steps, committed checkpoint
+    eng = PipelineEngine(cfg, hpc8, args.train, devices=cpu_devices,
+                        compute_dtype=jnp.float32)
+    sp = eng.split_params(params, axes)
+    so = eng.init_opt(sp, axes)
+    for _ in range(2):
+        sp, so, _ = eng.train_step(sp, so, make_batch(data))
+    truth = eng.merge_params(sp)
+    save_checkpoint(str(tmp_path / "host"), 2, sp, so, hpc=hpc8)
+    hd = str(tmp_path / "host" / "step_2")
+
+    canonical, copt, step, _ = R.load_checkpoint_canonical(
+        hd, tie_word_embeddings=cfg.tie_word_embeddings)
+    assert step == 2
+    _leaves_equal(canonical, truth)
+
+    # stages -> spmd on HALF the devices (the N -> N/2 shape)
+    args4 = _args(pp=1, tp=2, chunks=1)
+    hpc4 = get_hybrid_parallel_config(args4, 4)
+    mesh4 = build_mesh(4, 1, devices=cpu_devices[:4])
+    step_fn, pspecs, ospecs, bshd = make_spmd_train_step(
+        cfg, hpc4, mesh4, axes, tx, params, compute_dtype=jnp.float32,
+        donate=False)
+    sp4 = shard_params(params, pspecs, mesh4)
+    so4 = jax.jit(tx.init, out_shardings=jax.tree.map(
+        lambda s: NamedSharding(mesh4, s), ospecs,
+        is_leaf=lambda x: isinstance(x, PartitionSpec)))(sp4)
+    nsp, nso, st = R.resume_elastic(
+        hd, sp4, so4, tie_word_embeddings=cfg.tie_word_embeddings)
+    assert st == 2
+    _leaves_equal(nsp, canonical)
+    _leaves_equal(nso, copt)
+    b4 = jax.device_put(jax.tree.map(jnp.asarray, make_batch(data)), bshd)
+    _, _, m4 = step_fn(nsp, nso, b4)
+    assert np.isfinite(float(m4["loss"]))
+
+    # stages -> stacked (compiled engine, same 8-device plan)
+    ceng = CompiledPipelineEngine(cfg, hpc8, args.train,
+                                  devices=cpu_devices,
+                                  compute_dtype=jnp.float32, donate=False)
+    csp = ceng.split_params(params, axes)
+    cso = ceng.init_opt(csp, axes)
+    nsp2, nso2, _ = R.resume_elastic(
+        hd, csp, cso, tie_word_embeddings=cfg.tie_word_embeddings)
+    _leaves_equal(ceng.merge_params(nsp2), truth)
+    csp2, cso2, mc = ceng.train_step(nsp2, nso2, make_batch(data))
+    assert np.isfinite(float(mc["loss"]))
+
+    # source B: compiled (stacked) checkpoint -> host (stages) plan
+    save_checkpoint(str(tmp_path / "compiled"), 3, csp2, cso2, hpc=hpc8)
+    cd = str(tmp_path / "compiled" / "step_3")
+    sp_h = eng.split_params(params, axes)
+    so_h = eng.init_opt(sp_h, axes)
+    nsp3, nso3, _ = R.resume_elastic(
+        cd, sp_h, so_h, tie_word_embeddings=cfg.tie_word_embeddings)
+    _leaves_equal(eng.merge_params(nsp3), ceng.merge_params(csp2))
+    _, _, mh = eng.train_step(nsp3, nso3, make_batch(data))
+    assert np.isfinite(float(mh["loss"]))
+
+
+def test_resume_elastic_rejects_moe_opt_state(tmp_path):
+    with pytest.raises(R.ReshardError, match="MoE"):
+        R.resume_elastic(str(tmp_path), {}, {}, num_experts=4)

@@ -184,6 +184,52 @@ def test_restart_budget_resets_on_progress():
     assert rc2 == EXIT_CODE_CHECKPOINT_AND_EXIT and len(sleeps) == 2
 
 
+def test_world_change_resets_restart_budget():
+    """A topology-change restart is PROGRESS (the attempt will re-search
+    and reshard, not repeat the fault): when world_fn's value differs
+    between attempts the budget resets exactly as a committed checkpoint
+    would reset it — while a same-world exit loop still exhausts it."""
+    reg = MetricsRegistry()
+    seq = [EXIT_CODE_CHECKPOINT_AND_EXIT] * 6 + [0]
+    # the fleet shrinks every other attempt: 8 -> 8 -> 4 -> 4 -> 2 -> 2
+    worlds = iter([8, 4, 4, 2, 2, 1, 1, 1])
+
+    rc = run_with_restarts(
+        lambda: seq.pop(0), max_restarts=2,
+        world_fn=lambda: next(worlds),
+        sleep=lambda s: None, log=lambda m: None, registry=reg)
+    assert rc == 0 and not seq  # survived 6 exits on a budget of 2
+    assert reg.counter("supervisor/world_changes").value >= 2
+
+    # a STATIC world with the same exit sequence exhausts the budget
+    seq2 = [EXIT_CODE_CHECKPOINT_AND_EXIT] * 6 + [0]
+    rc2 = run_with_restarts(
+        lambda: seq2.pop(0), max_restarts=2,
+        world_fn=lambda: 8,
+        sleep=lambda s: None, log=lambda m: None,
+        registry=MetricsRegistry())
+    assert rc2 == EXIT_CODE_CHECKPOINT_AND_EXIT
+
+
+def test_reshard_failure_code_17_is_terminal_not_a_restart_loop():
+    """An OOM-rejected elastic target plan exits 17 (failed result
+    validation — it reproduces on every restart): the supervisor must
+    surface it immediately, even when the world just changed."""
+    calls = []
+
+    def attempt():
+        calls.append(1)
+        return EXIT_CODE_FAILED_ON_RESULT_VALIDATION
+
+    worlds = iter([8, 4, 4, 4])
+    rc = run_with_restarts(
+        attempt, max_restarts=5, world_fn=lambda: next(worlds),
+        sleep=lambda s: None, log=lambda m: None,
+        registry=MetricsRegistry())
+    assert rc == EXIT_CODE_FAILED_ON_RESULT_VALIDATION
+    assert len(calls) == 1  # no restart loop
+
+
 def test_crash_restarts_when_enabled():
     rc, sleeps, _ = _supervised([InjectedCrash("boom"), 0],
                                 max_restarts=2, restart_on_error=True)
