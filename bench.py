@@ -128,6 +128,30 @@ def run_leg(spec: dict, journal: str) -> int:
                      compiled_overlap_recompiles=out["compiled_recompiles"],
                      platform=out["platform"])
             return 0
+        if spec.get("kind") == "hier_dp":
+            # hierarchical-vs-flat dp gradient reduction A/B
+            # (tools/hier_dp_bench.py): lane-accumulated rs/ar/ag once per
+            # step vs GSPMD's in-scan flat all-reduce, same plans. Needs
+            # the 8-device virtual mesh on CPU, like the tp_overlap leg.
+            if spec["platform"] == "cpu":
+                os.environ["JAX_PLATFORMS"] = "cpu"
+                flag = "--xla_force_host_platform_device_count=8"
+                if "xla_force_host_platform_device_count" not in \
+                        os.environ.get("XLA_FLAGS", ""):
+                    os.environ["XLA_FLAGS"] = (
+                        os.environ.get("XLA_FLAGS", "") + " " + flag).strip()
+            sys.path.insert(0, os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), "tools"))
+            import hier_dp_bench
+
+            out = hier_dp_bench.run(on_tpu=spec["platform"] == "tpu")
+            if "skipped" in out:
+                emit("error", error=out["skipped"])
+            else:
+                emit("ok", hier_dp_vs_flat=out["hier_dp_vs_flat"],
+                     hier_dp_recompiles=out["hier_dp_recompiles"],
+                     hier_dp_legs=out["legs"], platform=out["platform"])
+            return 0
         if spec.get("kind") in ("serve_prefix", "spec_decode"):
             # serving A/B legs (tools/serve_bench.py): single-device tiny
             # engines — radix prefix cache hit-vs-cold TTFT ratio, and
@@ -678,6 +702,27 @@ def main() -> int:
             print(f"warning: compiled-overlap A/B leg failed: "
                   f"{res.get('error')}", file=sys.stderr)
 
+    # hierarchical dp reduction A/B (tools/hier_dp_bench.py): on by default
+    # on both platforms — the CPU ratio (once-per-step vs per-microbatch
+    # reduction schedule) is the committed bench_baseline.json entry.
+    # BENCH_HIER_DP=0 opts out.
+    hier_ab = None
+    if (not orch.wedged
+            and os.environ.get("BENCH_HIER_DP", "1") != "0"):
+        state["stage"] = "hier-dp"
+        res = orch.run({"kind": "hier_dp", "platform": platform,
+                        "seq": seq, "bsz": best["bsz"], "iters": iters,
+                        "flash": False, "fused_ce": False}, leg_budget)
+        if res["status"] == "ok":
+            hier_ab = {"hier_dp_vs_flat": res["hier_dp_vs_flat"],
+                       "hier_dp_recompiles": res["hier_dp_recompiles"]}
+            print(f"bench hier-dp A/B: hier_dp_vs_flat "
+                  f"{res['hier_dp_vs_flat']} (recompiles "
+                  f"{res['hier_dp_recompiles']})", file=sys.stderr)
+        else:
+            print(f"warning: hier-dp A/B leg failed: {res.get('error')}",
+                  file=sys.stderr)
+
     # serving A/B legs (tools/serve_bench.py run_prefix / run_spec): on by
     # default on both platforms — the CPU ratios are real (TTFT measures
     # actual prefill compute skipped; tokens/sec the actual verify cost)
@@ -718,6 +763,8 @@ def main() -> int:
         out.update(tp_ab)
     if co_ab:
         out.update(co_ab)
+    if hier_ab:
+        out.update(hier_ab)
     if serve_ab:
         out.update(serve_ab)
     if orch.abandoned:

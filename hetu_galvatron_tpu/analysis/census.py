@@ -249,9 +249,13 @@ def census_compiled_step(cfg: Any, hpc: Any, train: Any, *,
     return out
 
 
-def census_spmd_step(cfg: Any, hpc: Any, train: Any, mesh: Any,
-                     *, tp_overlap: bool = True) -> CensusResult:
-    """Trace the pp=1 SPMD train step (``parallel.spmd``) and census it."""
+def trace_spmd_step(cfg: Any, hpc: Any, train: Any, mesh: Any,
+                    *, tp_overlap: bool = True, hier_dp: bool = False,
+                    dcn_slices: int = 1):
+    """ClosedJaxpr of the pp=1 SPMD train step (``parallel.spmd``) —
+    tracing only, nothing executes. Shared by the count census and the
+    sharding-flow byte census; ``hier_dp`` traces the hierarchical dp
+    gradient-reduction variant (``ops/hier_reduce.py``)."""
     import jax
     import jax.numpy as jnp
 
@@ -263,13 +267,22 @@ def census_spmd_step(cfg: Any, hpc: Any, train: Any, mesh: Any,
     tx = make_optimizer(train)
     step, pspecs, ospecs, _ = make_spmd_train_step(
         cfg, hpc, mesh, axes, tx, params, compute_dtype=jnp.float32,
-        donate=True, tp_overlap=tp_overlap)
+        donate=True, tp_overlap=tp_overlap, hier_dp=hier_dp,
+        dcn_slices=dcn_slices)
     sp_shape = jax.tree.map(
         lambda p: jax.ShapeDtypeStruct(p.shape, p.dtype), params)
     so_shape = jax.eval_shape(tx.init, sp_shape)
     batch = _tiny_batch(cfg, hpc.global_bsz)
-    jaxpr = jax.make_jaxpr(step)(sp_shape, so_shape, batch)
-    return census_jaxpr(jaxpr)
+    return jax.make_jaxpr(step)(sp_shape, so_shape, batch)
+
+
+def census_spmd_step(cfg: Any, hpc: Any, train: Any, mesh: Any,
+                     *, tp_overlap: bool = True, hier_dp: bool = False,
+                     dcn_slices: int = 1) -> CensusResult:
+    """Trace the pp=1 SPMD train step (``parallel.spmd``) and census it."""
+    return census_jaxpr(trace_spmd_step(
+        cfg, hpc, train, mesh, tp_overlap=tp_overlap, hier_dp=hier_dp,
+        dcn_slices=dcn_slices))
 
 
 def trace_serving_programs(cfg: Any, *, mesh: Any = None, hpc: Any = None,

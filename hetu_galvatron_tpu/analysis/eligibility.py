@@ -204,6 +204,99 @@ def search_tp_overlap_expressible(tp: int, cp: int, enabled: bool) -> bool:
 
 
 # ---------------------------------------------------------------------------
+# hierarchical dp/sdp gradient reduction eligibility (ops/hier_reduce.py)
+# ---------------------------------------------------------------------------
+
+# shared reason strings (launcher logging + plan doctor + engine ctors)
+HIER_KERNEL_REASON = ("shard_map kernels (tp_overlap rings / flash / "
+                      "ring-cp / ulysses a2a) cannot nest under the "
+                      "hierarchical path's per-lane vmap")
+HIER_DROPOUT_REASON = ("dropout: per-lane rng streams would draw masks "
+                       "the flat path never draws (trajectories diverge "
+                       "beyond reduction reassociation)")
+
+
+def hier_dp_unsupported_reason(
+    *,
+    dp: int,
+    cp: int = 1,
+    ulysses: bool = False,
+    tp: int = 1,
+    tp_consecutive: bool = True,
+    uniform_strategies: bool = True,
+    model_type: str = "gpt",
+    num_experts: int = 0,
+    dropout: float = 0.0,
+    vtp: int = 1,
+    vcp: int = 1,
+) -> Optional[str]:
+    """None when the hierarchical dp gradient-reduction path can run this
+    plan; otherwise the reason the launcher logs before keeping the flat
+    GSPMD all-reduce. The same predicate gates the runtime engines, the
+    cost model's hierarchical dp term
+    (:func:`search_hier_dp_expressible`), and the count/byte predictions
+    (``telemetry.plan_collective_counts/bytes``)."""
+    if not uniform_strategies:
+        return ("heterogeneous per-layer strategies (one dp lane split "
+                "must cover every layer)")
+    if dp < 2:
+        return "dp == 1 (no data-parallel gradient ring to decompose)"
+    if ulysses:
+        return ("ulysses layer: gradients are partial over the "
+                "sequence-parallel axis too, which the dp lane split does "
+                "not model")
+    if cp > 1:
+        return ("cp layer: gradients are partial over the cp ring too, "
+                "which the dp lane split does not model")
+    if not tp_consecutive:
+        return ("non-consecutive tp: the dp axes are not a contiguous "
+                "leading mesh run, so they cannot regroup into "
+                "slice x host sub-axes")
+    if model_type == "t5":
+        return "t5 encoder-decoder stacks keep the flat GSPMD reduction"
+    if num_experts:
+        return ("MoE layers: expert grads ride the ep/edp axes, not the "
+                "plain dp lane split")
+    if dropout > 0.0:
+        return HIER_DROPOUT_REASON
+    if vtp * vcp > tp * cp:
+        return (f"vocab tp/cp degree {vtp * vcp} exceeds the layer "
+                f"tp*cp {tp * cp}: the vocab weight axes would overlap "
+                "the dp lane axes")
+    return None
+
+
+def plan_hier_dp_reason(cfg: Any, hpc: Any) -> Optional[str]:
+    """Plan-level adapter: (ModelArgs, HybridParallelConfig) -> reason
+    (None = the hierarchical path can run). Kernel nesting (tp_overlap /
+    flash / ring) is a runtime dispatch property checked by the engines —
+    this is the pure plan-shape half."""
+    s = hpc.layers[0]
+    return hier_dp_unsupported_reason(
+        dp=s.dp_size,
+        cp=s.cp_size,
+        ulysses=s.sp,
+        tp=s.tp_size,
+        tp_consecutive=s.tp_consecutive,
+        uniform_strategies=all(l == s for l in hpc.layers),
+        model_type=cfg.model_type,
+        num_experts=cfg.num_experts,
+        dropout=max(cfg.hidden_dropout, cfg.attention_dropout),
+        vtp=hpc.vocab.vtp,
+        vcp=hpc.vocab.vcp,
+    )
+
+
+def search_hier_dp_expressible(s: Any, enabled: bool) -> bool:
+    """Cost-model adapter (``cost_model.cost``): can this candidate layer
+    earn the hierarchical dp pricing? The degree-level half of
+    :func:`hier_dp_unsupported_reason` — dp > 1, Megatron-TP only (no
+    cp/Ulysses); the model-level gates (t5/MoE/dropout/vocab overlap) are
+    resolved by the runtime and the plan doctor."""
+    return bool(enabled) and s.dp > 1 and s.cp == 1 and s.sp == 1
+
+
+# ---------------------------------------------------------------------------
 # plan structure (divisibility / stage sums / axis products)
 # ---------------------------------------------------------------------------
 

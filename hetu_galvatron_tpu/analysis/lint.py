@@ -11,7 +11,8 @@ Six rules encode repo invariants that no off-the-shelf linter knows:
   ``for``/``while`` body: a recompile (or retrace) hazard when the loop is
   a step loop. Init-time loops are baselined with a justification.
 * **GAL003 mesh-axis canon** — mesh axis-name string literals outside the
-  ``runtime/mesh.py`` canon (``pp`` and the binary ``d0..dk``) in
+  ``runtime/mesh.py`` canon (``pp``, the binary ``d0..dk``, and the
+  hierarchical dp reduction's ``slice``/``host`` sub-axes) in
   collective/PartitionSpec positions: a typo'd axis name fails at trace
   time with an opaque error, or silently shards nothing.
 * **GAL004 dynamic named_scope** — f-strings/computed names in
@@ -62,8 +63,11 @@ HOT_PATH_MODULES = (
     "observability/recorder.py",
 )
 
-# mesh axis-name canon (runtime/mesh.py build_mesh): 'pp' + binary d-axes
-_AXIS_CANON = re.compile(r"^(pp|d\d+)$")
+# mesh axis-name canon (runtime/mesh.py): 'pp' + binary d-axes, plus the
+# hierarchical dp reduction's slice/host sub-axes (mesh.hier_submesh /
+# HIER_SLICE_AXIS / HIER_HOST_AXIS) — any other hand-rolled axis literal
+# in the hierarchical path (or anywhere else) is a finding
+_AXIS_CANON = re.compile(r"^(pp|d\d+|host|slice)$")
 
 # modules where GAL006 permits ambient-environment reads: the schema is
 # where config is DEFINED, and cli/ is the process boundary that feeds it

@@ -250,6 +250,13 @@ def reshard_findings(jaxpr: Any, *, program: str,
         name = eqn.primitive.name
         where = f"{_path}eqn {i} ({name})"
         if name == "all_gather":
+            # the hierarchical dp reduction's gather-back is DELIBERATE
+            # re-materialization (the summed grads return to the params'
+            # layout); its named_scope marker exempts it — anything else
+            # weight-sized is still a finding
+            stack = str(getattr(eqn.source_info, "name_stack", ""))
+            if "hier_dp_ag" in stack:
+                continue
             out_mb = sum(_aval_mb(v) for v in eqn.outvars)
             if out_mb >= gather_mb:
                 aval = getattr(eqn.outvars[0], "aval", None)
@@ -364,6 +371,25 @@ def flow_compiled_step(cfg: Any, hpc: Any, train: Any, *,
         donation=donation_report(jaxpr),
         reshard_problems=reshard_findings(
             jaxpr, program="compiled_step", gather_mb=gather_mb))
+
+
+def flow_spmd_step(cfg: Any, hpc: Any, train: Any, mesh: Any, *,
+                   tp_overlap: bool = True, hier_dp: bool = False,
+                   dcn_slices: int = 1,
+                   gather_mb: float = 1.0) -> ProgramFlow:
+    """Trace the pp=1 SPMD train step (``census.trace_spmd_step``) and run
+    the full byte-side analysis — the hook the hierarchical-dp drill uses
+    to cross-check the reduce-scatter/all-reduce/all-gather payloads
+    against ``plan_collective_bytes`` exactly."""
+    from hetu_galvatron_tpu.analysis.census import trace_spmd_step
+
+    jaxpr = trace_spmd_step(cfg, hpc, train, mesh, tp_overlap=tp_overlap,
+                            hier_dp=hier_dp, dcn_slices=dcn_slices)
+    return ProgramFlow(
+        name="spmd_step", flow=flow_jaxpr(jaxpr),
+        donation=donation_report(jaxpr),
+        reshard_problems=reshard_findings(
+            jaxpr, program="spmd_step", gather_mb=gather_mb))
 
 
 def flow_serving_programs(cfg: Any, *, mesh: Any = None, hpc: Any = None,

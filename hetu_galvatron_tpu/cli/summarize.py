@@ -258,12 +258,21 @@ def summarize_hardware(cfg: Dict[str, Any], path: str, out=None
     """Render a hardware bandwidth JSON: per (group size, consecutiveness)
     the measured bandwidth and, when the profiler fitted them
     (``profile_alpha_beta``), the α (latency ms) / β (MB/ms) pair — the
-    latency-aware collective model the search engine prices TP with."""
+    latency-aware collective model the search engine prices TP with.
+    Per-algorithm/per-level pairs (``profile_alpha_beta_algos``: ring and
+    halving-doubling schedules on ICI and the DCN proxy) render as extra
+    ``α/β`` columns, "—" where a curve was not fitted."""
     out = out or sys.stdout
     w = lambda s="": print(s, file=out)
     w(f"== hardware profile: {path} ==")
-    w(f"{'group':<14}{'bw MB/ms':>10}{'alpha ms':>12}{'beta MB/ms':>12}")
-    headline: Dict[str, Any] = {"groups": 0, "alpha_beta_groups": 0}
+    algo_cols = ("ring_ici", "tree_ici", "ring_dcn", "tree_dcn")
+    has_algos = any("_alg_" in k for k in cfg)
+    header = f"{'group':<14}{'bw MB/ms':>10}{'alpha ms':>12}{'beta MB/ms':>12}"
+    if has_algos:
+        header += "".join(f"{c:>18}" for c in algo_cols)
+    w(header)
+    headline: Dict[str, Any] = {"groups": 0, "alpha_beta_groups": 0,
+                                "algo_groups": 0}
     for key in sorted(cfg):
         if not (key.startswith("allreduce_size_")
                 and key.split("_")[-1] in ("0", "1")):
@@ -276,13 +285,33 @@ def summarize_hardware(cfg: Dict[str, Any], path: str, out=None
         headline["groups"] += 1
         if alpha is not None and beta is not None:
             headline["alpha_beta_groups"] += 1
-            w(f"{label:<14}{_fmt(cfg[key]):>10}{_fmt(alpha):>12}"
-              f"{_fmt(beta):>12}")
+            line = (f"{label:<14}{_fmt(cfg[key]):>10}{_fmt(alpha):>12}"
+                    f"{_fmt(beta):>12}")
         else:
-            w(f"{label:<14}{_fmt(cfg[key]):>10}{'-':>12}{'-':>12}")
+            line = f"{label:<14}{_fmt(cfg[key]):>10}{'-':>12}{'-':>12}"
+        if has_algos:
+            row_has_algo = False
+            for col in algo_cols:
+                alg, lvl = col.split("_")
+                a = cfg.get(f"allreduce_size_{n}_consec_{c}_alg_{alg}"
+                            f"_lvl_{lvl}_alpha_ms")
+                b = cfg.get(f"allreduce_size_{n}_consec_{c}_alg_{alg}"
+                            f"_lvl_{lvl}_beta_mb_per_ms")
+                if a is not None and b is not None:
+                    row_has_algo = True
+                    line += f"{_fmt(a) + '/' + _fmt(b):>18}"
+                else:
+                    line += f"{'—':>18}"
+            if row_has_algo:
+                headline["algo_groups"] += 1
+        w(line)
     if not headline["alpha_beta_groups"]:
         w("(no fitted alpha/beta keys: legacy bandwidth-only profile — "
           "the cost model uses the measured latency tables)")
+    if has_algos:
+        w("(per-algorithm columns are alpha/beta of the fitted "
+          "ring/halving-doubling schedules per level; the cost model "
+          "prices each collective as the min over available curves)")
     return headline
 
 

@@ -223,6 +223,38 @@ def train(args) -> Dict[str, Any]:
                       "running the GSPMD path")
             tp_overlap_on = False
 
+    # hierarchical dp/sdp gradient reduction (parallel.hier_dp or the
+    # plan's "hier_dp": 1 key, ops/hier_reduce.py): resolve eligibility
+    # once, log the fallback reason, remember the slice/host split
+    hier_dp_on = bool(args.parallel.hier_dp or hpc.hier_dp)
+    if hier_dp_on:
+        from hetu_galvatron_tpu.analysis.eligibility import (
+            HIER_KERNEL_REASON,
+            plan_hier_dp_reason,
+        )
+
+        hier_reason = plan_hier_dp_reason(cfg, hpc)
+        if hier_reason is None and tp_overlap_on:
+            hier_reason = HIER_KERNEL_REASON
+        if hier_reason is None and cfg.use_flash_attn and all(
+                d.platform == "tpu" for d in state.devices[:1]):
+            hier_reason = HIER_KERNEL_REASON
+        if hier_reason is None and cfg.use_fused_ce and world > 1:
+            hier_reason = HIER_KERNEL_REASON  # vocab-parallel CE shard_map
+        if hier_reason is not None:
+            state.log("hier_dp: falling back to the flat GSPMD gradient "
+                      f"all-reduce ({hier_reason})")
+            hier_dp_on = False
+        else:
+            from hetu_galvatron_tpu.runtime.mesh import hier_cross_degree
+
+            _dp = hpc.layers[0].dp_size
+            _cross = hier_cross_degree(hpc.pp_deg, _dp,
+                                       args.parallel.dcn_slices)
+            state.log("hier_dp: hierarchical gradient reduction on "
+                      f"(dp {_dp} = {_cross} slice x {_dp // _cross} host;"
+                      " rs-intra / ar-cross / ag-intra, once per step)")
+
     def finish_tp_overlap_setup(step_fn):
         """Once the engine choice has settled: emit the coverage gauge and
         wrap the step in the ``tp/overlap_step`` span."""
@@ -653,13 +685,15 @@ def train(args) -> Dict[str, Any]:
                     from hetu_galvatron_tpu.observability.trace_analysis \
                         import analyze_and_audit
 
-                    ab = None
+                    ab = ab_algos = None
                     if args.observability.audit_hardware_config:
                         from hetu_galvatron_tpu.core.search_engine.profiles \
-                            import read_alpha_beta
+                            import read_alpha_beta, read_alpha_beta_algos
 
                         try:
                             ab = read_alpha_beta(
+                                args.observability.audit_hardware_config)
+                            ab_algos = read_alpha_beta_algos(
                                 args.observability.audit_hardware_config)
                         except Exception as e:  # noqa: BLE001
                             state.log(f"warning: audit_hardware_config "
@@ -673,9 +707,11 @@ def train(args) -> Dict[str, Any]:
                     table = analyze_and_audit(
                         args.profile.trace_dir, hpc, cfg,
                         registry=telemetry.registry, alpha_beta=ab,
+                        alpha_beta_algos=ab_algos,
                         mixed_precision=(
                             args.parallel.mixed_precision != "fp32"),
-                        predicted_layer_s=pred_s)
+                        predicted_layer_s=pred_s,
+                        dcn_slices=args.parallel.dcn_slices)
                     if table:
                         state.log(
                             f"plan audit: {len(table['rows'])} components "
@@ -719,7 +755,8 @@ def train(args) -> Dict[str, Any]:
                     compute_dtype=compute_dtype,
                     dcn_slices=args.parallel.dcn_slices,
                     donate=not rerun.enabled,
-                    tp_overlap=tp_overlap_on)
+                    tp_overlap=tp_overlap_on,
+                    hier_dp=hier_dp_on)
                 if tp_overlap_on and not eng.tp_overlap:
                     state.log("tp_overlap: no eligible layer under the "
                               f"compiled schedule ({eng.overlap_reason}); "
@@ -734,7 +771,8 @@ def train(args) -> Dict[str, Any]:
             eng = PipelineEngine(cfg, hpc, args.train, devices=state.devices,
                                  compute_dtype=compute_dtype,
                                  dcn_slices=args.parallel.dcn_slices,
-                                 tp_overlap=tp_overlap_on)
+                                 tp_overlap=tp_overlap_on,
+                                 hier_dp=hier_dp_on)
         sp = eng.split_params(params, axes)
         so = eng.init_opt(sp, axes)
         sp, so, start_iter = maybe_resume(sp, so)
@@ -754,7 +792,8 @@ def train(args) -> Dict[str, Any]:
         # rerun machine will never re-call the step on pre-update buffers
         step, pspecs, ospecs, batch_shd = make_spmd_train_step(
             cfg, hpc, mesh, axes, tx, params, compute_dtype=compute_dtype,
-            donate=not rerun.enabled, tp_overlap=tp_overlap_on)
+            donate=not rerun.enabled, tp_overlap=tp_overlap_on,
+            hier_dp=hier_dp_on, dcn_slices=args.parallel.dcn_slices)
         nshd = jax.tree.map(
             lambda s: NamedSharding(mesh, s), ospecs,
             is_leaf=lambda x: isinstance(x, PartitionSpec))
@@ -771,7 +810,8 @@ def train(args) -> Dict[str, Any]:
                     cfg, hpc, mesh, axes, tx, params,
                     compute_dtype=compute_dtype,
                     donate=not rerun.enabled, chunks=ch,
-                    tp_overlap=tp_overlap_on)[0]
+                    tp_overlap=tp_overlap_on, hier_dp=hier_dp_on,
+                    dcn_slices=args.parallel.dcn_slices)[0]
             return step_cache[ch]
 
         def spmd_step(sp, so, raw):

@@ -185,6 +185,17 @@ class ParallelArgs(BaseModel):
     # DCN topology: number of ICI slices (pods) the job spans; >1 arranges
     # the mesh so pp + outer dp axes cross DCN and tp/cp stay ICI-local
     dcn_slices: int = 1
+    # hierarchical dp/sdp gradient reduction (ops/hier_reduce.py): swap the
+    # flat GSPMD dp grad all-reduce for the explicit two-level schedule —
+    # reduce-scatter intra-host at full volume, all-reduce across slices on
+    # the 1/k shard, all-gather back — with the slice/host split derived
+    # from dcn_slices (pp-first absorption). Per-dp-lane grads accumulate
+    # reduction-free through the microbatch scan, so the dp traffic is paid
+    # ONCE per step instead of once per microbatch. Ineligible plans
+    # (cp/ulysses/MoE/t5/dropout/non-uniform; shard_map kernels under the
+    # lane vmap) fall back to the flat path with a logged reason. A
+    # searched plan may also carry "hier_dp": 1 (either source enables it)
+    hier_dp: bool = False
 
     @model_validator(mode="after")
     def _check(self):
@@ -548,6 +559,15 @@ class SearchArgs(BaseModel):
     # and falls back to the legacy latency tables otherwise, so legacy
     # profiles reproduce golden costs exactly.
     tp_overlap: int = 0
+    # Hierarchical dp gradient-reduction pricing (ops/hier_reduce.py + the
+    # per-algorithm/per-level α-β curves): 1 prices eligible candidates'
+    # dp term as min(flat overlapped ring, hierarchical rs-intra +
+    # ar-cross-on-shard + ag-intra) using the per-level fitted curves
+    # (hardware_profiler.profile_alpha_beta_algos). Without per-level
+    # curves in the bandwidth JSON the hierarchical term is unavailable
+    # and every golden cost stays byte-identical. The winning plan records
+    # "hier_dp": 1 when the hierarchical term priced its dp reduction.
+    hier_dp: int = 0
 
 
 class ModelProfileArgs(BaseModel):
@@ -588,6 +608,13 @@ class HardwareProfileArgs(BaseModel):
     # (profile_sp_time 'sub_' keys + profile_alpha_beta); layer-wise TP
     # messages live in this regime, where the α term dominates
     sub_mb_floor_kb: int = 64
+    # per-algorithm / per-level fits (profile_alpha_beta_algos): benchmark
+    # ring vs recursive halving-doubling shaped schedules over ICI and
+    # DCN-proxy groups and fit distinct (α, β) pairs per
+    # (size, algorithm, level) — the cost model then prices each
+    # collective as the min over available curves. 0 skips the sweep
+    # (legacy-sized profiling runs)
+    profile_algos: int = 1
     warmup_iters: int = 5
     profile_iters: int = 20
     avg_or_min_or_first: Literal["avg", "min", "first"] = "avg"

@@ -30,6 +30,7 @@ import numpy as np
 
 from hetu_galvatron_tpu.analysis.eligibility import (
     search_compiled_expressible,
+    search_hier_dp_expressible,
     search_tp_overlap_expressible,
 )
 from hetu_galvatron_tpu.utils.strategy import DPType
@@ -110,6 +111,28 @@ class CostContext:
     # layers only (tp > 1, no cp, not under the compiled pipeline engine).
     tp_alpha_beta: Dict[str, Tuple[float, float]] = field(default_factory=dict)
     tp_overlap: bool = False
+    # per-algorithm, per-LEVEL collective curves (beyond the single fitted
+    # curve): "{size}_{consec}" -> {"{ring|tree}_{ici|dcn}": (α ms,
+    # β MB/ms)}, fitted by hardware_profiler.profile_alpha_beta_algos over
+    # algorithm-SHAPED schedules (ring reduce-scatter/all-gather vs
+    # recursive halving-doubling) on intra-host/ICI vs cross-slice/DCN
+    # groups. A collective is priced as the MIN over the curves available
+    # at its size and level — "Revisiting the Time Cost Model of
+    # AllReduce": ring and tree have materially different (α, β) regimes,
+    # and the win comes from CHOOSING per collective ("The Big Send-off").
+    # Empty dict (legacy profiles) keeps every golden cost byte-identical.
+    alpha_beta_algos: Dict[str, Dict[str, Tuple[float, float]]] = field(
+        default_factory=dict)
+    # hierarchical dp gradient reduction pricing (search.hier_dp +
+    # ops/hier_reduce.py): when the per-level curves are available, an
+    # eligible layer's dp term may be priced as reduce-scatter intra-host
+    # at full grad volume + all-reduce across slices on the 1/intra shard
+    # + all-gather back (un-overlapped — the runtime reduces once at step
+    # end), and the layer cost takes min(flat, hierarchical). dcn_slices
+    # (the search maps num_nodes onto it) fixes the slice/host split with
+    # the same pp-first absorption as mesh.dcn_factor_shape.
+    hier_dp: bool = False
+    dcn_slices: int = 1
 
 
 def _zero_ratios(chunks: int, mixed_precision: bool, async_grad_reduce: bool):
@@ -164,19 +187,94 @@ def _overlap_window(comm: float, comp: float, coe: float) -> float:
     return comm_ov
 
 
+def _algo_min_ms(ctx: CostContext, size: int, consec: int, level: str,
+                 message_mb: float) -> Optional[float]:
+    """Cheapest ALLREDUCE time at ``message_mb`` over the per-algorithm
+    curves fitted for group ``(size, consec)`` at the given topology
+    ``level`` (``ici`` | ``dcn``); None when no curve covers it. This is
+    where the algorithm CHOICE happens: small messages ride the
+    latency-optimal halving-doubling curve, large ones the
+    bandwidth-optimal ring, per collective and per size."""
+    table = ctx.alpha_beta_algos.get(f"{size}_{consec}")
+    if not table:
+        return None
+    best = None
+    suffix = f"_{level}"
+    for key, (alpha, beta) in table.items():
+        if not key.endswith(suffix):
+            continue
+        t = alpha + message_mb / beta
+        if best is None or t < best:
+            best = t
+    return best
+
+
 def _tp_message_ms(s: "SearchStrategy", ctx: CostContext,
                    message_mb: float) -> float:
     """One Megatron-SP ag/rs-equivalent collective of ``message_mb`` MB:
-    the fitted α-β model when the profile carries it (half the allreduce
-    curve, matching profiles.remap_collective_latency's allgather
-    derivation), else the legacy measured-table lookup. Only called with
+    the cheapest of the fitted curves when the profile carries them — the
+    flat α-β pair AND the per-algorithm ICI curves, each at half the
+    allreduce time (matching profiles.remap_collective_latency's allgather
+    derivation) — else the legacy measured-table lookup. Only called with
     s.tp > 1; tp groups are consecutive (the same assumption the legacy
-    dc_key encodes), so the "{n}_1" pair applies."""
+    dc_key encodes), so the "{n}_1" pair applies and the level is ici."""
+    candidates = []
     ab = ctx.tp_alpha_beta.get(f"{s.tp}_1")
     if ab is not None:
         alpha, beta = ab
-        return 0.5 * (alpha + message_mb / beta)
+        candidates.append(alpha + message_mb / beta)
+    algo = _algo_min_ms(ctx, s.tp, 1, "ici", message_mb)
+    if algo is not None:
+        candidates.append(algo)
+    if candidates:
+        return 0.5 * min(candidates)
     return _lookup_latency(ctx.allgather_latency[s.tp], message_mb)
+
+
+def _hier_dp_split(s: "SearchStrategy", ctx: CostContext
+                   ) -> Optional[Tuple[int, int]]:
+    """(cross, intra) split of the layer's sdp group, mirroring
+    ``mesh.hier_cross_degree``'s pp-first slice absorption; None when the
+    leftover slices cannot divide sdp (the runtime would reject too)."""
+    import math as _math
+
+    dcn = max(ctx.dcn_slices, 1)
+    left = dcn // _math.gcd(dcn, max(s.pp, 1))
+    if s.sdp % left:
+        return None
+    return left, s.sdp // left
+
+
+def hier_dp_reduce_ms(s: "SearchStrategy", ctx: CostContext,
+                      grad_mb: float) -> Optional[float]:
+    """Hierarchical dp gradient-reduction time for ``grad_mb`` (the
+    per-device grad volume): rs-intra at full volume + ar-cross on the
+    1/intra shard + ag-intra back, each priced off the per-level algorithm
+    curves (rs/ag at half the allreduce curve, the repo-wide convention).
+    None when ineligible or any needed curve is missing — the caller then
+    keeps the flat pricing, so legacy profiles stay byte-identical."""
+    if not search_hier_dp_expressible(s, ctx.hier_dp):
+        return None
+    split = _hier_dp_split(s, ctx)
+    if split is None:
+        return None
+    cross, intra = split
+    total = 0.0
+    if intra > 1:
+        rs = _algo_min_ms(ctx, intra, 1, "ici", grad_mb)
+        if rs is None:
+            return None
+        total += rs  # 0.5 rs + 0.5 ag of the same curve
+    if cross > 1:
+        ar = _algo_min_ms(ctx, cross, 0, "dcn", grad_mb / intra)
+        if ar is None:
+            ar = _algo_min_ms(ctx, cross, 1, "dcn", grad_mb / intra)
+        if ar is None:
+            return None
+        total += ar
+    if intra == 1 and cross == 1:
+        return None
+    return total
 
 
 def _tp_terms(s: "SearchStrategy", ctx: CostContext, gbsz: int, chunks: int
@@ -280,6 +378,13 @@ def layer_time_cost(
     # comm against the backward keep only the forward window free.
     overlap_tp = tp_overlap_expressible(s, ctx) and tp_time > 0
 
+    # hierarchical dp alternative (hier_dp_reduce_ms): the full per-device
+    # grad volume reduced ONCE at step end (un-overlapped — the runtime's
+    # lane accumulation defers the reduction out of the backward), priced
+    # per level off the algorithm curves; None keeps flat-only pricing
+    hier_grad_mb = param_mb * n * (0.5 if ctx.mixed_precision else 1.0)
+    hier_ms = hier_dp_reduce_ms(s, ctx, hier_grad_mb)
+
     def tp_term(window: float) -> float:
         """Exposed TP comm time beyond the compute window it hides under."""
         if not overlap_tp:
@@ -298,6 +403,19 @@ def layer_time_cost(
         else:
             ov, rest = overlap(dp_message * factor)
             r = fct + ov + rest + tp_term(fct) + ctx.extra_overhead
+        if factor and hier_ms is not None:
+            # hierarchical dp candidate: backward runs un-overlapped (the
+            # reduction happens once after accumulation), dp comm is the
+            # three-level schedule; the layer takes whichever is cheaper
+            if s.tp_sp == 1 and s.dp > 1:
+                r_h = fct + bct + hier_ms + ctx.extra_overhead
+            elif s.dp > 1 and s.tp_sp > 1:
+                r_h = (fct + bct + tp_term(fct) + hier_ms
+                       + ctx.extra_overhead)
+            else:
+                r_h = None
+            if r_h is not None:
+                r = min(r, r_h)
         if s.dp_type == DPType.ZERO3:
             r += fsdp_allgather * dc
         if s.pp > 1 and p2p_coe is not None:
@@ -306,6 +424,22 @@ def layer_time_cost(
         return r * 0.001 * ctx.costmodel_coe / n
 
     return result(False), result(True)
+
+
+def hier_dp_wins(s: "SearchStrategy", ctx: CostContext, gbsz: int,
+                 chunks: int) -> bool:
+    """Did the hierarchical dp term price this layer's chosen cost (i.e.
+    enabling ``ctx.hier_dp`` strictly lowered the with-sync layer cost)?
+    The search engine records ``"hier_dp": 1`` in the winning plan when
+    every layer says yes, so the runtime enables the matching execution
+    path."""
+    if not search_hier_dp_expressible(s, ctx.hier_dp):
+        return False
+    from dataclasses import replace as _replace
+
+    off = _replace(ctx, hier_dp=False)
+    return (layer_time_cost(s, ctx, gbsz, chunks)[0]
+            < layer_time_cost(s, off, gbsz, chunks)[0])
 
 
 def tp_overlap_hidden_frac(s: "SearchStrategy", ctx: CostContext,
@@ -353,6 +487,11 @@ def layer_time_components(s: "SearchStrategy", ctx: CostContext,
     # charging dp_message here would invent a component the search never
     # priced, and total_ms must reconcile with layer_time_cost
     dp_time = dp_message * ctx.comm_coe_dict[dc_key] if s.dp > 1 else 0.0
+    if s.dp > 1 and hier_dp_wins(s, ctx, gbsz, chunks):
+        # the chosen price was the hierarchical schedule: the audit must
+        # compare measured dp time against THAT decomposition
+        dp_time = hier_dp_reduce_ms(
+            s, ctx, param_mb * n * (0.5 if ctx.mixed_precision else 1.0))
     if s.dp_type == DPType.ZERO3 and s.sdp > 1:
         dp_time += dp_message * 0.5 * ctx.comm_coe_dict[dc_key]
 

@@ -21,8 +21,9 @@ from __future__ import annotations
 
 import os
 import time
+import warnings
 from functools import partial
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -37,9 +38,11 @@ from hetu_galvatron_tpu.core.search_engine.profiles import write_json
 def _time_fn(fn, arg, *, warmup: int, iters: int, inner: int = 1) -> float:
     """Median wall-clock ms of fn(arg) (reference uses trimmed means over 20
     x10-iter samples, profile_allreduce.py:14-17,129-133)."""
+    out = None
     for _ in range(warmup):
         out = fn(arg)
-    jax.block_until_ready(out)
+    if out is not None:
+        jax.block_until_ready(out)
     samples = []
     for _ in range(iters):
         t0 = time.perf_counter()
@@ -48,6 +51,36 @@ def _time_fn(fn, arg, *, warmup: int, iters: int, inner: int = 1) -> float:
         jax.block_until_ready(out)
         samples.append((time.perf_counter() - t0) / inner * 1000.0)
     return float(np.median(samples))
+
+
+# the profiler's private single-axis benchmark mesh name (the legacy
+# literal uses are baselined in analysis/lint_baseline.json; new code
+# routes through this constant so GAL003 stays at zero new findings)
+_G_AXIS = "g"
+
+# slope floor for the α-β fit (ms per MB): measurement noise on sub-MB
+# points can tilt the fitted line flat or NEGATIVE, and 1/slope would then
+# be a nonsense β (infinite-or-negative bandwidth). Below the floor the
+# fit is rejected and the legacy single-point bandwidth stays the model.
+_MIN_SLOPE_MS_PER_MB = 1e-7
+
+
+def fit_alpha_beta(xs: Sequence[float], ys: Sequence[float], *,
+                   label: str = "") -> Optional[Tuple[float, float]]:
+    """Least-squares ``t(size) = α + size/β`` fit over (MB, ms) points.
+    Returns (α ms ≥ 0, β MB/ms) — or None with a warning when the slope is
+    degenerate (≤ :data:`_MIN_SLOPE_MS_PER_MB`): writing a garbage pair
+    would poison every cost the search prices with it, while an ABSENT
+    pair falls back to the measured latency tables."""
+    slope, alpha = np.polyfit(list(xs), list(ys), 1)
+    if float(slope) <= _MIN_SLOPE_MS_PER_MB:
+        warnings.warn(
+            f"alpha-beta fit {label or '<unnamed>'}: degenerate slope "
+            f"{float(slope):.3e} ms/MB (noisy sub-MB points?); skipping "
+            "the pair — the legacy single-point bandwidth stays in effect",
+            stacklevel=2)
+        return None
+    return max(float(alpha), 0.0), 1.0 / float(slope)
 
 
 def _group_devices(devices: Sequence, size: int, consecutive: bool,
@@ -221,13 +254,153 @@ class HardwareProfiler:
                         t = self._collective_ms("allreduce", group, mb)
                     xs.append(mb)
                     ys.append(t)
-                slope, alpha = np.polyfit(xs, ys, 1)
-                alpha = max(float(alpha), 0.0)
-                beta = 1.0 / max(float(slope), 1e-9)
+                pair = fit_alpha_beta(
+                    xs, ys,
+                    label=f"allreduce_size_{size}_consec_{consec}")
+                if pair is None:
+                    # degenerate slope: no pair is written, so the cost
+                    # model keeps pricing this (size, consec) off the
+                    # legacy single-point bandwidth / latency tables
+                    continue
+                alpha, beta = pair
                 out[f"allreduce_size_{size}_consec_{consec}_alpha_ms"] = \
                     round(alpha, 6)
                 out[f"allreduce_size_{size}_consec_{consec}_beta_mb_per_ms"] \
                     = round(beta, 3)
+            size //= 2
+        return out
+
+    # -- per-algorithm schedules (ring vs recursive halving-doubling) -------
+
+    def _algo_allreduce_ms(self, alg: str, group: List,
+                           message_mb: float) -> float:
+        """Time one all-reduce of ``message_mb`` MB/device over ``group``
+        running an EXPLICIT algorithm-shaped schedule instead of whatever
+        the runtime lowers psum to:
+
+        * ``ring`` — reduce-scatter then all-gather rings: 2(n-1) hops of
+          1/n-sized chunks (`lax.ppermute`), the bandwidth-optimal,
+          latency-poor shape.
+        * ``tree`` — recursive halving-doubling: log2(n) pairwise
+          exchange rounds with halving payloads then the doubling gather
+          back — 2·log2(n) hops, the latency-optimal shape for small
+          messages ("Revisiting the Time Cost Model of AllReduce").
+
+        The two schedules have materially different (α, β) regimes; the
+        fitted pairs let the cost model price each collective as the MIN
+        over algorithms at its message size and level."""
+        n = len(group)
+        if n < 2 or (n & (n - 1)):
+            raise ValueError(f"algorithm schedules need a power-of-two "
+                             f"group, got {n}")
+        mesh = Mesh(np.array(group), (_G_AXIS,))
+        elems = max(int(message_mb * 1024 * 1024 // 4), 2 * n)
+        elems = (elems // (2 * n)) * (2 * n)
+        x = jax.device_put(jnp.ones((elems,), jnp.float32),
+                           NamedSharding(mesh, P(None)))
+        from jax.experimental.shard_map import shard_map
+
+        if alg == "ring":
+            def body(v):
+                r = jax.lax.axis_index(_G_AXIS)
+                c = elems // n
+                chunks = v.reshape(n, c)
+                perm = [(i, (i + 1) % n) for i in range(n)]
+                # reduce-scatter ring: the accumulator for chunk k starts
+                # at rank (k+1)%n and collects each rank's share en route
+                acc = None
+                for t in range(n):
+                    k = (r - 1 - t) % n
+                    part = jnp.take(chunks, k, axis=0)
+                    acc = part if acc is None else (
+                        jax.lax.ppermute(acc, _G_AXIS, perm) + part)
+                # all-gather ring: rotate the owned chunk n-1 hops
+                out = jnp.zeros((n, c), jnp.float32)
+                cur = acc
+                for t in range(n):
+                    k = (r - t) % n
+                    out = jax.lax.dynamic_update_index_in_dim(
+                        out, cur, k, 0)
+                    if t < n - 1:
+                        cur = jax.lax.ppermute(cur, _G_AXIS, perm)
+                return out.reshape(-1)
+        elif alg == "tree":
+            rounds = n.bit_length() - 1
+
+            def body(v):
+                r = jax.lax.axis_index(_G_AXIS)
+                cur = v
+                # recursive halving reduce-scatter: round k exchanges
+                # half the live payload with the rank at distance 2^k
+                for k in range(rounds):
+                    perm = [(i, i ^ (1 << k)) for i in range(n)]
+                    half = cur.shape[0] // 2
+                    bit = (r >> k) & 1
+                    lo, hi = cur[:half], cur[half:]
+                    send = jnp.where(bit == 0, hi, lo)
+                    recv = jax.lax.ppermute(send, _G_AXIS, perm)
+                    cur = jnp.where(bit == 0, lo, hi) + recv
+                # recursive doubling all-gather: reverse rounds, payload
+                # doubling back to full size
+                for k in range(rounds - 1, -1, -1):
+                    perm = [(i, i ^ (1 << k)) for i in range(n)]
+                    bit = (r >> k) & 1
+                    recv = jax.lax.ppermute(cur, _G_AXIS, perm)
+                    cur = jnp.where(bit == 0,
+                                    jnp.concatenate([cur, recv]),
+                                    jnp.concatenate([recv, cur]))
+                return cur
+        else:
+            raise ValueError(f"unknown collective algorithm {alg!r} "
+                             "(ring | tree)")
+        fn = jax.jit(shard_map(body, mesh=mesh, in_specs=P(None),
+                               out_specs=P(None), check_rep=False))
+        return _time_fn(fn, x, warmup=self.args.warmup_iters,
+                        iters=self.args.profile_iters)
+
+    def profile_alpha_beta_algos(self) -> Dict[str, float]:
+        """Per-algorithm, per-LEVEL latency-bandwidth fits: for each group
+        size, each algorithm schedule (ring / tree) is benchmarked over an
+        intra-host/ICI group (adjacent devices, ``consec=1``, level
+        ``ici``) and a cross-slice/DCN proxy group (maximally strided,
+        ``consec=0``, level ``dcn`` — the grouping
+        ``mesh.dcn_factor_shape`` puts across slices), and the
+        ``t = α + size/β`` curve is fitted over the sub-MB + integer-MB
+        sweep. Emitted keys extend the flat :meth:`profile_alpha_beta`
+        namespace::
+
+            allreduce_size_{n}_consec_{c}_alg_{ring|tree}_lvl_{ici|dcn}_
+            alpha_ms / ..._beta_mb_per_ms
+
+        ``profiles.read_alpha_beta_algos`` parses them; the flat reader
+        and every legacy parser skip them. Degenerate fits are dropped
+        with a warning (:func:`fit_alpha_beta`), falling back per
+        (size, algorithm, level) to whatever coarser model remains."""
+        fit_sizes = self._sub_mb_sizes() + [float(self.args.start_mb),
+                                            float(self.args.start_mb * 2),
+                                            float(self.args.start_mb * 4)]
+        out: Dict[str, float] = {}
+        size = self.world
+        while size >= 2:
+            levels = [("ici", 1)]
+            if size < self.world:
+                levels.append(("dcn", 0))
+            for lvl, consec in levels:
+                group = _group_devices(self.devices, size, bool(consec),
+                                       self.world)
+                for alg in ("ring", "tree"):
+                    xs, ys = [], []
+                    for mb in fit_sizes:
+                        xs.append(mb)
+                        ys.append(self._algo_allreduce_ms(alg, group, mb))
+                    key = (f"allreduce_size_{size}_consec_{consec}"
+                           f"_alg_{alg}_lvl_{lvl}")
+                    pair = fit_alpha_beta(xs, ys, label=key)
+                    if pair is None:
+                        continue
+                    alpha, beta = pair
+                    out[f"{key}_alpha_ms"] = round(alpha, 6)
+                    out[f"{key}_beta_mb_per_ms"] = round(beta, 3)
             size //= 2
         return out
 
@@ -295,6 +468,10 @@ class HardwareProfiler:
         bandwidth = self.profile_allreduce_bandwidth()
         # α-β pairs ride the bandwidth JSON next to the legacy keys
         bandwidth.update(self.profile_alpha_beta(sp_times))
+        if a.profile_algos:
+            # per-algorithm / per-level pairs (ring vs halving-doubling,
+            # ICI vs DCN-proxy groups) extend the same namespace
+            bandwidth.update(self.profile_alpha_beta_algos())
         paths = {}
         for name, cfg in [
             (f"allreduce_bandwidth_{tag}.json", bandwidth),

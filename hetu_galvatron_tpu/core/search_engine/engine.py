@@ -212,6 +212,11 @@ class SearchEngine:
             schedule_impl=self.args.pipeline_schedule_impl,
             tp_alpha_beta=hw.alpha_beta,
             tp_overlap=bool(self.args.tp_overlap),
+            alpha_beta_algos=hw.alpha_beta_algos,
+            hier_dp=bool(self.args.hier_dp),
+            # the search's topology model: nodes are the cross-DCN level
+            # (mesh.dcn_factor_shape's slice granularity)
+            dcn_slices=max(self.args.num_nodes, 1),
         )
 
     # ---------------- outer loop ----------------
@@ -697,6 +702,22 @@ class SearchEngine:
                     best.strategy_list[li], ctx, best.bsz, best.chunks)
                 pred_ms.append(round(comp["fct_ms"] + comp["bct_ms"], 6))
                 li += 1
+        # record the hierarchical dp choice when the hierarchical term
+        # priced EVERY layer's dp reduction (cost.hier_dp_wins) — the
+        # runtime then enables the matching ops/hier_reduce.py path
+        hier_chosen = False
+        if self.args.hier_dp:
+            from hetu_galvatron_tpu.core.cost_model.cost import hier_dp_wins
+
+            li = 0
+            flags = []
+            for lt, n in enumerate(self.layernum_list):
+                for _ in range(n):
+                    flags.append(hier_dp_wins(
+                        best.strategy_list[li], self.contexts[lt],
+                        best.bsz, best.chunks))
+                    li += 1
+            hier_chosen = bool(flags) and all(flags)
         cfg = strategy_list2config(
             runtime, global_bsz=best.bsz, chunks=best.chunks,
             pipeline_type=self.pipeline_type,
@@ -706,7 +727,8 @@ class SearchEngine:
                 embed_sdp=bool(best.vocab_sdp)),
             pp_division=best.pp_stage_list,
             num_encoder_layers=getattr(self, "num_encoder_layers", None),
-            predicted_layer_compute_ms=pred_ms)
+            predicted_layer_compute_ms=pred_ms,
+            hier_dp=hier_chosen)
         a = self.args
         off = [name for flag, name in (
             (a.disable_dp, "dp"), (a.disable_tp, "tp"), (a.disable_pp, "pp"),

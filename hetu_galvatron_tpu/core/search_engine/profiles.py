@@ -96,11 +96,45 @@ def read_alpha_beta(config: Any) -> Dict[str, Tuple[float, float]]:
         if not (key.startswith("allreduce_size_")
                 and key.endswith("_alpha_ms")):
             continue
+        if "_alg_" in key:
+            # namespaced per-algorithm/per-level pairs
+            # (profile_alpha_beta_algos) — parsed by
+            # :func:`read_alpha_beta_algos`; pairing one of their alphas
+            # with the FLAT beta key here would corrupt the legacy table
+            continue
         parts = key.split("_")  # allreduce_size_{n}_consec_{c}_alpha_ms
         n, c = parts[2], parts[4]
         beta = env.get(f"allreduce_size_{n}_consec_{c}_beta_mb_per_ms")
         if beta:
             out[f"{n}_{c}"] = (float(val), float(beta))
+    return out
+
+
+def read_alpha_beta_algos(config: Any
+                          ) -> Dict[str, Dict[str, Tuple[float, float]]]:
+    """Per-algorithm, per-level fitted pairs from the allreduce-bandwidth
+    JSON: ``allreduce_size_{n}_consec_{c}_alg_{ring|tree}_lvl_{ici|dcn}_
+    alpha_ms`` / ``..._beta_mb_per_ms`` keys (written by
+    ``hardware_profiler.profile_alpha_beta_algos``) ->
+    ``{"{n}_{c}": {"{alg}_{lvl}": (α ms, β MB/ms)}}``. The cost model
+    prices a collective as the MIN over the curves available at its size
+    and level; profiles without the namespaced keys yield an empty dict
+    and every golden cost stays byte-identical."""
+    env = read_json(config) if isinstance(config, str) else config
+    out: Dict[str, Dict[str, Tuple[float, float]]] = {}
+    for key, val in env.items():
+        if not (key.startswith("allreduce_size_") and "_alg_" in key
+                and key.endswith("_alpha_ms")):
+            continue
+        # allreduce_size_{n}_consec_{c}_alg_{alg}_lvl_{lvl}_alpha_ms
+        parts = key.split("_")
+        n, c, alg, lvl = parts[2], parts[4], parts[6], parts[8]
+        beta = env.get(
+            f"allreduce_size_{n}_consec_{c}_alg_{alg}_lvl_{lvl}"
+            "_beta_mb_per_ms")
+        if beta:
+            out.setdefault(f"{n}_{c}", {})[f"{alg}_{lvl}"] = (
+                float(val), float(beta))
     return out
 
 
@@ -178,6 +212,10 @@ class HardwareProfile:
     all2all_latency: Dict[int, Dict[Any, float]]
     # fitted α-β pairs per "{size}_{consec}" (empty for legacy profiles)
     alpha_beta: Dict[str, Tuple[float, float]] = field(default_factory=dict)
+    # per-algorithm/per-level pairs: "{size}_{consec}" ->
+    # {"{ring|tree}_{ici|dcn}": (α, β)} (empty for legacy profiles)
+    alpha_beta_algos: Dict[str, Dict[str, Tuple[float, float]]] = field(
+        default_factory=dict)
 
 
 def load_hardware_profile(
@@ -192,6 +230,7 @@ def load_hardware_profile(
     get_profiled_hardware_configs, search_engine.py:419-462)."""
     bw, coe = read_allreduce_bandwidth(allreduce_path, world_size)
     alpha_beta = read_alpha_beta(allreduce_path)
+    alpha_beta_algos = read_alpha_beta_algos(allreduce_path)
     p2p_bw, p2p_coe = read_p2p_bandwidth(p2p_path)
     overlap = read_json(overlap_path)["overlap_coe"]
     sp = read_json(sp_time_path)
@@ -207,6 +246,7 @@ def load_hardware_profile(
         allgather_latency=remap_collective_latency(sp, "allgather"),
         all2all_latency=remap_collective_latency(sp, "all2all"),
         alpha_beta=alpha_beta,
+        alpha_beta_algos=alpha_beta_algos,
     )
 
 

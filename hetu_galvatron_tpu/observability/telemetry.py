@@ -310,12 +310,80 @@ def plan_comm_volume(
     return out
 
 
+def _hier_payload_elems_from_plan(hpc, model, *, cross: int
+                                  ) -> Tuple[int, int, int]:
+    """(local, padded, intra) per-device payload element counts of the
+    hierarchical dp reduction for a pp=1 plan — built from THE SAME spec
+    arithmetic the runtime reducer uses (``ops.hier_reduce``: eval-shaped
+    params, ``grad_reduce_specs``, ``hier_payload_elems``), so the byte
+    prediction cannot drift from the traced program."""
+    from types import SimpleNamespace
+
+    import jax
+
+    from hetu_galvatron_tpu.models.builder import init_causal_lm
+    from hetu_galvatron_tpu.ops.hier_reduce import (
+        grad_reduce_specs,
+        hier_payload_elems,
+    )
+    from hetu_galvatron_tpu.runtime.mesh import (
+        lower_strategy,
+        lower_vocab_strategy,
+    )
+
+    if hpc.pp_deg > 1:
+        raise ValueError("hier_dp payload prediction models pp=1 plans; "
+                         "pp>1 engines pass their stacked payload via "
+                         "the engine's reducer")
+    # shape-only mesh stand-in: the prediction needs axis NAMES and SIZES
+    # (lower_strategy / axes_size are shape arithmetic), never devices —
+    # a plan for 8 chips stays predictable on a 1-device analysis host
+    stage = hpc.world_size
+    k = stage.bit_length() - 1
+    if (1 << k) != stage:
+        raise ValueError(f"world {stage} is not a power of two")
+    mesh = SimpleNamespace(
+        axis_names=("pp",) + tuple(f"d{i}" for i in range(k)),
+        shape={"pp": 1, **{f"d{i}": 2 for i in range(k)}})
+    per_layer = [lower_strategy(s, mesh) for s in hpc.layers]
+    vocab = lower_vocab_strategy(hpc.vocab, mesh, hpc.default_dp_type)
+    # eval_shape the params (no arrays materialize); the logical-axes tree
+    # is static python built during the trace, captured via the closure
+    box = {}
+
+    def only_params(k):
+        p, a = init_causal_lm(k, model)
+        box["axes"] = a
+        return p
+
+    params_shapes = jax.eval_shape(only_params, jax.random.key(0))
+    axes_tree = box["axes"]
+    specs = grad_reduce_specs(axes_tree, per_layer, vocab)
+    dp_deg = max(hpc.layers[0].dp_size, 1)
+    if cross < 1 or dp_deg % cross:
+        raise ValueError(f"cross-slice degree {cross} does not divide the "
+                         f"dp degree {dp_deg}")
+    intra = dp_deg // cross
+    from jax.sharding import PartitionSpec as P
+
+    shape_leaves = [tuple(s.shape)
+                    for s in jax.tree_util.tree_leaves(params_shapes)]
+    spec_leaves = jax.tree_util.tree_leaves(
+        specs, is_leaf=lambda x: isinstance(x, P))
+    # the grad specs never mention the dp (lane) axes, so the flat
+    # shape-only view prices the per-device leaf sizes exactly
+    local, padded = hier_payload_elems(shape_leaves, spec_leaves, mesh,
+                                       intra)
+    return local, padded, intra
+
+
 def plan_collective_counts(
     hpc,
     model,
     *,
     num_microbatches: Optional[int] = None,
     tp_overlap: bool = True,
+    hier_dp: bool = False,
 ) -> Dict[str, int]:
     """Predicted EXECUTED explicit-collective counts for the compiled
     single-program 1F1B step — the count-side companion of
@@ -338,6 +406,13 @@ def plan_collective_counts(
     another 4-ring forward recompute under per-layer remat. Each ring is
     ``tp - 1`` ppermute hops. The stage rotations add 2 ppermutes per tick
     (activations forward, cotangents backward).
+
+    ``hier_dp=True`` adds the hierarchical dp gradient reduction's three
+    explicit collectives (``ops/hier_reduce.py``): the whole grad tree
+    flattens into ONE payload per step, so exactly one ``reduce_scatter``
+    (psum_scatter over the host sub-axis), one ``all_reduce`` (psum over
+    the slice sub-axis) and one ``all_gather`` — independent of the
+    microbatch count (lane accumulation is reduction-free in-scan).
 
     Raises ValueError for plan shapes the prediction does not model
     (non-uniform strategies, Ulysses/cp layers — the census still counts
@@ -363,6 +438,13 @@ def plan_collective_counts(
     if tp_overlap and tp > 1:
         rings_per_tick = 4 + 8 + (4 if s.checkpoint else 0)
         out["ppermute_tp"] = T * lps * rings_per_tick * (tp - 1)
+    if hier_dp:
+        if s.dp_size < 2:
+            raise ValueError("hier_dp prediction needs dp > 1 "
+                             "(eligibility.hier_dp_unsupported_reason)")
+        out["reduce_scatter"] = 1
+        out["all_reduce"] = 1
+        out["all_gather"] = 1
     return out
 
 
@@ -373,6 +455,8 @@ def plan_collective_bytes(
     num_microbatches: Optional[int] = None,
     tp_overlap: bool = True,
     elem_bytes: int = 4,
+    hier_dp: bool = False,
+    hier_cross: int = 1,
 ) -> Dict[str, float]:
     """Predicted per-device EXECUTED explicit-collective megabytes for the
     compiled single-program 1F1B step — the byte-side companion of
@@ -427,6 +511,21 @@ def plan_collective_bytes(
         rings_per_tick = 4 + 8 + (4 if s.checkpoint else 0)
         out["ppermute_tp"] = (T * lps * rings_per_tick * (tp - 1)
                               * act_mb / tp)
+    if hier_dp:
+        # hierarchical dp reduction payloads (fp32 accumulators — the
+        # reduce casts every leaf to f32, independent of elem_bytes): the
+        # concatenated per-device grad vector, zero-padded to the
+        # intra-host degree. Input-aval convention, matching the flow
+        # pass: rs moves the padded full vector, ar and ag the 1/intra
+        # shard.
+        if s.dp_size < 2:
+            raise ValueError("hier_dp prediction needs dp > 1 "
+                             "(eligibility.hier_dp_unsupported_reason)")
+        _, padded, intra = _hier_payload_elems_from_plan(
+            hpc, model, cross=hier_cross)
+        out["reduce_scatter"] = padded * 4 / MB
+        out["all_reduce"] = padded // intra * 4 / MB
+        out["all_gather"] = padded // intra * 4 / MB
     return out
 
 

@@ -160,6 +160,15 @@ _PERMUTE_MARKERS: Tuple[Tuple[str, str], ...] = (
     ("cp_ring", "permute_cp"),
     ("pp_rotate", "permute_pp"),
 )
+# hierarchical dp reduction markers (ops/hier_reduce.py scopes): the three
+# collectives bill to the dp component — without the markers, the
+# reduce-scatter/all-gather halves would land in the tp bucket (the
+# Megatron-SP heuristic) on any plan that runs the hierarchical path
+_HIER_MARKERS: Tuple[Tuple[str, str], ...] = (
+    ("hier_dp_rs", "hier_rs"),
+    ("hier_dp_ar", "hier_ar"),
+    ("hier_dp_ag", "hier_ag"),
+)
 # device-propagated span() names whose covered permute time belongs to tp
 # (the overlapped-TP step annotation, cli/train_dist.py)
 _TP_SPAN = "tp/overlap_step"
@@ -283,6 +292,11 @@ def attribute(trace: TraceData,
                 if cat == "permute":
                     bare_permutes.setdefault((pid, tid), []).append(
                         (ts, ts + dur))
+        elif cat in ("allgather", "reducescatter", "allreduce"):
+            for marker, key in _HIER_MARKERS:
+                if marker in hint:
+                    cat = key
+                    break
         cats[cat] = cats.get(cat, 0.0) + dur / 1000.0
         if mod:
             mods[mod] = mods.get(mod, 0.0) + dur / 1000.0
@@ -463,12 +477,32 @@ def _ab_for(alpha_beta: Dict[str, Tuple[float, float]], size: int,
             or alpha_beta.get(f"{size}_1") or alpha_beta.get(f"{size}_0"))
 
 
+def _merge_algo(d: Dict[str, Any], cands: Dict[str, float],
+                choices: Optional[Tuple[str, ...]] = None) -> None:
+    """Accumulate per-curve candidate ms into a component dict and keep
+    ``predicted_ms`` at the summed MIN choice (``choices`` restricts which
+    keys compete — decomposition entries like hier_intra ride along as
+    detail only)."""
+    algs = d.setdefault("algorithms", {})
+    for k, v in cands.items():
+        algs[k] = algs.get(k, 0.0) + v
+    pool = {k: v for k, v in algs.items()
+            if choices is None or k in choices}
+    if pool:
+        best = min(pool, key=pool.get)
+        d["algorithm"] = best
+        d["predicted_ms"] = pool[best]
+
+
 def predicted_comm_per_step(
     hpc: Any,
     model: Any,
     *,
     alpha_beta: Optional[Dict[str, Tuple[float, float]]] = None,
+    alpha_beta_algos: Optional[Dict[str, Dict[str, Tuple[float, float]]]]
+    = None,
     mixed_precision: bool = True,
+    dcn_slices: int = 1,
 ) -> Dict[str, Dict[str, float]]:
     """Per component (tp/dp/sp/cp/pp): the plan's predicted per-step MB
     (``plan_comm_volume``) and — for the allreduce-derived collectives,
@@ -484,7 +518,18 @@ def predicted_comm_per_step(
     The measured side (``Attribution``) is a per-device-track average, and
     each device only runs the layers of its own pipeline stage — so the
     priced times sum over all layers and divide by ``pp_deg`` (the uniform
-    per-device average; volumes stay whole-plan MB)."""
+    per-device average; volumes stay whole-plan MB).
+
+    ``alpha_beta_algos`` (``profiles.read_alpha_beta_algos``) adds the
+    PER-ALGORITHM view: each component dict gains an ``algorithms`` map of
+    candidate-curve predicted ms (``flat`` plus each fitted
+    ``{ring|tree}_{ici|dcn}`` curve for tp; ``flat`` / ``hier`` /
+    ``hier_intra`` / ``hier_cross`` for dp when the plan runs the
+    hierarchical reduction), an ``algorithm`` key naming the winner, and
+    ``predicted_ms`` = the min — EXACTLY the choice the cost model priced
+    (cost._tp_message_ms / cost.hier_dp_reduce_ms, called here so the two
+    can never drift). ``audit_plan`` renders these as per-algorithm
+    rows."""
     from hetu_galvatron_tpu.observability.telemetry import (
         layer_param_mb,
         plan_comm_volume,
@@ -495,7 +540,10 @@ def predicted_comm_per_step(
     vols = plan_comm_volume(hpc.layers, model, global_bsz=hpc.global_bsz,
                             chunks=chunks, mixed_precision=mixed_precision)
     ab = alpha_beta or {}
+    abalgos = alpha_beta_algos or {}
     param_mb = layer_param_mb(model)
+    # whole-plan accumulator for the once-per-step hierarchical payload
+    hier_acc = {"mb": 0.0, "dp": 1, "tp": 1}
     seq, h = model.seq_length, model.hidden_size
     elem = 2 if mixed_precision else 4
     out: Dict[str, Dict[str, float]] = {
@@ -513,26 +561,103 @@ def predicted_comm_per_step(
         if tp > 1:
             # mirror cost._tp_message_ms EXACTLY: the search only ever
             # prices tp with the "{tp}_1" pair (tp groups are consecutive
-            # by construction) — auditing against any other pair would
-            # measure drift vs a curve the search never used
+            # by construction, level ici) and takes the MIN over the flat
+            # pair and the per-algorithm ICI curves — auditing against any
+            # other choice would measure drift vs a curve it never used
+            act_mb = lbsz * seq * h * elem / MB
+            n_msgs = 6 * chunks * (1.5 if s.checkpoint else 1.0)
+            scale = n_msgs * 0.5 / pp
+            cands: Dict[str, float] = {}
             pair = ab.get(f"{tp}_1")
             if pair is not None:
-                alpha, beta = pair
-                act_mb = lbsz * seq * h * elem / MB
-                n_msgs = 6 * chunks * (1.5 if s.checkpoint else 1.0)
+                cands["flat"] = (pair[0] + act_mb / pair[1]) * scale
+            for alg_lvl, (alpha, beta) in (abalgos.get(f"{tp}_1") or
+                                           {}).items():
+                if alg_lvl.endswith("_ici"):
+                    cands[alg_lvl] = (alpha + act_mb / beta) * scale
+            if cands:
+                # per-LAYER min summed — exactly the cost model's choice
+                # (mixed curve coverage across layers stays correct: a
+                # flat-only layer contributes its flat time, an
+                # algo-covered layer its cheapest curve)
                 out["tp"]["predicted_ms"] = out["tp"].get(
-                    "predicted_ms", 0.0) + \
-                    n_msgs * 0.5 * (alpha + act_mb / beta) / pp
+                    "predicted_ms", 0.0) + min(cands.values())
+                if len(cands) > 1 or "flat" not in cands:
+                    algs = out["tp"].setdefault("algorithms", {})
+                    for k, v in cands.items():
+                        algs[k] = algs.get(k, 0.0) + v
         sdp = max(s.dp_size * s.cp_size * ulysses, 1)
         if sdp > 1:
+            cands = {}
             # dc_key convention (cost.py): tp>1 groups leave dp strided
             pair = _ab_for(ab, sdp, tp == 1)
+            grad_mb = param_mb / max(tp, 1) * \
+                (0.5 if mixed_precision else 1.0)
             if pair is not None:
-                alpha, beta = pair
-                grad_mb = param_mb / max(tp, 1) * \
-                    (0.5 if mixed_precision else 1.0)
+                cands["flat"] = (pair[0] + grad_mb / pair[1]) / pp
+            if getattr(hpc, "hier_dp", False):
+                # the hierarchical reduction runs ONCE per step over the
+                # CONCATENATED grad payload — its α must not be charged
+                # per layer (unlike the flat per-buffer rings above), so
+                # only the volume accumulates here; priced after the loop
+                hier_acc["mb"] += grad_mb
+                hier_acc["dp"] = s.dp_size
+                hier_acc["tp"] = tp
+            if cands:
                 out["dp"]["predicted_ms"] = out["dp"].get(
-                    "predicted_ms", 0.0) + (alpha + grad_mb / beta) / pp
+                    "predicted_ms", 0.0) + cands["flat"]
+                algs = out["dp"].setdefault("algorithms", {})
+                algs["flat"] = algs.get("flat", 0.0) + cands["flat"]
+    if hier_acc["mb"] and abalgos:
+        # price the hierarchical schedule through the cost model's OWN
+        # arithmetic (parity by construction): one schedule, whole-plan
+        # volume, α counted once — matching both the runtime (one
+        # three-collective program per step) and the summed layer costs
+        # (layer_time_cost's hier_ms uses the layertype total then
+        # divides by layer count)
+        from hetu_galvatron_tpu.core.cost_model.cost import (
+            CostContext,
+            _algo_min_ms,
+            _hier_dp_split,
+            hier_dp_reduce_ms,
+        )
+        from hetu_galvatron_tpu.core.search_engine.strategies import (
+            SearchStrategy,
+        )
+
+        cctx = CostContext(alpha_beta_algos=abalgos, hier_dp=True,
+                           dcn_slices=dcn_slices)
+        ss = SearchStrategy(pp=pp, tp=hier_acc["tp"], dp=hier_acc["dp"])
+        gmb = hier_acc["mb"]
+        cands = {}
+        hier = hier_dp_reduce_ms(ss, cctx, gmb)
+        if hier is not None:
+            cands["hier"] = hier / pp
+            split = _hier_dp_split(ss, cctx)
+            if split is not None:
+                cross, intra = split
+                if intra > 1:
+                    cands["hier_intra"] = _algo_min_ms(
+                        cctx, intra, 1, "ici", gmb) / pp
+                if cross > 1:
+                    ar = (_algo_min_ms(cctx, cross, 0, "dcn", gmb / intra)
+                          or _algo_min_ms(cctx, cross, 1, "dcn",
+                                          gmb / intra))
+                    if ar is not None:
+                        cands["hier_cross"] = ar / pp
+        if cands:
+            # hier_intra/hier_cross are the DECOMPOSITION of "hier", not
+            # competing candidates — the min runs over flat/hier
+            _merge_algo(out["dp"], cands, choices=("flat", "hier"))
+    # prune the algorithms scaffolding when only the flat pair priced dp
+    # (the legacy single-curve output shape); flag tp's accumulated
+    # argmin as indicative (exact when curve coverage is layer-uniform)
+    if set(out["dp"].get("algorithms", ())) == {"flat"}:
+        del out["dp"]["algorithms"]
+        out["dp"].pop("algorithm", None)
+    tp_algs = out["tp"].get("algorithms")
+    if tp_algs:
+        out["tp"]["algorithm"] = min(tp_algs, key=tp_algs.get)
     return {c: d for c, d in out.items()
             if d["predicted_mb"] or d.get("predicted_ms")}
 
@@ -576,6 +701,10 @@ def measured_components(attr: Attribution, hpc: Any) -> Dict[str, float]:
     add("cp", cat.get("permute_cp", 0.0))
     add("pp", cat.get("permute_pp", 0.0))
     add("dp" if any_sdp else "tp", cat.get("allreduce", 0.0))
+    # hierarchical dp reduction (marker-billed in attribute()): all three
+    # collectives are dp traffic regardless of the ag/rs heuristics above
+    add("dp", cat.get("hier_rs", 0.0) + cat.get("hier_ar", 0.0)
+        + cat.get("hier_ag", 0.0))
     add(permute_to, cat.get("permute", 0.0) + cat.get("p2p", 0.0)
         + cat.get("broadcast", 0.0))
     return out
@@ -588,9 +717,12 @@ def audit_plan(
     *,
     registry: Optional[MetricsRegistry] = None,
     alpha_beta: Optional[Dict[str, Tuple[float, float]]] = None,
+    alpha_beta_algos: Optional[Dict[str, Dict[str, Tuple[float, float]]]]
+    = None,
     mixed_precision: bool = True,
     predicted_layer_s: Optional[Sequence[float]] = None,
     steps: Optional[int] = None,
+    dcn_slices: int = 1,
 ) -> Dict[str, Any]:
     """Diff the active plan's predictions against the measured attribution
     and emit the calibration data: per component, predicted MB + (α-β)
@@ -601,6 +733,14 @@ def audit_plan(
     per-layer predictions when given, and the pipeline bubble fraction
     against the 1F1B analytical ``2(pp−1)/(m+2(pp−1))``.
 
+    With ``alpha_beta_algos``, per-ALGORITHM rows follow each priced
+    component (``tp[ring_ici]``, ``dp[hier]``, ...): every candidate
+    curve's predicted ms, the chosen one flagged — measured-vs-predicted
+    per algorithm is exactly the signal that says whether the
+    per-algorithm model beats the single curve. The hierarchical dp
+    sub-collectives additionally carry their own MEASURED ms (the
+    ``hier_dp_*`` scope markers bill them separately in ``attribute``).
+
     Emits ``audit/*`` gauges (labelled ``component=``) into ``registry``
     (the process default when omitted) plus one ``plan_audit`` event
     carrying the whole table for ``cli/summarize.py``; returns the table.
@@ -610,7 +750,18 @@ def audit_plan(
     measured = {c: ms / n_steps for c, ms in
                 measured_components(attr, hpc).items()}
     predicted = predicted_comm_per_step(
-        hpc, model, alpha_beta=alpha_beta, mixed_precision=mixed_precision)
+        hpc, model, alpha_beta=alpha_beta,
+        alpha_beta_algos=alpha_beta_algos,
+        mixed_precision=mixed_precision, dcn_slices=dcn_slices)
+    # measured counterparts of the hierarchical decomposition rows
+    hier_measured = {
+        "hier_intra": (attr.categories_ms.get("hier_rs", 0.0)
+                       + attr.categories_ms.get("hier_ag", 0.0)) / n_steps,
+        "hier_cross": attr.categories_ms.get("hier_ar", 0.0) / n_steps,
+        "hier": (attr.categories_ms.get("hier_rs", 0.0)
+                 + attr.categories_ms.get("hier_ar", 0.0)
+                 + attr.categories_ms.get("hier_ag", 0.0)) / n_steps,
+    }
 
     rows: List[Dict[str, Any]] = []
     for comp in ("tp", "dp", "sp", "cp", "pp"):
@@ -628,6 +779,20 @@ def audit_plan(
             row["ratio"] = round((m_ms or 0.0) / p_ms, 4)
             row["residual_ms"] = round((m_ms or 0.0) - p_ms, 4)
         rows.append(row)
+        # per-algorithm candidate rows (alpha_beta_algos present)
+        chosen = pred.get("algorithm")
+        for alg, alg_ms in sorted((pred.get("algorithms") or {}).items()):
+            arow: Dict[str, Any] = {"component": f"{comp}[{alg}]",
+                                    "predicted_ms": round(alg_ms, 4)}
+            if alg == chosen:
+                arow["chosen"] = True
+            a_meas = (hier_measured.get(alg) if comp == "dp" else None)
+            if a_meas:
+                arow["measured_ms"] = round(a_meas, 4)
+                if alg_ms:
+                    arow["ratio"] = round(a_meas / alg_ms, 4)
+                    arow["residual_ms"] = round(a_meas - alg_ms, 4)
+            rows.append(arow)
 
     compute_row: Dict[str, Any] = {
         "component": "compute",
@@ -686,9 +851,12 @@ def analyze_and_audit(
     *,
     registry: Optional[MetricsRegistry] = None,
     alpha_beta: Optional[Dict[str, Tuple[float, float]]] = None,
+    alpha_beta_algos: Optional[Dict[str, Dict[str, Tuple[float, float]]]]
+    = None,
     mixed_precision: bool = True,
     predicted_layer_s: Optional[Sequence[float]] = None,
     step_spans: Sequence[str] = STEP_SPANS,
+    dcn_slices: int = 1,
 ) -> Optional[Dict[str, Any]]:
     """One-call closed loop for the launchers: parse the newest capture
     under ``trace_dir``, attribute it, audit it against the plan. Thread
@@ -707,8 +875,10 @@ def analyze_and_audit(
             return None
         return audit_plan(attr, hpc, model, registry=registry,
                           alpha_beta=alpha_beta,
+                          alpha_beta_algos=alpha_beta_algos,
                           mixed_precision=mixed_precision,
-                          predicted_layer_s=predicted_layer_s)
+                          predicted_layer_s=predicted_layer_s,
+                          dcn_slices=dcn_slices)
     except FileNotFoundError:
         return None
     except Exception:  # noqa: BLE001 — post-mortem helper, never fatal

@@ -1,0 +1,316 @@
+"""Hierarchical dp/sdp gradient reduction: explicit two-level collectives.
+
+Why: under GSPMD the dp gradient all-reduce is invisible — the partitioner
+inserts ONE flat ring over the whole dp group at partition time, every
+microbatch, with no way to steer the algorithm or the topology level
+("Demystifying NCCL" / "Revisiting the Time Cost Model of AllReduce",
+PAPERS.md: flat rings price the slowest link into every hop). On a
+multi-slice mesh the dp group spans both the ICI domain and the DCN
+seam (``runtime/mesh.py::dcn_factor_shape`` puts pp + outer dp on DCN),
+so the right schedule is hierarchical: reduce-scatter INTRA-host at full
+volume over the fast links, all-reduce ACROSS slices on the 1/k shard
+(the only traffic that touches DCN), and all-gather the result back
+intra-host. This module makes that schedule an EXPLICIT part of the
+program so the static census can count it, the flow pass can weigh it,
+and the cost model can price it per level.
+
+Mechanics (two halves):
+
+* **Per-lane gradients** — the flat path's partial sums exist only inside
+  the partitioner, so the cross-dp sum is made explicit by computing
+  per-dp-lane gradients: the batch's leading dim reshapes to
+  ``[lanes, B/lanes, ...]`` with the lane axis sharded over the plan's dp
+  mesh axes, and ``jax.vmap(grad_fn, in_axes=(None, 0))`` produces
+  lane-stacked grads with ZERO cross-dp communication (each lane's
+  devices already hold its samples; the per-device contraction is
+  identical to the flat path's local work — only the cross-lane
+  summation ORDER changes, a reduction reassociation within float
+  tolerance). Gradient accumulation across microbatches stays lane-local,
+  so a ``chunks``-microbatch step pays the dp reduction ONCE instead of
+  the flat path's once-per-microbatch in-scan all-reduce.
+* **The reduction** — ONE full-manual ``shard_map`` over
+  :func:`~hetu_galvatron_tpu.runtime.mesh.hier_submesh` (the global mesh
+  with the dp axes regrouped into the canonical
+  :data:`~hetu_galvatron_tpu.runtime.mesh.HIER_SLICE_AXIS` /
+  :data:`~hetu_galvatron_tpu.runtime.mesh.HIER_HOST_AXIS` sub-axes).
+  Every grad leaf flattens and concatenates into ONE per-device payload
+  vector (zero-padded to the intra-host degree), so the whole tree costs
+  exactly three collective eqns per step — ``psum_scatter`` over the host
+  axis at full volume, ``psum`` over the slice axis on the 1/intra shard,
+  ``all_gather`` back — each under its ``jax.named_scope`` marker
+  (:data:`HIER_DP_RS_SCOPE` etc.) so trace attribution and the census can
+  bill them. ``telemetry.plan_collective_counts/bytes`` predict these
+  counts and padded payload bytes EXACTLY from the same spec arithmetic
+  (:func:`hier_payload_elems`).
+
+Eligibility lives in ``analysis/eligibility.py``
+(``hier_dp_unsupported_reason``): uniform Megatron-TP plans only — no
+cp/Ulysses (their grads are partial over more than dp), no dropout (lane
+mask streams would diverge from the flat path's), no shard_map kernels
+under the lane vmap (tp_overlap rings / flash / ring-cp cannot nest), and
+the vocab tp axes must stay off the dp lane axes.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from hetu_galvatron_tpu.runtime.mesh import (
+    HIER_HOST_AXIS,
+    HIER_SLICE_AXIS,
+    LayerSharding,
+    axes_size,
+    hier_submesh,
+)
+
+# HLO-metadata markers (jax.named_scope) for the three hierarchical
+# collectives — trace attribution (observability/trace_analysis.py) bills
+# them to the dp component, and the sharding-flow reshard lint exempts the
+# deliberate hier_dp_ag re-materialization
+HIER_DP_RS_SCOPE = "hier_dp_rs"
+HIER_DP_AR_SCOPE = "hier_dp_ar"
+HIER_DP_AG_SCOPE = "hier_dp_ag"
+HIER_DP_SCOPES = (HIER_DP_RS_SCOPE, HIER_DP_AR_SCOPE, HIER_DP_AG_SCOPE)
+
+
+def _is_axes(x: Any) -> bool:
+    return isinstance(x, tuple) and all(isinstance(s, str) for s in x)
+
+
+def grad_reduce_specs(axes_tree: Any, per_layer: List[LayerSharding],
+                      vocab: LayerSharding) -> Any:
+    """PartitionSpec tree for the LANE-STACKED gradients' non-lane dims:
+    the params' specs with ZeRO-3 dp-sharding overridden OFF (the lane
+    axis owns the dp mesh axes; a leaf spec may not mention them twice).
+    Mirrors ``parallel.spmd.param_specs``' row assignment — decoder layers
+    use their own sharding, embed/prenorm/head the vocab sharding."""
+    sp = lambda sh: (lambda la: sh.param_spec(la, zero3_override=False))
+    tree = lambda axes, sh: jax.tree.map(sp(sh), axes, is_leaf=_is_axes)
+    out = {
+        "embed": tree(axes_tree["embed"], vocab),
+        "layers": tuple(tree(a, sh)
+                        for a, sh in zip(axes_tree["layers"], per_layer)),
+        "prenorm": tree(axes_tree["prenorm"], vocab),
+        "head": tree(axes_tree["head"], vocab),
+    }
+    if "enc_layers" in axes_tree:
+        out["enc_layers"] = tuple(
+            tree(a, per_layer[0]) for a in axes_tree["enc_layers"])
+        out["enc_norm"] = tree(axes_tree["enc_norm"], vocab)
+    return out
+
+
+def hier_payload_elems(shapes: Sequence[Tuple[int, ...]],
+                       specs: Sequence[P], mesh: Any,
+                       intra: int) -> Tuple[int, int]:
+    """(local, padded) per-device element counts of the concatenated
+    reduction payload: each leaf contributes its GLOBAL size divided by
+    the product of the mesh axes its spec shards it over, and the concat
+    zero-pads up to the intra-host degree for the tiled scatter. This is
+    the arithmetic ``plan_collective_bytes`` uses to predict the traced
+    payload EXACTLY — one function, two callers, no drift. ``mesh`` only
+    needs axis SIZES (``.shape``), so a shape-only stand-in works on a
+    host with no devices (telemetry's plan prediction)."""
+    local = 0
+    for shape, spec in zip(shapes, specs):
+        n = 1
+        for d in shape:
+            n *= int(d)
+        div = 1
+        for entry in spec:
+            if entry is None:
+                continue
+            names = entry if isinstance(entry, tuple) else (entry,)
+            div *= axes_size(mesh, names)
+        local += n // div
+    padded = -(-local // max(intra, 1)) * max(intra, 1)
+    return local, padded
+
+
+def _check_specs_off_lane_axes(specs: List[P],
+                               dp_axes: Tuple[str, ...]) -> None:
+    banned = set(dp_axes)
+    for spec in specs:
+        for entry in tuple(spec):
+            names = (entry if isinstance(entry, tuple)
+                     else (entry,) if entry else ())
+            if banned & set(names):
+                raise ValueError(
+                    f"grad leaf spec {spec} shards a non-lane dim over the "
+                    f"dp lane axes {dp_axes}; build the grad specs with "
+                    "zero3_override=False (grad_reduce_specs)")
+
+
+@dataclass
+class HierDpReducer:
+    """One plan's hierarchical dp gradient reducer, bound to a mesh.
+
+    ``lanes`` is the plan's dp degree (the lane-vmap width);
+    ``cross``/``intra`` the slice/host split of it. :meth:`reduce` takes a
+    lane-stacked grad tree (leading ``[lanes]`` dim sharded over the dp
+    axes, every other dim laid out per ``specs``) and returns the summed
+    tree with the lane dim gone — three explicit collectives total.
+    """
+
+    mesh: Mesh
+    dp_axes: Tuple[str, ...]
+    cross: int
+    intra: int
+    # PartitionSpec tree matching the (unstacked) grad leaves; leaves that
+    # carry extra stacked dims (the compiled engine's leading "pp") include
+    # them in their own spec — the lane dim is prepended here
+    specs: Any
+    # the flat batch's [B, ...] spec (per_layer[0].batch_spec()); the lane
+    # split re-pins dims past the lane one to it
+    batch_spec: Optional[P] = None
+
+    def __post_init__(self):
+        self.lanes = axes_size(self.mesh, self.dp_axes)
+        if self.lanes != self.cross * self.intra:
+            raise ValueError(
+                f"cross {self.cross} x intra {self.intra} != dp degree "
+                f"{self.lanes}")
+        self.hmesh = hier_submesh(self.mesh, self.dp_axes, self.cross)
+        leaves, self._treedef = jax.tree_util.tree_flatten(
+            self.specs, is_leaf=lambda x: isinstance(x, P))
+        _check_specs_off_lane_axes(leaves, self.dp_axes)
+        self._in_specs = tuple(
+            P((HIER_SLICE_AXIS, HIER_HOST_AXIS), *s) for s in leaves)
+        self._out_specs = tuple(leaves)
+        self._leaf_specs = leaves
+        self._lane_dim = tuple(self.dp_axes)
+        self._fn = shard_map(self._body, self.hmesh,
+                             in_specs=self._in_specs,
+                             out_specs=self._out_specs, check_rep=False)
+
+    # -- lane helpers -------------------------------------------------------
+
+    def lane_batch(self, batch: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
+        """Reshape a batch tree's leading [B, ...] dim to [lanes, B/lanes,
+        ...] with the lane axis pinned to the dp mesh axes (the flat
+        batch's own dp sharding — the reshape moves no data)."""
+        L = self.lanes
+        batch_spec = (self.batch_spec if self.batch_spec is not None
+                      else P(self._lane_dim))
+
+        def split(x):
+            if x.shape[0] % L:
+                raise ValueError(
+                    f"batch dim {x.shape[0]} not divisible by the dp lane "
+                    f"count {L}")
+            y = x.reshape((L, x.shape[0] // L) + x.shape[1:])
+            rest = tuple(batch_spec)[1:]
+            return jax.lax.with_sharding_constraint(
+                y, NamedSharding(self.mesh,
+                                 P(self._lane_dim, None, *rest)))
+
+        return jax.tree.map(split, batch)
+
+    def constrain_stacked(self, grads: Any) -> Any:
+        """Pin a lane-stacked grad tree's layout (lane over dp axes, the
+        rest per the leaf specs) — used on the scan carry so the
+        accumulator never silently re-shards."""
+        specs = jax.tree_util.tree_unflatten(
+            self._treedef,
+            [P(self._lane_dim, *s) for s in self._leaf_specs])
+        return jax.tree.map(
+            lambda g, s: jax.lax.with_sharding_constraint(
+                g, NamedSharding(self.mesh, s)),
+            grads, specs, is_leaf=lambda x: isinstance(x, P))
+
+    # -- the reduction ------------------------------------------------------
+
+    def _body(self, *blocks):
+        """Local shard_map body: each block arrives ``[1, ...]`` (one lane
+        per device along the regrouped dp sub-axes); flatten-concat-pad to
+        one payload vector, run the three-level schedule, split back."""
+        intra = self.intra
+        flats = [b[0].reshape(-1).astype(jnp.float32) for b in blocks]
+        sizes = [f.size for f in flats]
+        v = jnp.concatenate(flats) if len(flats) > 1 else flats[0]
+        pad = (-v.size) % intra
+        if pad:
+            v = jnp.pad(v, (0, pad))
+        with jax.named_scope(HIER_DP_RS_SCOPE):
+            s = jax.lax.psum_scatter(v, HIER_HOST_AXIS,
+                                     scatter_dimension=0, tiled=True)
+        with jax.named_scope(HIER_DP_AR_SCOPE):
+            s = jax.lax.psum(s, HIER_SLICE_AXIS)
+        with jax.named_scope(HIER_DP_AG_SCOPE):
+            full = jax.lax.all_gather(s, HIER_HOST_AXIS, tiled=True)
+        if pad:
+            full = full[:sum(sizes)]
+        outs, off = [], 0
+        for b, n in zip(blocks, sizes):
+            outs.append(full[off:off + n].reshape(b.shape[1:])
+                        .astype(b.dtype))
+            off += n
+        return tuple(outs)
+
+    def reduce(self, stacked: Any) -> Any:
+        """Lane-stacked grads ``[lanes, ...]`` -> summed grads (lane dim
+        dropped), via the one three-collective program."""
+        leaves = jax.tree_util.tree_leaves(stacked)
+        if len(leaves) != len(self._leaf_specs):
+            raise ValueError(
+                f"grad tree has {len(leaves)} leaves, reducer was built "
+                f"for {len(self._leaf_specs)}")
+        outs = self._fn(*leaves)
+        return jax.tree_util.tree_unflatten(self._treedef, list(outs))
+
+    def payload_elems(self, stacked_or_shapes: Any) -> Tuple[int, int]:
+        """(local, padded) payload element counts — the traced-byte
+        prediction's anchor. Accepts either a LANE-STACKED grad tree
+        (leaf lane dims stripped) or a flat list of UNSTACKED global leaf
+        shape tuples in spec order."""
+        if isinstance(stacked_or_shapes, (list, tuple)) and all(
+                isinstance(s, tuple) for s in stacked_or_shapes):
+            shapes = [tuple(s) for s in stacked_or_shapes]
+        else:
+            shapes = [tuple(l.shape[1:]) for l in
+                      jax.tree_util.tree_leaves(stacked_or_shapes)]
+        return hier_payload_elems(shapes, self._leaf_specs, self.hmesh,
+                                  self.intra)
+
+
+def make_hier_reducer(
+    mesh: Mesh,
+    per_layer: List[LayerSharding],
+    vocab: LayerSharding,
+    axes_tree: Any,
+    *,
+    dcn_slices: int = 1,
+    cross: Optional[int] = None,
+    specs: Any = None,
+) -> HierDpReducer:
+    """Build the reducer for a lowered plan: dp lane axes from the (uniform)
+    first decoder layer, the slice/host split from ``dcn_slices`` (pp-first
+    absorption, ``mesh.hier_cross_degree``) unless ``cross`` pins it, and
+    grad specs from :func:`grad_reduce_specs` unless given."""
+    from hetu_galvatron_tpu.runtime.mesh import hier_cross_degree
+
+    sh = per_layer[0]
+    dp_axes = sh.dp_axes
+    dp_deg = axes_size(mesh, dp_axes)
+    if cross is None:
+        cross = hier_cross_degree(mesh.shape.get("pp", 1), dp_deg,
+                                  dcn_slices)
+    if specs is None:
+        specs = grad_reduce_specs(axes_tree, per_layer, vocab)
+    return HierDpReducer(mesh=mesh, dp_axes=dp_axes, cross=cross,
+                         intra=dp_deg // cross, specs=specs,
+                         batch_spec=sh.batch_spec())
+
+
+# NOTE: per-lane grad computation is NOT wrapped here on purpose — every
+# caller (trainer / both pipeline engines) must build its own
+# ``jax.vmap(grad_fn, in_axes=(None, 0), spmd_axis_name=dp_axes)`` with
+# lane-aware (dp-free) interior shardings; a generic helper without the
+# axis pinning would silently reintroduce the per-layer lane reshard this
+# module's docstring warns about.

@@ -310,6 +310,7 @@ def build_spmd_loss_fn(
     layer_overrides: Optional[Dict[int, Dict[str, Any]]] = None,
     with_moe_stats: bool = False,
     tp_overlap: bool = False,
+    lane_dp: bool = False,
 ):
     """The plan-lowered loss closure shared by the train and eval steps:
     per-layer shardings, boundary constraints, attention-impl dispatch,
@@ -318,18 +319,35 @@ def build_spmd_loss_fn(
     ``tp_overlap`` swaps eligible Megatron-TP layers' projection matmuls
     for the decomposed ring collectives (:func:`tp_overlap_overrides`);
     ineligible layers silently keep GSPMD — the launcher logs the reasons.
-    """
+
+    ``lane_dp`` builds the hierarchical-dp LANE variant: the interior
+    activation constraints drop the dp axes (each lane's batch slice lives
+    entirely inside one dp group, so a dp-sharded constraint under the
+    per-lane vmap would force a per-layer reshard of every lane), and the
+    lane axis itself is pinned to the dp mesh axes by the caller's
+    ``jax.vmap(..., spmd_axis_name=dp_axes)``. Param specs and the
+    returned batch sharding stay the FLAT plan's (params are unmapped;
+    the lane reshape happens inside the step)."""
+    from dataclasses import replace as _replace
+
     enc_per, per_layer, vocab, pspecs = _lower_specs(hpc, mesh, axes_tree)
-    boundary = make_boundary_fn(per_layer, vocab, mesh)
-    enc_boundary = (make_boundary_fn(enc_per, vocab, mesh)
-                    if enc_per else None)
+    if lane_dp:
+        lane = lambda sh: _replace(sh, dp_axes=())
+        b_layers = [lane(sh) for sh in per_layer]
+        b_vocab = lane(vocab)
+        b_enc = [lane(sh) for sh in enc_per]
+    else:
+        b_layers, b_vocab, b_enc = per_layer, vocab, enc_per
+    boundary = make_boundary_fn(b_layers, b_vocab, mesh)
+    enc_boundary = (make_boundary_fn(b_enc, b_vocab, mesh)
+                    if b_enc else None)
     use_flash = None if cfg.use_flash_attn else False
     ring = attention_overrides(
-        per_layer, mesh, use_flash=use_flash,
+        b_layers, mesh, use_flash=use_flash,
         with_cross=cfg.model_type == "t5",
         cp_zigzag=getattr(hpc, "cp_zigzag", False))
-    enc_overrides = (attention_overrides(enc_per, mesh, use_flash=use_flash)
-                     if enc_per else None)
+    enc_overrides = (attention_overrides(b_enc, mesh, use_flash=use_flash)
+                     if b_enc else None)
     if tp_overlap:
         overlap_ov, _ = tp_overlap_overrides(per_layer, mesh, cfg)
         # merged UNDER ring/caller overrides per key: an explicit
@@ -423,6 +441,8 @@ def make_spmd_train_step(
     donate: bool = True,
     chunks: Optional[int] = None,
     tp_overlap: bool = False,
+    hier_dp: bool = False,
+    dcn_slices: int = 1,
 ):
     """Build the jitted hybrid-parallel train step (no pipeline; pp=1).
 
@@ -432,22 +452,49 @@ def make_spmd_train_step(
     ``chunks`` overrides the plan's microbatch count (batch-size ramp:
     the launcher rebuilds the step per chunk count at a fixed micro size).
     ``tp_overlap`` runs eligible TP layers' projections as decomposed
-    ring-collective matmuls (ops/overlap.py).
+    ring-collective matmuls (ops/overlap.py). ``hier_dp`` swaps the
+    implicit GSPMD dp gradient all-reduce for the explicit hierarchical
+    reduce-scatter/all-reduce/all-gather path (ops/hier_reduce.py), with
+    the slice/host split taken from ``dcn_slices``; ineligible plans raise
+    with the shared eligibility reason (the launcher logs and falls back).
     """
     if hpc.pp_deg != 1:
         raise ValueError("make_spmd_train_step is the pp=1 path; use the "
                          "pipeline engine for pp>1")
     moe_stats = bool(cfg.num_experts)
+    if hier_dp:
+        from hetu_galvatron_tpu.analysis.eligibility import (
+            HIER_KERNEL_REASON,
+            plan_hier_dp_reason,
+        )
+
+        reason = plan_hier_dp_reason(cfg, hpc)
+        if reason is None and tp_overlap:
+            reason = HIER_KERNEL_REASON
+        if reason is None and cfg.use_flash_attn and all(
+                d.platform == "tpu" for d in mesh.devices.flat[:1]):
+            reason = HIER_KERNEL_REASON
+        if reason is None and cfg.use_fused_ce and mesh.size > 1:
+            reason = HIER_KERNEL_REASON  # vocab-parallel CE is a shard_map
+        if reason is not None:
+            raise ValueError(f"hier_dp unsupported: {reason}")
     loss_fn, pspecs, batch_shd, per_layer, vocab, enc_per = (
         build_spmd_loss_fn(
             cfg, hpc, mesh, axes_tree, compute_dtype=compute_dtype,
             layer_overrides=layer_overrides, with_moe_stats=moe_stats,
-            tp_overlap=tp_overlap))
+            tp_overlap=tp_overlap, lane_dp=hier_dp))
     opt_pspecs = param_specs(axes_tree, per_layer, vocab, opt=True,
                              enc_per_layer=enc_per or None)
     opt_specs = opt_state_specs(tx, params, opt_pspecs)
     chunks = max(chunks if chunks is not None else hpc.chunks, 1)
-    step = make_train_step(loss_fn, tx, chunks=chunks, aux_stats=moe_stats)
+    hier = None
+    if hier_dp:
+        from hetu_galvatron_tpu.ops.hier_reduce import make_hier_reducer
+
+        hier = make_hier_reducer(mesh, per_layer, vocab, axes_tree,
+                                 dcn_slices=dcn_slices)
+    step = make_train_step(loss_fn, tx, chunks=chunks, aux_stats=moe_stats,
+                           hier=hier)
 
     nshd = lambda tree: jax.tree.map(
         lambda s: NamedSharding(mesh, s), tree,

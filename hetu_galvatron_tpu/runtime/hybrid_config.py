@@ -60,6 +60,10 @@ class HybridParallelConfig:
     # (fct+bct, ms) so the plan audit can diff the exact model that picked
     # the plan; None for GLOBAL-mode or pre-audit plan files.
     predicted_layer_compute_ms: Optional[List[float]] = None
+    # The search priced this plan's dp gradient reduction hierarchically
+    # ("hier_dp": 1 in the plan JSON) — the launcher enables the matching
+    # runtime path (ops/hier_reduce.py; args.parallel.hier_dp ORs in).
+    hier_dp: bool = False
 
     @property
     def enc_strategies(self) -> List[LayerStrategy]:
@@ -141,6 +145,7 @@ def get_hybrid_parallel_config(
         pp_division = extras["pp_division"] or default_pp_division(
             n_layers, pp_deg * vpp)
         pred_layer_ms = extras.get("predicted_layer_compute_ms")
+        hier_dp = bool(extras.get("hier_dp", False))
     else:
         pp_deg = par.pp_deg
         r = eligibility.pp_world_reason(world_size, pp_deg)
@@ -174,6 +179,7 @@ def get_hybrid_parallel_config(
         pp_division = default_pp_division(n_layers, pp_deg * vpp)
         chunks = get_chunks(args, world_size)
         pred_layer_ms = None
+        hier_dp = False
 
     # guard both branches (a JSON plan with pp*vpp > layers would otherwise
     # slip through as zero-layer chunks from default_pp_division): the
@@ -210,4 +216,5 @@ def get_hybrid_parallel_config(
         pipeline_type=pipeline_type, default_dp_type=default_dp,
         world_size=world_size, num_encoder_layers=n_enc, vpp_deg=vpp,
         cp_zigzag=cp_zigzag, predicted_layer_compute_ms=pred_layer_ms,
+        hier_dp=hier_dp,
     )

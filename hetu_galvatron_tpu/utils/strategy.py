@@ -173,6 +173,7 @@ def strategy_list2config(
     num_encoder_layers: Optional[int] = None,
     vpp_deg: Optional[int] = None,
     predicted_layer_compute_ms: Optional[Sequence[float]] = None,
+    hier_dp: Optional[bool] = None,
 ) -> Dict[str, Any]:
     """Serialize per-layer strategies to the interchange dict.
 
@@ -243,6 +244,11 @@ def strategy_list2config(
                 f"{len(strategies)} layers")
         cfg["predicted_layer_compute_ms"] = [
             float(x) for x in predicted_layer_compute_ms]
+    if hier_dp:
+        # the search priced this plan's dp gradient reduction with the
+        # hierarchical two-level schedule (ops/hier_reduce.py); the runtime
+        # enables the matching execution path (args.parallel.hier_dp ORs in)
+        cfg["hier_dp"] = 1
     return cfg
 
 
@@ -377,6 +383,7 @@ def config2strategy(
         "num_encoder_layers": (_int_field(cfg, "num_encoder_layers")
                                if "num_encoder_layers" in cfg else None),
         "vpp_deg": _int_field(cfg, "vpp_deg", 1),
+        "hier_dp": bool(_int_field(cfg, "hier_dp", 0)),
         # optional per-layer compute prediction (see strategy_list2config);
         # a hand-edited plan whose vector no longer matches the layer count
         # is dropped rather than mis-attributed to the wrong layers

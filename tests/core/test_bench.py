@@ -40,6 +40,9 @@ def _run_bench(tmp_path, extra_env, timeout=600):
         # tests/serving/test_serve_bench.py)
         "BENCH_SERVE_PREFIX": "0",
         "BENCH_SPEC_DECODE": "0",
+        # and the default-on hierarchical-dp A/B leg (covered by
+        # tests/core/test_hier_dp_bench.py)
+        "BENCH_HIER_DP": "0",
     })
     env.update(extra_env)
     proc = subprocess.run(

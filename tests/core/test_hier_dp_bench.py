@@ -1,0 +1,44 @@
+"""The hierarchical-dp A/B microbench must run, produce self-consistent
+numbers, and (acceptance) not regress the flat GSPMD path on the virtual
+CPU mesh — pooled-median ``hier_dp_vs_flat <= 1.0`` with zero
+steady-state recompiles. The full-size acceptance shape rides the slow
+tier; the fast smoke only checks the harness is alive."""
+
+import pytest
+
+pytestmark = [pytest.mark.core]
+
+
+def _bench(**kw):
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "..",
+                                    "tools"))
+    import hier_dp_bench as b
+
+    return b.run(**kw)
+
+
+@pytest.mark.slow
+def test_hier_dp_bench_runs_and_is_consistent():
+    out = _bench(iters=2, plans=((1, 8),), hidden=64, seq=64, chunks=4)
+    leg = out["legs"]["tp1dp8"]
+    assert leg["flat_step_ms"] > 0 and leg["hier_step_ms"] > 0
+    assert out["hier_dp_vs_flat"] > 0
+    assert out["hier_dp_recompiles"] == 0
+    assert out["platform"] == "cpu"
+    assert out["dcn_slices"] == 2
+
+
+@pytest.mark.slow
+def test_hier_dp_bench_acceptance_ratio():
+    """ACCEPTANCE: at the committed bench shape the hierarchical path must
+    not lose to the flat all-reduce on the CPU mesh (the once-per-step vs
+    once-per-microbatch schedule difference dominates; the per-level DCN
+    win needs real hardware). Bounded loosely above the committed
+    baseline to absorb shared-CI noise — the committed number itself is
+    gated by tools/bench_gate.py."""
+    out = _bench(iters=6)
+    assert out["hier_dp_recompiles"] == 0
+    assert out["hier_dp_vs_flat"] <= 1.1, out

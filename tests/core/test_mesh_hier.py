@@ -1,0 +1,99 @@
+"""Mesh hierarchy helpers: ``dcn_factor_shape`` edge cases (satellite —
+the documented raise, pp-first absorption order, dcn_slices=1 no-op) and
+the hierarchical sub-mesh view (``hier_submesh`` / ``hier_cross_degree``)
+the dp gradient reduction builds on."""
+
+import numpy as np
+import pytest
+
+from hetu_galvatron_tpu.runtime.mesh import (
+    HIER_HOST_AXIS,
+    HIER_SLICE_AXIS,
+    build_mesh,
+    dcn_factor_shape,
+    device_array,
+    hier_cross_degree,
+    hier_submesh,
+)
+
+pytestmark = [pytest.mark.core, pytest.mark.distributed]
+
+
+def test_dcn_factor_shape_pp_first_absorption():
+    """Slices land on pp FIRST, then the outer binary d-axes, in order —
+    the 'consecutive ranks on the fast links' locality lifted to pods."""
+    # pp2 x d0..d2: 2 slices fully absorbed by pp
+    assert dcn_factor_shape((2, 2, 2, 2), 2) == (2, 1, 1, 1)
+    # 4 slices: pp takes 2, d0 the rest
+    assert dcn_factor_shape((2, 2, 2, 2), 4) == (2, 2, 1, 1)
+    # 8 slices: pp, d0, d1
+    assert dcn_factor_shape((2, 2, 2, 2), 8) == (2, 2, 2, 1)
+    # pp=3 with 6 slices: gcd absorption (3 on pp, 2 on d0)
+    assert dcn_factor_shape((3, 2, 2), 6) == (3, 2, 1)
+
+
+def test_dcn_factor_shape_nonfactoring_raises_documented_message():
+    with pytest.raises(ValueError,
+                       match="pp \\* outer-dp must absorb the slices"):
+        dcn_factor_shape((2, 2, 2), 16)
+    # odd slice counts cannot divide the binary axes past pp
+    with pytest.raises(ValueError,
+                       match="does not factor over the leading mesh axes"):
+        dcn_factor_shape((2, 2, 2), 3)
+
+
+def test_dcn_slices_one_is_byte_identical_mesh(cpu_devices):
+    a = device_array(8, 2, cpu_devices[:8], dcn_slices=1)
+    b = device_array(8, 2, cpu_devices[:8])
+    assert a.shape == b.shape
+    assert all(x is y for x, y in zip(a.flat, b.flat))
+    m1 = build_mesh(8, 2, devices=cpu_devices[:8], dcn_slices=1)
+    m0 = build_mesh(8, 2, devices=cpu_devices[:8])
+    assert m1.axis_names == m0.axis_names
+    assert m1.devices.tolist() == m0.devices.tolist()
+
+
+def test_hier_cross_degree_matches_dcn_absorption():
+    assert hier_cross_degree(1, 8, 1) == 1
+    assert hier_cross_degree(1, 8, 2) == 2
+    assert hier_cross_degree(2, 4, 2) == 1   # pp absorbs the slices
+    assert hier_cross_degree(2, 2, 4) == 2
+    with pytest.raises(ValueError, match="does not factor"):
+        hier_cross_degree(1, 2, 8)
+
+
+def test_hier_submesh_regroups_dp_axes(cpu_devices):
+    mesh = build_mesh(8, 1, devices=cpu_devices[:8])  # pp, d0, d1, d2
+    h = hier_submesh(mesh, ("d0", "d1"), cross=2)
+    assert h.axis_names == ("pp", HIER_SLICE_AXIS, HIER_HOST_AXIS, "d2")
+    assert h.shape[HIER_SLICE_AXIS] == 2 and h.shape[HIER_HOST_AXIS] == 2
+    # same flat device order: the view coexists with the global mesh
+    assert list(h.devices.flat) == list(mesh.devices.flat)
+    # degenerate cross=1 keeps the full dp degree on the host axis
+    h1 = hier_submesh(mesh, ("d0", "d1"), cross=1)
+    assert h1.shape[HIER_HOST_AXIS] == 4
+
+    with pytest.raises(ValueError, match="not a contiguous run"):
+        hier_submesh(mesh, ("d0", "d2"), cross=2)
+    with pytest.raises(ValueError, match="does not divide"):
+        hier_submesh(mesh, ("d0", "d1"), cross=3)
+
+
+def test_plan_hier_dp_key_roundtrip():
+    """The searched plan's hier_dp key survives the interchange format."""
+    from hetu_galvatron_tpu.utils.strategy import (
+        LayerStrategy,
+        config2strategy,
+        strategy_list2config,
+    )
+
+    layers = [LayerStrategy(pp_deg=1, tp_size=2, dp_size=4)] * 2
+    cfg = strategy_list2config(layers, global_bsz=8, chunks=1,
+                               hier_dp=True)
+    assert cfg["hier_dp"] == 1
+    _, _, extras = config2strategy(cfg, world_size=8)
+    assert extras["hier_dp"] is True
+    cfg2 = strategy_list2config(layers, global_bsz=8, chunks=1)
+    assert "hier_dp" not in cfg2
+    _, _, extras2 = config2strategy(cfg2, world_size=8)
+    assert extras2["hier_dp"] is False

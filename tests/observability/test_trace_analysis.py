@@ -608,3 +608,161 @@ def test_summarize_renders_calibration_table(tmp_path):
     assert headline["audit_ratio_tp"] > 0
     assert headline["audit_ratio_compute"] == pytest.approx(5.0 / 8.0)
     assert headline["audit_step_device_ms"] == pytest.approx(8.0)
+
+
+# ---------------------------------------------------------------------------
+# per-algorithm / hierarchical-dp audit rows
+# ---------------------------------------------------------------------------
+
+
+def test_predicted_comm_per_algorithm_min_choice():
+    """With per-algorithm curves, each priced component carries every
+    candidate's ms and predicted_ms = the min — the cost model's own
+    choice (min over flat pair + ICI algo curves)."""
+    ab = {"2_1": (0.05, 100.0), "2_0": (0.07, 80.0)}
+    algos = {"2_1": {"tree_ici": (0.01, 100.0),
+                     "ring_ici": (0.2, 400.0),
+                     "ring_dcn": (9.9, 1.0)}}  # dcn curve must not price tp
+    hpc = _hpc([LayerStrategy(tp_size=2, dp_size=2)])
+    out = predicted_comm_per_step(hpc, CFG, alpha_beta=ab,
+                                  alpha_beta_algos=algos)
+    tp = out["tp"]
+    assert set(tp["algorithms"]) == {"flat", "tree_ici", "ring_ici"}
+    assert tp["algorithm"] == min(tp["algorithms"],
+                                  key=tp["algorithms"].get)
+    assert tp["predicted_ms"] == pytest.approx(
+        min(tp["algorithms"].values()))
+    # without algo data: behavior unchanged (no algorithms key)
+    flat_only = predicted_comm_per_step(hpc, CFG, alpha_beta=ab)
+    assert "algorithms" not in flat_only["tp"]
+
+
+def test_predicted_comm_hier_dp_decomposition():
+    """A hier_dp plan prices dp as min(flat, hier) and reports the
+    rs+ag/cross decomposition, through the cost model's own arithmetic."""
+    from hetu_galvatron_tpu.core.cost_model.cost import (
+        CostContext,
+        hier_dp_reduce_ms,
+    )
+    from hetu_galvatron_tpu.core.search_engine.strategies import (
+        SearchStrategy,
+    )
+    from hetu_galvatron_tpu.observability.telemetry import layer_param_mb
+
+    ab = {"4_1": (0.5, 50.0)}
+    algos = {"2_1": {"ring_ici": (0.05, 200.0)},
+             "2_0": {"ring_dcn": (0.3, 20.0)}}
+    hpc = _hpc([LayerStrategy(tp_size=1, dp_size=4)])
+    hpc.hier_dp = True
+    out = predicted_comm_per_step(hpc, CFG, alpha_beta=ab,
+                                  alpha_beta_algos=algos, dcn_slices=2)
+    dp = out["dp"]
+    assert {"flat", "hier", "hier_intra", "hier_cross"} <= set(
+        dp["algorithms"])
+    grad_mb = layer_param_mb(CFG) * 0.5
+    want_hier = hier_dp_reduce_ms(
+        SearchStrategy(pp=1, tp=1, dp=4),
+        CostContext(alpha_beta_algos=algos, hier_dp=True, dcn_slices=2),
+        grad_mb)
+    assert dp["algorithms"]["hier"] == pytest.approx(want_hier)
+    assert dp["predicted_ms"] == pytest.approx(
+        min(dp["algorithms"]["flat"], dp["algorithms"]["hier"]))
+    # the decomposition entries never compete in the min
+    assert dp["algorithm"] in ("flat", "hier")
+
+
+def test_measured_components_bills_hier_markers_to_dp():
+    attr = Attribution(categories_ms={
+        "allgather": 2.0, "reducescatter": 1.0, "hier_rs": 3.0,
+        "hier_ar": 0.5, "hier_ag": 2.5})
+    m = measured_components(attr, _hpc([LayerStrategy(tp_size=2,
+                                                      dp_size=4)]))
+    # the marked hier collectives are dp; the unmarked ag/rs stay tp
+    assert m["dp"] == pytest.approx(6.0)
+    assert m["tp"] == pytest.approx(3.0)
+
+
+def test_audit_plan_emits_per_algorithm_rows(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    reg = MetricsRegistry([JsonlSink(path)])
+    ab = {"2_1": (0.05, 100.0), "2_0": (0.07, 80.0)}
+    algos = {"2_1": {"tree_ici": (0.01, 100.0),
+                     "ring_ici": (0.2, 400.0)}}
+    attr = _measured_attr()
+    attr.categories_ms.update({"hier_rs": 1.0, "hier_ar": 0.2,
+                               "hier_ag": 0.8})
+    hpc = _hpc([LayerStrategy(tp_size=2, dp_size=2)] * 2)
+    hpc.hier_dp = True
+    algos.update({"1_1": {}})
+    table = audit_plan(attr, hpc, CFG, registry=reg, alpha_beta=ab,
+                       alpha_beta_algos=algos, dcn_slices=1)
+    comps = {r["component"]: r for r in table["rows"]}
+    # per-algorithm candidate rows ride along, exactly one chosen
+    for name in ("tp[flat]", "tp[tree_ici]", "tp[ring_ici]"):
+        assert name in comps and "predicted_ms" in comps[name]
+    chosen = [r for c, r in comps.items()
+              if c.startswith("tp[") and r.get("chosen")]
+    assert len(chosen) == 1
+    # the hier sub-collectives carry MEASURED ms from their markers even
+    # when no hier curves are fitted (dp[...] rows need fitted dcn/ici
+    # curves to exist; the dp component row still measures the traffic)
+    assert comps["dp"]["measured_ms"] == pytest.approx(
+        (2.0 + 1.0 + 0.2 + 0.8) / attr.steps)
+    reg.flush()
+
+
+def test_summarize_hardware_renders_algo_columns(tmp_path, capsys):
+    from hetu_galvatron_tpu.cli.summarize import summarize_hardware
+
+    cfg = {
+        "allreduce_size_4_consec_1": 120.0,
+        "allreduce_size_4_consec_1_alpha_ms": 0.2,
+        "allreduce_size_4_consec_1_beta_mb_per_ms": 100.0,
+        "allreduce_size_4_consec_1_alg_ring_lvl_ici_alpha_ms": 0.3,
+        "allreduce_size_4_consec_1_alg_ring_lvl_ici_beta_mb_per_ms": 140.0,
+        "allreduce_size_2_consec_0": 80.0,
+        "allreduce_size_2_consec_0_alg_ring_lvl_dcn_alpha_ms": 0.9,
+        "allreduce_size_2_consec_0_alg_ring_lvl_dcn_beta_mb_per_ms": 30.0,
+    }
+    import io
+
+    buf = io.StringIO()
+    head = summarize_hardware(cfg, "hw.json", out=buf)
+    text = buf.getvalue()
+    assert "ring_ici" in text and "ring_dcn" in text
+    assert "—" in text  # unfitted cells render as em-dash
+    assert head["algo_groups"] == 2
+    # legacy JSON renders without the algo columns
+    buf2 = io.StringIO()
+    summarize_hardware({"allreduce_size_4_consec_1": 120.0}, "hw.json",
+                       out=buf2)
+    assert "ring_ici" not in buf2.getvalue()
+
+
+def test_predicted_comm_hier_alpha_counted_once_across_layers():
+    """The hierarchical schedule runs ONCE per step over the concatenated
+    payload: an L-layer plan's dp[hier] prediction must charge the α
+    terms once (whole-plan volume through one schedule), not L times —
+    matching both the runtime and the summed layer costs."""
+    from hetu_galvatron_tpu.core.cost_model.cost import (
+        CostContext,
+        hier_dp_reduce_ms,
+    )
+    from hetu_galvatron_tpu.core.search_engine.strategies import (
+        SearchStrategy,
+    )
+    from hetu_galvatron_tpu.observability.telemetry import layer_param_mb
+
+    algos = {"2_1": {"ring_ici": (0.05, 200.0)},
+             "2_0": {"ring_dcn": (0.3, 20.0)}}
+    L = 4
+    hpc = _hpc([LayerStrategy(tp_size=1, dp_size=4)] * L)
+    hpc.hier_dp = True
+    out = predicted_comm_per_step(hpc, CFG, alpha_beta_algos=algos,
+                                  dcn_slices=2)
+    grad_total = L * layer_param_mb(CFG) * 0.5
+    want = hier_dp_reduce_ms(
+        SearchStrategy(pp=1, tp=1, dp=4),
+        CostContext(alpha_beta_algos=algos, hier_dp=True, dcn_slices=2),
+        grad_total)
+    assert out["dp"]["algorithms"]["hier"] == pytest.approx(want)

@@ -236,3 +236,88 @@ def test_runtime_profiler_trace_capture(tmp_path):
     prof.stop_trace()  # idempotent
     files = glob.glob(str(tmp_path / "trace" / "**" / "*"), recursive=True)
     assert any(os.path.isfile(f) for f in files), "no trace files written"
+
+
+def test_alpha_beta_degenerate_fit_falls_back(cpu_devices):
+    """Satellite hardening: a noisy fit with a non-positive slope must NOT
+    write a garbage β pair — the (size, consec) falls back to the legacy
+    single-point bandwidth (absent keys), with a warning."""
+    from hetu_galvatron_tpu.core.profiler.hardware_profiler import (
+        fit_alpha_beta,
+    )
+    from hetu_galvatron_tpu.core.search_engine.profiles import (
+        read_alpha_beta,
+    )
+
+    # flat and DECREASING synthetic point sets are both degenerate
+    with pytest.warns(UserWarning, match="degenerate slope"):
+        assert fit_alpha_beta([0.1, 0.5, 1, 2, 4], [1, 1, 1, 1, 1],
+                              label="flat") is None
+    with pytest.warns(UserWarning, match="degenerate slope"):
+        assert fit_alpha_beta([0.1, 0.5, 1, 2, 4],
+                              [5, 4, 3, 2, 1], label="neg") is None
+    # a healthy set still fits (α clamped ≥ 0)
+    pair = fit_alpha_beta([1, 2, 4], [0.9, 2.1, 3.9], label="ok")
+    assert pair is not None and pair[0] >= 0 and pair[1] > 0
+
+    # integration: synthetic sp_times whose size-4 curve is constant ->
+    # profile_alpha_beta emits NO 4_1 pair, and the strided/other groups
+    # it measures live are unaffected (world 2: no strided variant)
+    args = HardwareProfileArgs(num_nodes=1, num_devices_per_node=4,
+                               start_mb=1, end_mb=4, sub_mb_floor_kb=256,
+                               warmup_iters=0, profile_iters=1)
+    prof = HardwareProfiler(args, devices=cpu_devices[:4])
+    sp = {}
+    for size in (4, 2):
+        for kb in (256, 512):
+            sp[f"sub_allreduce_size_{size}_{kb}KB_time"] = (
+                1.0 if size == 4 else kb / 1024.0)
+        for mb in (1, 2, 4):
+            sp[f"allreduce_size_{size}_{mb}MB_time"] = (
+                1.0 if size == 4 else float(mb))
+    with pytest.warns(UserWarning, match="allreduce_size_4_consec_1"):
+        ab = prof.profile_alpha_beta(sp)
+    assert "allreduce_size_4_consec_1_alpha_ms" not in ab
+    assert "allreduce_size_4_consec_1_beta_mb_per_ms" not in ab
+    assert "allreduce_size_2_consec_1_alpha_ms" in ab
+    # the reader sees only the healthy pairs
+    pairs = read_alpha_beta(ab)
+    assert "4_1" not in pairs and "2_1" in pairs
+
+
+def test_alpha_beta_algos_roundtrip(cpu_devices):
+    """profile_alpha_beta_algos fits per-(algorithm, level) pairs from
+    ring vs halving-doubling shaped schedules; read_alpha_beta_algos
+    parses them; the FLAT reader and legacy parsers skip the namespaced
+    keys untouched."""
+    from hetu_galvatron_tpu.core.search_engine.profiles import (
+        read_alpha_beta,
+        read_alpha_beta_algos,
+    )
+
+    args = HardwareProfileArgs(num_nodes=1, num_devices_per_node=4,
+                               start_mb=1, end_mb=4, sub_mb_floor_kb=256,
+                               warmup_iters=0, profile_iters=1)
+    prof = HardwareProfiler(args, devices=cpu_devices[:4])
+    algos = prof.profile_alpha_beta_algos()
+    # full-world group: ici only; sub-world: ici + the strided dcn proxy
+    for key in ("allreduce_size_4_consec_1_alg_ring_lvl_ici_alpha_ms",
+                "allreduce_size_4_consec_1_alg_tree_lvl_ici_alpha_ms",
+                "allreduce_size_2_consec_0_alg_ring_lvl_dcn_alpha_ms"):
+        # CPU timing noise may legitimately drop a degenerate fit; the
+        # schema contract is that whatever IS emitted pairs α with β
+        if key in algos:
+            assert key.replace("_alpha_ms", "_beta_mb_per_ms") in algos
+    table = read_alpha_beta_algos(algos)
+    for group, curves in table.items():
+        for alg_lvl, (a, b) in curves.items():
+            assert a >= 0 and b > 0
+            alg, lvl = alg_lvl.split("_")
+            assert alg in ("ring", "tree") and lvl in ("ici", "dcn")
+    # the namespaced keys are INVISIBLE to the flat reader: merging them
+    # next to flat pairs does not corrupt the legacy table
+    flat = {"allreduce_size_4_consec_1_alpha_ms": 0.5,
+            "allreduce_size_4_consec_1_beta_mb_per_ms": 100.0}
+    merged = {**flat, **algos}
+    assert read_alpha_beta(merged) == read_alpha_beta(flat)
+    assert read_alpha_beta_algos(flat) == {}

@@ -1,0 +1,197 @@
+"""Per-algorithm / per-level collective pricing + the hierarchical dp
+term: the min-over-curves choice must pick the right algorithm per
+message size, the hierarchical term must be able to flip the chosen plan,
+and EMPTY per-algorithm data must leave every cost byte-identical (the
+golden search regressions pin the full-plan version against the legacy
+fixtures)."""
+
+import numpy as np
+import pytest
+
+from hetu_galvatron_tpu.core.cost_model.cost import (
+    CostContext,
+    _algo_min_ms,
+    _tp_message_ms,
+    hier_dp_reduce_ms,
+    hier_dp_wins,
+    layer_time_cost,
+    layer_time_components,
+)
+from hetu_galvatron_tpu.core.search_engine.strategies import SearchStrategy
+
+pytestmark = [pytest.mark.search_engine]
+
+
+def _latency_table(per_mb=0.01):
+    table = {mb: per_mb * mb for mb in (1, 2, 4, 8, 16, 32, 64, 128)}
+    table["popt"] = np.array([per_mb, 0.0])
+    return table
+
+
+def _ctx(**kw):
+    base = dict(
+        parameter_size=48.0, seq_length=128, hidden_size=256, layer_num=4,
+        mixed_precision=True,
+        forward_computation_time=0.05,
+        comm_coe_dict={"8_1": 0.01, "8_0": 0.01, "4_1": 0.01, "4_0": 0.01,
+                       "2_1": 0.01, "2_0": 0.01, "1": 0.0, "1_1": 0.0},
+        dp_overlap_coe=1.1, bct_overlap_coe=1.1,
+        allgather_latency={2: _latency_table(), 4: _latency_table(),
+                           8: _latency_table()},
+        all2all_latency={2: _latency_table(), 4: _latency_table(),
+                         8: _latency_table()},
+    )
+    base.update(kw)
+    return CostContext(**base)
+
+
+def _cost(s, ctx, gbsz=64, chunks=1):
+    return layer_time_cost(s, ctx, gbsz, chunks)[0]
+
+
+TP2 = SearchStrategy(pp=1, tp=2, dp=4)
+TP4 = SearchStrategy(pp=1, tp=4, dp=2)
+DP8 = SearchStrategy(pp=1, tp=1, dp=8)
+
+
+# ---------------------------------------------------------------------------
+# min-over-algorithm-curves
+# ---------------------------------------------------------------------------
+
+
+def test_algo_min_picks_per_message_size():
+    """Ring: low α, low β⁻¹ slope advantage at bulk; tree: high bandwidth
+    cost but tiny α. The min must switch algorithms with the message
+    size — the whole point of fitting per-algorithm curves."""
+    algos = {"4_1": {"ring_ici": (1.0, 100.0),   # 1ms + size/100
+                     "tree_ici": (0.05, 20.0)}}  # 0.05ms + size/20
+    ctx = _ctx(alpha_beta_algos=algos)
+    small = _algo_min_ms(ctx, 4, 1, "ici", 0.1)
+    big = _algo_min_ms(ctx, 4, 1, "ici", 64.0)
+    assert small == pytest.approx(0.05 + 0.1 / 20.0)   # tree wins small
+    assert big == pytest.approx(1.0 + 64.0 / 100.0)    # ring wins big
+    # level filter: no dcn curves fitted -> None
+    assert _algo_min_ms(ctx, 4, 1, "dcn", 1.0) is None
+    assert _algo_min_ms(ctx, 2, 1, "ici", 1.0) is None
+
+
+def test_tp_message_prices_min_of_flat_and_algo_curves():
+    ab = {"2_1": (1.0, 50.0)}
+    algos = {"2_1": {"tree_ici": (0.1, 50.0)}}
+    ctx = _ctx(tp_alpha_beta=ab, alpha_beta_algos=algos)
+    # algo curve cheaper at every size here
+    assert _tp_message_ms(TP2, ctx, 4.0) == pytest.approx(
+        0.5 * (0.1 + 4.0 / 50.0))
+    # without algo data, the flat pair prices it (legacy behavior)
+    ctx2 = _ctx(tp_alpha_beta=ab)
+    assert _tp_message_ms(TP2, ctx2, 4.0) == pytest.approx(
+        0.5 * (1.0 + 4.0 / 50.0))
+
+
+def test_empty_algo_data_costs_byte_identical():
+    """The golden-cost discipline: alpha_beta_algos={} and hier_dp=False
+    (the defaults) reproduce today's costs bit-for-bit."""
+    for s in (TP2, TP4, DP8):
+        assert _cost(s, _ctx()) == _cost(
+            s, _ctx(alpha_beta_algos={}, hier_dp=False))
+
+
+def test_algo_pairs_flip_the_chosen_plan():
+    """PINNED plan flip: with slow flat measured tables, tp4 wins (cheap
+    dp sync); fitted per-algorithm ICI curves that are much faster at
+    size 2 than size 4 flip the winner to tp2 — the choice the
+    single-curve model cannot express."""
+    coe = {"8_1": 0.1, "8_0": 0.1, "4_1": 0.1, "4_0": 0.1,
+           "2_1": 0.1, "2_0": 0.1, "1": 0.0, "1_1": 0.0}
+    ctx = _ctx(comm_coe_dict=coe)
+    assert _cost(TP4, ctx) < _cost(TP2, ctx)
+    algos = {"2_1": {"ring_ici": (0.01, 500.0), "tree_ici": (0.005, 80.0)},
+             "4_1": {"ring_ici": (2.0, 60.0), "tree_ici": (1.5, 30.0)}}
+    ctx_a = _ctx(comm_coe_dict=coe, alpha_beta_algos=algos)
+    assert _cost(TP2, ctx_a) < _cost(TP4, ctx_a)
+
+
+# ---------------------------------------------------------------------------
+# hierarchical dp term
+# ---------------------------------------------------------------------------
+
+
+def _hier_algos():
+    return {
+        # intra-host level: size 4 ICI ring/tree curves
+        "4_1": {"ring_ici": (0.1, 200.0), "tree_ici": (0.2, 100.0)},
+        # cross-slice level: size 2 DCN curves (slow links)
+        "2_0": {"ring_dcn": (1.0, 10.0)},
+        "2_1": {"ring_ici": (0.05, 300.0)},
+        "8_1": {"ring_ici": (0.2, 150.0)},
+    }
+
+
+def test_hier_dp_reduce_ms_hand_math():
+    """dp8 over 2 slices: intra=4, cross=2. Time = allreduce_ici(4, V)
+    (the rs+ag halves) + allreduce_dcn(2, V/4)."""
+    ctx = _ctx(hier_dp=True, dcn_slices=2, alpha_beta_algos=_hier_algos())
+    V = 12.0
+    want = (0.1 + V / 200.0) + (1.0 + (V / 4) / 10.0)
+    assert hier_dp_reduce_ms(DP8, ctx, V) == pytest.approx(want)
+    # missing dcn curve -> None (flat pricing stays)
+    algos = {"4_1": _hier_algos()["4_1"]}
+    ctx2 = _ctx(hier_dp=True, dcn_slices=2, alpha_beta_algos=algos)
+    assert hier_dp_reduce_ms(DP8, ctx2, V) is None
+    # disabled -> None regardless of curves
+    ctx3 = _ctx(hier_dp=False, alpha_beta_algos=_hier_algos())
+    assert hier_dp_reduce_ms(DP8, ctx3, V) is None
+    # cp / ulysses layers are ineligible
+    assert hier_dp_reduce_ms(
+        SearchStrategy(pp=1, tp=1, cp=2, dp=4), ctx, V) is None
+
+
+def test_hier_dp_term_flips_the_chosen_plan():
+    """PINNED hier flip: with a slow flat dp coefficient, tp4xdp2 beats
+    tp1xdp8 (less dp traffic); the hierarchical curves make the big dp
+    group cheap (fast intra-host level + tiny cross shard), flipping the
+    winner to dp8 — and hier_dp_wins records the choice for the plan."""
+    coe = {"8_1": 0.4, "8_0": 0.4, "4_1": 0.4, "4_0": 0.4,
+           "2_1": 0.4, "2_0": 0.4, "1": 0.0, "1_1": 0.0}
+    ctx = _ctx(comm_coe_dict=coe)
+    assert _cost(TP4, ctx) < _cost(DP8, ctx)
+    ctx_h = _ctx(comm_coe_dict=coe, hier_dp=True, dcn_slices=2,
+                 alpha_beta_algos=_hier_algos())
+    assert _cost(DP8, ctx_h) < _cost(TP4, ctx_h)
+    assert hier_dp_wins(DP8, ctx_h, 64, 1)
+    assert not hier_dp_wins(DP8, ctx, 64, 1)
+
+
+def test_hier_enabled_never_raises_cost():
+    """min(flat, hier): at FIXED curves, turning the hier pricing on can
+    only lower a cost (the algo curves themselves may reprice tp either
+    way — that's the min-over-curves tests' subject, not this one)."""
+    for s in (TP2, TP4, DP8):
+        flat = _cost(s, _ctx(alpha_beta_algos=_hier_algos()))
+        hier = _cost(s, _ctx(hier_dp=True, dcn_slices=2,
+                             alpha_beta_algos=_hier_algos()))
+        assert hier <= flat + 1e-15
+
+
+def test_components_reflect_hier_choice():
+    """When the hierarchical term priced the layer, the audit-facing
+    decomposition reports the hierarchical dp time."""
+    coe = {"8_1": 0.4, "8_0": 0.4, "4_1": 0.4, "4_0": 0.4,
+           "2_1": 0.4, "2_0": 0.4, "1": 0.0, "1_1": 0.0}
+    ctx_h = _ctx(comm_coe_dict=coe, hier_dp=True, dcn_slices=2,
+                 alpha_beta_algos=_hier_algos())
+    comp = layer_time_components(DP8, ctx_h, 64, 1)
+    V = 48.0 / 1 * 4 * 0.5  # param_mb * n * mixed
+    want = hier_dp_reduce_ms(DP8, ctx_h, V)
+    assert comp["dp_ms"] * 4 == pytest.approx(want)  # scale = coe/n
+
+
+def test_hier_split_absorbs_pp_first():
+    """dcn_slices absorb pp before dp (mesh.dcn_factor_shape parity):
+    pp2 x dp4 under 2 slices has NO cross-slice dp level — the hier term
+    needs only the intra curves."""
+    s = SearchStrategy(pp=2, tp=1, dp=4)
+    algos = {"4_1": {"ring_ici": (0.1, 200.0)}}
+    ctx = _ctx(hier_dp=True, dcn_slices=2, alpha_beta_algos=algos)
+    V = 10.0
+    assert hier_dp_reduce_ms(s, ctx, V) == pytest.approx(0.1 + V / 200.0)

@@ -69,6 +69,10 @@ LEGS: Tuple[Tuple[str, str, bool], ...] = (
     # verify program; regresses DOWN)
     ("serve_prefix", "serve_prefix_ttft_ratio", False),
     ("spec_decode", "spec_decode_tokens_ratio", True),
+    # hierarchical-vs-flat dp gradient reduction (tools/hier_dp_bench.py):
+    # lane-accumulated rs/ar/ag once per step vs the flat GSPMD in-scan
+    # all-reduce. A ratio, regresses UP.
+    ("hier_dp", "hier_dp_vs_flat", False),
 )
 
 
@@ -210,17 +214,22 @@ def smoke() -> int:
     base = {"device": "TPU v5 lite",
             "legs": {"mfu_pct": 40.0, "tokens_per_sec": 100000.0,
                      "compiled_vs_host": 0.7, "compiled_overlap": 0.75,
-                     "serve_prefix": 0.3, "spec_decode": 1.4}}
+                     "serve_prefix": 0.3, "spec_decode": 1.4,
+                     "hier_dp": 0.85}}
     same = {"device": "TPU v5 lite",
             "legs": {"mfu_pct": 39.2, "tokens_per_sec": 98000.0,
                      "compiled_vs_host": 0.72, "compiled_overlap": 0.77,
-                     "serve_prefix": 0.31, "spec_decode": 1.37}}
+                     "serve_prefix": 0.31, "spec_decode": 1.37,
+                     "hier_dp": 0.87}}
     bad = {"device": "TPU v5 lite",
            "legs": {"mfu_pct": 40.1, "tokens_per_sec": 80000.0,
                     "compiled_vs_host": 0.95, "compiled_overlap": 1.2,
                     # serve_prefix regresses UP (hits stop skipping
                     # prefill), spec_decode DOWN (drafts stop paying)
-                    "serve_prefix": 0.9, "spec_decode": 0.8}}
+                    "serve_prefix": 0.9, "spec_decode": 0.8,
+                    # hier_dp regresses UP (the hierarchical schedule
+                    # stops beating the flat all-reduce)
+                    "hier_dp": 1.3}}
     other_dev = {"device": "cpu", "legs": {"mfu_pct": 5.0}}
 
     rows, ok_same = compare(base, same, threshold=0.10)
@@ -238,7 +247,7 @@ def smoke() -> int:
     healthy = (ok_same and not ok_bad
                and regressed == {"tokens_per_sec", "compiled_vs_host",
                                  "compiled_overlap", "serve_prefix",
-                                 "spec_decode"}
+                                 "spec_decode", "hier_dp"}
                and ok_dev
                and all(r["status"].startswith("skipped") for r in rows)
                and "NO VERDICT" in buf.getvalue())

@@ -138,6 +138,9 @@ def build_bench_candidate():
     if co and isinstance(co.get("compiled_overlap_vs_host"), (int, float)):
         base.setdefault("compiled_overlap_vs_host",
                         co["compiled_overlap_vs_host"])
+    hd = _last_json_line(os.path.join(LOG_DIR, "hier_dp.log"))
+    if hd and isinstance(hd.get("hier_dp_vs_flat"), (int, float)):
+        base.setdefault("hier_dp_vs_flat", hd["hier_dp_vs_flat"])
     path = os.path.join(LOG_DIR, "bench_candidate.json")
     with open(path, "w") as f:
         json.dump({"parsed": base}, f, indent=2)
@@ -217,6 +220,11 @@ def main() -> int:
         ("compiled_overlap", [py, os.path.join(ROOT, "tools",
                                                "pipeline_dispatch_bench.py"),
                               "--kernels", "--tpu"], 1800, None),
+        # hierarchical-vs-flat dp gradient reduction: on multi-slice
+        # topologies this is where the per-level schedule shows (the
+        # cross-slice hop carries only the 1/intra shard over DCN)
+        ("hier_dp", [py, os.path.join(ROOT, "tools", "hier_dp_bench.py"),
+                     "--tpu"], 1800, None),
         ("bench", [py, os.path.join(ROOT, "bench.py")], 1100, None),
     ]
     for name, argv, deadline, env_extra in steps:
