@@ -150,6 +150,8 @@ def run_leg(spec: dict, journal: str) -> int:
             else:
                 emit("ok", hier_dp_vs_flat=out["hier_dp_vs_flat"],
                      hier_dp_recompiles=out["hier_dp_recompiles"],
+                     hier_dp_bucketed_vs_mono=out.get(
+                         "hier_dp_bucketed_vs_mono"),
                      hier_dp_legs=out["legs"], platform=out["platform"])
             return 0
         if spec.get("kind") in ("serve_prefix", "spec_decode"):
@@ -716,9 +718,17 @@ def main() -> int:
         if res["status"] == "ok":
             hier_ab = {"hier_dp_vs_flat": res["hier_dp_vs_flat"],
                        "hier_dp_recompiles": res["hier_dp_recompiles"]}
+            if isinstance(res.get("hier_dp_bucketed_vs_mono"),
+                          (int, float)):
+                # bucketed software-pipelined schedule vs the monolithic
+                # hier program (tools/hier_dp_bench.py bucketed leg)
+                hier_ab["hier_dp_bucketed_vs_mono"] = \
+                    res["hier_dp_bucketed_vs_mono"]
             print(f"bench hier-dp A/B: hier_dp_vs_flat "
                   f"{res['hier_dp_vs_flat']} (recompiles "
-                  f"{res['hier_dp_recompiles']})", file=sys.stderr)
+                  f"{res['hier_dp_recompiles']}; bucketed-vs-mono "
+                  f"{res.get('hier_dp_bucketed_vs_mono')})",
+                  file=sys.stderr)
         else:
             print(f"warning: hier-dp A/B leg failed: {res.get('error')}",
                   file=sys.stderr)

@@ -251,11 +251,12 @@ def census_compiled_step(cfg: Any, hpc: Any, train: Any, *,
 
 def trace_spmd_step(cfg: Any, hpc: Any, train: Any, mesh: Any,
                     *, tp_overlap: bool = True, hier_dp: bool = False,
-                    dcn_slices: int = 1):
+                    dcn_slices: int = 1, hier_bucket_mb: float = 0.0):
     """ClosedJaxpr of the pp=1 SPMD train step (``parallel.spmd``) —
     tracing only, nothing executes. Shared by the count census and the
     sharding-flow byte census; ``hier_dp`` traces the hierarchical dp
-    gradient-reduction variant (``ops/hier_reduce.py``)."""
+    gradient-reduction variant (``ops/hier_reduce.py``),
+    ``hier_bucket_mb`` its bucketed software-pipelined flavour."""
     import jax
     import jax.numpy as jnp
 
@@ -268,7 +269,7 @@ def trace_spmd_step(cfg: Any, hpc: Any, train: Any, mesh: Any,
     step, pspecs, ospecs, _ = make_spmd_train_step(
         cfg, hpc, mesh, axes, tx, params, compute_dtype=jnp.float32,
         donate=True, tp_overlap=tp_overlap, hier_dp=hier_dp,
-        dcn_slices=dcn_slices)
+        dcn_slices=dcn_slices, hier_bucket_mb=hier_bucket_mb)
     sp_shape = jax.tree.map(
         lambda p: jax.ShapeDtypeStruct(p.shape, p.dtype), params)
     so_shape = jax.eval_shape(tx.init, sp_shape)
@@ -278,11 +279,12 @@ def trace_spmd_step(cfg: Any, hpc: Any, train: Any, mesh: Any,
 
 def census_spmd_step(cfg: Any, hpc: Any, train: Any, mesh: Any,
                      *, tp_overlap: bool = True, hier_dp: bool = False,
-                     dcn_slices: int = 1) -> CensusResult:
+                     dcn_slices: int = 1,
+                     hier_bucket_mb: float = 0.0) -> CensusResult:
     """Trace the pp=1 SPMD train step (``parallel.spmd``) and census it."""
     return census_jaxpr(trace_spmd_step(
         cfg, hpc, train, mesh, tp_overlap=tp_overlap, hier_dp=hier_dp,
-        dcn_slices=dcn_slices))
+        dcn_slices=dcn_slices, hier_bucket_mb=hier_bucket_mb))
 
 
 def trace_serving_programs(cfg: Any, *, mesh: Any = None, hpc: Any = None,

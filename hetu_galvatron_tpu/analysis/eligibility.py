@@ -214,6 +214,9 @@ HIER_KERNEL_REASON = ("shard_map kernels (tp_overlap rings / flash / "
 HIER_DROPOUT_REASON = ("dropout: per-lane rng streams would draw masks "
                        "the flat path never draws (trajectories diverge "
                        "beyond reduction reassociation)")
+HIER_ZIGZAG_REASON = ("zigzag-cp: sequences arrive pre-permuted for the "
+                      "ring kernel's layout, and the lane path's GSPMD "
+                      "attention would causally mask them by array order")
 
 
 def hier_dp_unsupported_reason(
@@ -229,25 +232,33 @@ def hier_dp_unsupported_reason(
     dropout: float = 0.0,
     vtp: int = 1,
     vcp: int = 1,
+    cp_zigzag: bool = False,
 ) -> Optional[str]:
     """None when the hierarchical dp gradient-reduction path can run this
     plan; otherwise the reason the launcher logs before keeping the flat
     GSPMD all-reduce. The same predicate gates the runtime engines, the
     cost model's hierarchical dp term
     (:func:`search_hier_dp_expressible`), and the count/byte predictions
-    (``telemetry.plan_collective_counts/bytes``)."""
+    (``telemetry.plan_collective_counts/bytes``).
+
+    cp/Ulysses-bearing sdp groups ARE eligible at the plan level: the lane
+    vmap covers the dp axes (``spmd_axis_name`` takes the full dp-axis
+    tuple) and the per-lane grads stay partial over the cp/sequence axes,
+    which the in-lane partitioner reduces over the small ICI-local group —
+    the big once-per-microbatch dp ring is still what moves out of the
+    scan. The REMAINING cp/sp gate is a kernel-dispatch property: the
+    pp>1 engines keep their stage-stacked ring/a2a kernels (cannot nest
+    under the lane vmap — they raise :data:`HIER_KERNEL_REASON`), while
+    the pp=1 SPMD path swaps those layers to the GSPMD attention core.
+    Zigzag-cp stays ineligible here (:data:`HIER_ZIGZAG_REASON`): its
+    dataloader-permuted layout is only correct under the ring kernel."""
     if not uniform_strategies:
         return ("heterogeneous per-layer strategies (one dp lane split "
                 "must cover every layer)")
     if dp < 2:
         return "dp == 1 (no data-parallel gradient ring to decompose)"
-    if ulysses:
-        return ("ulysses layer: gradients are partial over the "
-                "sequence-parallel axis too, which the dp lane split does "
-                "not model")
-    if cp > 1:
-        return ("cp layer: gradients are partial over the cp ring too, "
-                "which the dp lane split does not model")
+    if cp_zigzag:
+        return HIER_ZIGZAG_REASON
     if not tp_consecutive:
         return ("non-consecutive tp: the dp axes are not a contiguous "
                 "leading mesh run, so they cannot regroup into "
@@ -284,16 +295,25 @@ def plan_hier_dp_reason(cfg: Any, hpc: Any) -> Optional[str]:
         dropout=max(cfg.hidden_dropout, cfg.attention_dropout),
         vtp=hpc.vocab.vtp,
         vcp=hpc.vocab.vcp,
+        cp_zigzag=bool(getattr(hpc, "cp_zigzag", False)),
     )
 
 
 def search_hier_dp_expressible(s: Any, enabled: bool) -> bool:
     """Cost-model adapter (``cost_model.cost``): can this candidate layer
     earn the hierarchical dp pricing? The degree-level half of
-    :func:`hier_dp_unsupported_reason` — dp > 1, Megatron-TP only (no
-    cp/Ulysses); the model-level gates (t5/MoE/dropout/vocab overlap) are
-    resolved by the runtime and the plan doctor."""
-    return bool(enabled) and s.dp > 1 and s.cp == 1 and s.sp == 1
+    :func:`hier_dp_unsupported_reason` — dp > 1; cp/Ulysses layers
+    qualify on the pp=1 SPMD path only (the pp engines keep their
+    stage-stacked ring/a2a kernels, which cannot nest under the lane vmap
+    — :data:`HIER_KERNEL_REASON` — so the search must not price what the
+    runtime will reject: search==runtime parity). The model-level gates
+    (t5/MoE/dropout/zigzag/vocab overlap) are resolved by the runtime and
+    the plan doctor."""
+    if not (bool(enabled) and s.dp > 1):
+        return False
+    if s.cp == 1 and s.sp == 1:
+        return True
+    return s.pp == 1
 
 
 # ---------------------------------------------------------------------------

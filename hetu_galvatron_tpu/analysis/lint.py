@@ -224,11 +224,31 @@ class _Visitor(ast.NodeVisitor):
                 self._add("GAL004", node,
                           "f-string named_scope breaks trace-marker "
                           "matching (use a module-level constant)")
-            elif not isinstance(a, (ast.Constant, ast.Name, ast.Attribute)):
+            elif not (isinstance(a, (ast.Constant, ast.Name, ast.Attribute))
+                      or self._is_marker_preserving_scope(a)):
                 self._add("GAL004", node,
                           "computed named_scope name breaks trace-marker "
                           "matching (use a module-level constant)")
         self.generic_visit(node)
+
+    @staticmethod
+    def _is_marker_preserving_scope(a: ast.AST) -> bool:
+        """``hier_stage_scope(CONSTANT-or-NAME, ...)`` calls are
+        marker-preserving by contract (ops/hier_reduce.py): the base scope
+        stays a PREFIX of the returned name (bare at one bucket,
+        ``_b{i}``-suffixed otherwise), so every substring consumer — trace
+        attribution's ``_HIER_MARKERS``, the flow pass's ``hier_dp_ag``
+        gather exemption — still matches. Only the first argument being a
+        constant/name matters; a computed BASE would break matching and
+        stays a finding."""
+        if not (isinstance(a, ast.Call) and isinstance(
+                a.func, (ast.Name, ast.Attribute))):
+            return False
+        fn = (a.func.id if isinstance(a.func, ast.Name)
+              else a.func.attr)
+        return (fn == "hier_stage_scope" and bool(a.args)
+                and isinstance(a.args[0], (ast.Constant, ast.Name,
+                                           ast.Attribute)))
 
     def _check_axis_literals(self, node: ast.AST) -> None:
         lits: List[Tuple[ast.AST, str]] = []

@@ -375,16 +375,18 @@ def flow_compiled_step(cfg: Any, hpc: Any, train: Any, *,
 
 def flow_spmd_step(cfg: Any, hpc: Any, train: Any, mesh: Any, *,
                    tp_overlap: bool = True, hier_dp: bool = False,
-                   dcn_slices: int = 1,
+                   dcn_slices: int = 1, hier_bucket_mb: float = 0.0,
                    gather_mb: float = 1.0) -> ProgramFlow:
     """Trace the pp=1 SPMD train step (``census.trace_spmd_step``) and run
     the full byte-side analysis — the hook the hierarchical-dp drill uses
     to cross-check the reduce-scatter/all-reduce/all-gather payloads
-    against ``plan_collective_bytes`` exactly."""
+    (per-bucket under ``hier_bucket_mb``) against
+    ``plan_collective_bytes`` exactly."""
     from hetu_galvatron_tpu.analysis.census import trace_spmd_step
 
     jaxpr = trace_spmd_step(cfg, hpc, train, mesh, tp_overlap=tp_overlap,
-                            hier_dp=hier_dp, dcn_slices=dcn_slices)
+                            hier_dp=hier_dp, dcn_slices=dcn_slices,
+                            hier_bucket_mb=hier_bucket_mb)
     return ProgramFlow(
         name="spmd_step", flow=flow_jaxpr(jaxpr),
         donation=donation_report(jaxpr),

@@ -227,6 +227,15 @@ def train(args) -> Dict[str, Any]:
     # plan's "hier_dp": 1 key, ops/hier_reduce.py): resolve eligibility
     # once, log the fallback reason, remember the slice/host split
     hier_dp_on = bool(args.parallel.hier_dp or hpc.hier_dp)
+    # bucketed pipelining granularity: an explicit parallel setting wins,
+    # else the searched plan's recorded size (cost.hier_dp_best_bucket).
+    # The RESOLVED size is written back onto hpc so every downstream
+    # consumer that reads the plan (the exit audit's
+    # predicted_comm_per_step prices hpc.hier_bucket_mb) sees the
+    # granularity the runtime actually pipelines at, not just the plan's
+    hier_bucket_mb = float(args.parallel.hier_bucket_mb
+                           or hpc.hier_bucket_mb)
+    hpc.hier_bucket_mb = hier_bucket_mb
     if hier_dp_on:
         from hetu_galvatron_tpu.analysis.eligibility import (
             HIER_KERNEL_REASON,
@@ -235,6 +244,11 @@ def train(args) -> Dict[str, Any]:
 
         hier_reason = plan_hier_dp_reason(cfg, hpc)
         if hier_reason is None and tp_overlap_on:
+            hier_reason = HIER_KERNEL_REASON
+        if hier_reason is None and hpc.pp_deg > 1 and any(
+                s.cp_size > 1 or s.sp for s in hpc.layers):
+            # the pp engines keep their stage-stacked ring-cp/ulysses
+            # kernels (the pp=1 SPMD path swaps them for the GSPMD core)
             hier_reason = HIER_KERNEL_REASON
         if hier_reason is None and cfg.use_flash_attn and all(
                 d.platform == "tpu" for d in state.devices[:1]):
@@ -251,9 +265,11 @@ def train(args) -> Dict[str, Any]:
             _dp = hpc.layers[0].dp_size
             _cross = hier_cross_degree(hpc.pp_deg, _dp,
                                        args.parallel.dcn_slices)
+            _bkt = (f"; {hier_bucket_mb:g} MB buckets, pipelined"
+                    if hier_bucket_mb > 0 else "")
             state.log("hier_dp: hierarchical gradient reduction on "
                       f"(dp {_dp} = {_cross} slice x {_dp // _cross} host;"
-                      " rs-intra / ar-cross / ag-intra, once per step)")
+                      f" rs-intra / ar-cross / ag-intra, once per step{_bkt})")
 
     def finish_tp_overlap_setup(step_fn):
         """Once the engine choice has settled: emit the coverage gauge and
@@ -756,7 +772,7 @@ def train(args) -> Dict[str, Any]:
                     dcn_slices=args.parallel.dcn_slices,
                     donate=not rerun.enabled,
                     tp_overlap=tp_overlap_on,
-                    hier_dp=hier_dp_on)
+                    hier_dp=hier_dp_on, hier_bucket_mb=hier_bucket_mb)
                 if tp_overlap_on and not eng.tp_overlap:
                     state.log("tp_overlap: no eligible layer under the "
                               f"compiled schedule ({eng.overlap_reason}); "
@@ -772,7 +788,8 @@ def train(args) -> Dict[str, Any]:
                                  compute_dtype=compute_dtype,
                                  dcn_slices=args.parallel.dcn_slices,
                                  tp_overlap=tp_overlap_on,
-                                 hier_dp=hier_dp_on)
+                                 hier_dp=hier_dp_on,
+                                 hier_bucket_mb=hier_bucket_mb)
         sp = eng.split_params(params, axes)
         so = eng.init_opt(sp, axes)
         sp, so, start_iter = maybe_resume(sp, so)
@@ -793,7 +810,8 @@ def train(args) -> Dict[str, Any]:
         step, pspecs, ospecs, batch_shd = make_spmd_train_step(
             cfg, hpc, mesh, axes, tx, params, compute_dtype=compute_dtype,
             donate=not rerun.enabled, tp_overlap=tp_overlap_on,
-            hier_dp=hier_dp_on, dcn_slices=args.parallel.dcn_slices)
+            hier_dp=hier_dp_on, dcn_slices=args.parallel.dcn_slices,
+            hier_bucket_mb=hier_bucket_mb)
         nshd = jax.tree.map(
             lambda s: NamedSharding(mesh, s), ospecs,
             is_leaf=lambda x: isinstance(x, PartitionSpec))
@@ -811,7 +829,8 @@ def train(args) -> Dict[str, Any]:
                     compute_dtype=compute_dtype,
                     donate=not rerun.enabled, chunks=ch,
                     tp_overlap=tp_overlap_on, hier_dp=hier_dp_on,
-                    dcn_slices=args.parallel.dcn_slices)[0]
+                    dcn_slices=args.parallel.dcn_slices,
+                    hier_bucket_mb=hier_bucket_mb)[0]
             return step_cache[ch]
 
         def spmd_step(sp, so, raw):

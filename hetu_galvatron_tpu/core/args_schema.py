@@ -196,11 +196,31 @@ class ParallelArgs(BaseModel):
     # lane vmap) fall back to the flat path with a logged reason. A
     # searched plan may also carry "hier_dp": 1 (either source enables it)
     hier_dp: bool = False
+    # bucketed software pipelining of the hierarchical reduction
+    # (ops/hier_reduce.py hier_bucket_layout): the concatenated grad
+    # payload splits into <=hier_bucket_mb-MB buckets whose rs-intra /
+    # ar-cross / ag-intra chains are emitted in wavefront order, so bucket
+    # i's DCN stage overlaps bucket i±1's ICI stages — steady state
+    # approaches max(sum T_ici, T_dcn) instead of their sum. 0 (default)
+    # keeps today's single monolithic bucket, byte-identical program. A
+    # searched plan may carry "hier_bucket_mb" (parallel setting wins when
+    # nonzero); results are bit-consistent across bucket sizes (each
+    # element rides the same three-collective association)
+    hier_bucket_mb: float = 0.0
 
     @model_validator(mode="after")
     def _check(self):
         if self.config_mode == "json" and not self.galvatron_config_path:
             raise ValueError("config_mode=json requires galvatron_config_path")
+        if self.hier_bucket_mb < 0:
+            # the <0 auto-sweep convention is SEARCH-side only
+            # (search.hier_bucket_mb); the runtime needs an explicit size,
+            # and a truthy negative would silently override a plan's
+            # recorded bucket size into the monolithic schedule
+            raise ValueError(
+                "parallel.hier_bucket_mb must be >= 0 (the < 0 auto-sweep "
+                "mode lives in search.hier_bucket_mb; the winning plan "
+                "records the chosen size)")
         return self
 
 
@@ -568,6 +588,15 @@ class SearchArgs(BaseModel):
     # and every golden cost stays byte-identical. The winning plan records
     # "hier_dp": 1 when the hierarchical term priced its dp reduction.
     hier_dp: int = 0
+    # Bucketed software-pipelining granularity for the hierarchical dp
+    # pricing (cost_model.cost.hier_dp_reduce_ms): > 0 prices the
+    # pipelined schedule at that bucket size (fill-drain: first bucket
+    # pays the full rs+ar+ag chain, the rest pay the bottleneck stage —
+    # per-bucket α overhead vs overlap win); < 0 sweeps power-of-two
+    # bucket sizes (1..64 MB) and records the argmin in the winning plan
+    # ("hier_bucket_mb"); 0 keeps the monolithic three-collective price,
+    # byte-identical goldens.
+    hier_bucket_mb: float = 0.0
 
 
 class ModelProfileArgs(BaseModel):

@@ -94,6 +94,48 @@ def _group_devices(devices: Sequence, size: int, consecutive: bool,
     return [devices[i * stride] for i in range(size)]
 
 
+def _dcn_group_devices(devices: Sequence, size: int, world: int
+                       ) -> Tuple[List, str]:
+    """A ``size``-device group whose links actually cross the DCN seam,
+    plus the level-source tag recorded in the fitted JSON metadata.
+
+    Multi-process jobs (``jax.process_count() > 1``) pick devices
+    round-robin across processes (slice boundaries granule by process on
+    pods without ``slice_index``), so every hop in the benchmarked
+    collective crosses a host/slice boundary — a TRUE DCN measurement.
+    Single-process runs (CPU tests, one-slice jobs) keep the maximally
+    STRIDED proxy group with a warning: its hops measure intra-host
+    stride, not a slice boundary, so the fitted "dcn" α/β only bound the
+    topology model until a real multi-slice fleet re-measures them
+    (tools/tpu_measure_all.py)."""
+    devices = list(devices[:world])
+    by_proc: Dict[int, List] = {}
+    for d in devices:
+        by_proc.setdefault(getattr(d, "process_index", 0), []).append(d)
+    if len(by_proc) > 1:
+        # interleave one device per process until the group is full:
+        # adjacent group members always sit in different processes
+        group: List = []
+        ranks = sorted(by_proc)
+        i = 0
+        while len(group) < size:
+            proc = by_proc[ranks[i % len(ranks)]]
+            if proc:
+                group.append(proc.pop(0))
+            i += 1
+            if i > 10 * size * len(ranks):  # all pools drained
+                break
+        if len(group) == size:
+            return group, "multihost"
+    warnings.warn(
+        "profile_alpha_beta_algos: single-process fleet — the 'dcn' "
+        "level falls back to the strided intra-host PROXY group, which "
+        "measures stride, not a slice boundary; re-measure on a "
+        "multi-slice fleet before trusting the DCN α/β",
+        stacklevel=2)
+    return _group_devices(devices, size, False, world), "proxy-strided"
+
+
 class HardwareProfiler:
     def __init__(self, args: HardwareProfileArgs,
                  devices: Optional[Sequence] = None):
@@ -375,19 +417,35 @@ class HardwareProfiler:
         ``profiles.read_alpha_beta_algos`` parses them; the flat reader
         and every legacy parser skip them. Degenerate fits are dropped
         with a warning (:func:`fit_alpha_beta`), falling back per
-        (size, algorithm, level) to whatever coarser model remains."""
+        (size, algorithm, level) to whatever coarser model remains.
+
+        The ``dcn`` level's group is TRUE multi-host when the job spans
+        processes (one device per process round-robin,
+        :func:`_dcn_group_devices` — every hop crosses the DCN seam);
+        single-process runs keep the strided intra-host proxy with a
+        warning, and the emitted ``dcn_level_source`` metadata key
+        records which one measured the curves ("multihost" |
+        "proxy-strided") so a fitted JSON can never silently pass a
+        proxy off as a fleet measurement. Legacy parsers skip the
+        non-``allreduce_size_`` key."""
         fit_sizes = self._sub_mb_sizes() + [float(self.args.start_mb),
                                             float(self.args.start_mb * 2),
                                             float(self.args.start_mb * 4)]
         out: Dict[str, float] = {}
+        dcn_source: Optional[str] = None
         size = self.world
         while size >= 2:
             levels = [("ici", 1)]
             if size < self.world:
                 levels.append(("dcn", 0))
             for lvl, consec in levels:
-                group = _group_devices(self.devices, size, bool(consec),
-                                       self.world)
+                if lvl == "dcn":
+                    group, src = _dcn_group_devices(self.devices, size,
+                                                    self.world)
+                    dcn_source = dcn_source or src
+                else:
+                    group = _group_devices(self.devices, size, bool(consec),
+                                           self.world)
                 for alg in ("ring", "tree"):
                     xs, ys = [], []
                     for mb in fit_sizes:
@@ -402,6 +460,8 @@ class HardwareProfiler:
                     out[f"{key}_alpha_ms"] = round(alpha, 6)
                     out[f"{key}_beta_mb_per_ms"] = round(beta, 3)
             size //= 2
+        if dcn_source is not None:
+            out["dcn_level_source"] = dcn_source
         return out
 
     def profile_overlap_coefficient(self, message_mb: int = 64) -> Dict:

@@ -214,6 +214,7 @@ class SearchEngine:
             tp_overlap=bool(self.args.tp_overlap),
             alpha_beta_algos=hw.alpha_beta_algos,
             hier_dp=bool(self.args.hier_dp),
+            hier_bucket_mb=float(getattr(self.args, "hier_bucket_mb", 0.0)),
             # the search's topology model: nodes are the cross-DCN level
             # (mesh.dcn_factor_shape's slice granularity)
             dcn_slices=max(self.args.num_nodes, 1),
@@ -706,8 +707,13 @@ class SearchEngine:
         # priced EVERY layer's dp reduction (cost.hier_dp_wins) — the
         # runtime then enables the matching ops/hier_reduce.py path
         hier_chosen = False
+        hier_bucket = 0.0
         if self.args.hier_dp:
-            from hetu_galvatron_tpu.core.cost_model.cost import hier_dp_wins
+            from hetu_galvatron_tpu.core.cost_model.cost import (
+                hier_dp_best_bucket,
+                hier_dp_wins,
+                hier_grad_payload_mb,
+            )
 
             li = 0
             flags = []
@@ -718,6 +724,19 @@ class SearchEngine:
                         best.bsz, best.chunks))
                     li += 1
             hier_chosen = bool(flags) and all(flags)
+            if hier_chosen:
+                # record the bucket granularity the price assumed: the
+                # configured size, or — auto mode (hier_bucket_mb < 0) —
+                # the sweep's argmin over the first layertype's whole
+                # grad payload, so the runtime pipelines at exactly the
+                # granularity the search paid for
+                ctx0 = self.contexts[0]
+                s0 = best.strategy_list[0]
+                if ctx0.hier_bucket_mb < 0:
+                    _, hier_bucket = hier_dp_best_bucket(
+                        s0, ctx0, hier_grad_payload_mb(s0, ctx0))
+                else:
+                    hier_bucket = max(ctx0.hier_bucket_mb, 0.0)
         cfg = strategy_list2config(
             runtime, global_bsz=best.bsz, chunks=best.chunks,
             pipeline_type=self.pipeline_type,
@@ -728,7 +747,7 @@ class SearchEngine:
             pp_division=best.pp_stage_list,
             num_encoder_layers=getattr(self, "num_encoder_layers", None),
             predicted_layer_compute_ms=pred_ms,
-            hier_dp=hier_chosen)
+            hier_dp=hier_chosen, hier_bucket_mb=hier_bucket)
         a = self.args
         off = [name for flag, name in (
             (a.disable_dp, "dp"), (a.disable_tp, "tp"), (a.disable_pp, "pp"),

@@ -384,6 +384,8 @@ def plan_collective_counts(
     num_microbatches: Optional[int] = None,
     tp_overlap: bool = True,
     hier_dp: bool = False,
+    hier_bucket_mb: float = 0.0,
+    hier_cross: int = 1,
 ) -> Dict[str, int]:
     """Predicted EXECUTED explicit-collective counts for the compiled
     single-program 1F1B step — the count-side companion of
@@ -407,12 +409,18 @@ def plan_collective_counts(
     ``tp - 1`` ppermute hops. The stage rotations add 2 ppermutes per tick
     (activations forward, cotangents backward).
 
-    ``hier_dp=True`` adds the hierarchical dp gradient reduction's three
+    ``hier_dp=True`` adds the hierarchical dp gradient reduction's
     explicit collectives (``ops/hier_reduce.py``): the whole grad tree
-    flattens into ONE payload per step, so exactly one ``reduce_scatter``
-    (psum_scatter over the host sub-axis), one ``all_reduce`` (psum over
-    the slice sub-axis) and one ``all_gather`` — independent of the
-    microbatch count (lane accumulation is reduction-free in-scan).
+    flattens into ONE payload per step, split into ``B`` buckets by
+    ``hier_bucket_layout`` (``hier_bucket_mb``; B = 1 at the 0 default),
+    so exactly B ``reduce_scatter`` (psum_scatter over the host
+    sub-axis), B ``all_reduce`` (psum over the slice sub-axis) and B
+    ``all_gather`` — independent of the microbatch count (lane
+    accumulation is reduction-free in-scan). Bucketed counts need the
+    payload size, so ``hier_bucket_mb > 0`` models pp = 1 plans only
+    (``hier_cross`` fixes the slice/host split, as in
+    :func:`plan_collective_bytes`); pp > 1 engines predict from their
+    own reducer's ``bucket_layout``.
 
     Raises ValueError for plan shapes the prediction does not model
     (non-uniform strategies, Ulysses/cp layers — the census still counts
@@ -423,7 +431,15 @@ def plan_collective_counts(
     if any(l != s for l in hpc.layers):
         raise ValueError("collective-count prediction needs a uniform "
                          "per-layer strategy (the compiled engine's gate)")
-    if s.sp or s.cp_size > 1:
+    if (s.sp or s.cp_size > 1) and (
+            not hier_dp or tp_overlap or max(hpc.pp_deg, 1) > 1):
+        # the flat path's cp-ring / ulysses-a2a kernel hops have no exact
+        # prediction; the hier LANE path swaps those kernels for GSPMD
+        # (partition-time, invisible to the jaxpr), so its explicit
+        # collectives ARE predictable — but only at pp = 1 with
+        # tp_overlap off (the pp engines keep their stage-stacked
+        # ring/a2a kernels and reject hier for cp/sp layers, and rings
+        # cannot nest under the lane vmap anyway)
         raise ValueError("collective-count prediction models Megatron-TP "
                          "plans only (no Ulysses / cp ring layers)")
     m = max(num_microbatches if num_microbatches is not None
@@ -442,9 +458,19 @@ def plan_collective_counts(
         if s.dp_size < 2:
             raise ValueError("hier_dp prediction needs dp > 1 "
                              "(eligibility.hier_dp_unsupported_reason)")
-        out["reduce_scatter"] = 1
-        out["all_reduce"] = 1
-        out["all_gather"] = 1
+        n_buckets = 1
+        if hier_bucket_mb > 0:
+            from hetu_galvatron_tpu.ops.hier_reduce import (
+                hier_bucket_layout,
+            )
+
+            local, _, intra = _hier_payload_elems_from_plan(
+                hpc, model, cross=hier_cross)
+            n_buckets = len(hier_bucket_layout(local, intra,
+                                               hier_bucket_mb))
+        out["reduce_scatter"] = n_buckets
+        out["all_reduce"] = n_buckets
+        out["all_gather"] = n_buckets
     return out
 
 
@@ -457,6 +483,7 @@ def plan_collective_bytes(
     elem_bytes: int = 4,
     hier_dp: bool = False,
     hier_cross: int = 1,
+    hier_bucket_mb: float = 0.0,
 ) -> Dict[str, float]:
     """Predicted per-device EXECUTED explicit-collective megabytes for the
     compiled single-program 1F1B step — the byte-side companion of
@@ -493,7 +520,11 @@ def plan_collective_bytes(
     if any(l != s for l in hpc.layers):
         raise ValueError("collective-byte prediction needs a uniform "
                          "per-layer strategy (the compiled engine's gate)")
-    if s.sp or s.cp_size > 1:
+    if (s.sp or s.cp_size > 1) and (
+            not hier_dp or tp_overlap or max(hpc.pp_deg, 1) > 1):
+        # same relaxation (and same pp = 1 bound) as
+        # plan_collective_counts: the hier lane path carries no
+        # cp/ulysses kernels, so its explicit bytes are exact
         raise ValueError("collective-byte prediction models Megatron-TP "
                          "plans only (no Ulysses / cp ring layers)")
     m = max(num_microbatches if num_microbatches is not None
@@ -514,18 +545,23 @@ def plan_collective_bytes(
     if hier_dp:
         # hierarchical dp reduction payloads (fp32 accumulators — the
         # reduce casts every leaf to f32, independent of elem_bytes): the
-        # concatenated per-device grad vector, zero-padded to the
+        # concatenated per-device grad vector split into buckets by the
+        # SAME hier_bucket_layout the runtime slices with (one bucket at
+        # the 0 default), each independently zero-padded to the
         # intra-host degree. Input-aval convention, matching the flow
-        # pass: rs moves the padded full vector, ar and ag the 1/intra
-        # shard.
+        # pass: rs moves each bucket's padded vector, ar and ag its
+        # 1/intra shard — summed per collective kind.
         if s.dp_size < 2:
             raise ValueError("hier_dp prediction needs dp > 1 "
                              "(eligibility.hier_dp_unsupported_reason)")
-        _, padded, intra = _hier_payload_elems_from_plan(
+        from hetu_galvatron_tpu.ops.hier_reduce import hier_bucket_layout
+
+        local, _, intra = _hier_payload_elems_from_plan(
             hpc, model, cross=hier_cross)
-        out["reduce_scatter"] = padded * 4 / MB
-        out["all_reduce"] = padded // intra * 4 / MB
-        out["all_gather"] = padded // intra * 4 / MB
+        layout = hier_bucket_layout(local, intra, hier_bucket_mb)
+        out["reduce_scatter"] = sum(p for _, p in layout) * 4 / MB
+        out["all_reduce"] = sum(p // intra for _, p in layout) * 4 / MB
+        out["all_gather"] = sum(p // intra for _, p in layout) * 4 / MB
     return out
 
 

@@ -219,6 +219,11 @@ class Attribution:
     bubble_ms: float = 0.0            # per-device idle inside the wall window
     bubble_frac: float = 0.0
     categories_ms: Dict[str, float] = field(default_factory=dict)  # per-device
+    # per-BUCKET-stage detail of the hierarchical dp reduction
+    # ("hier_rs_b0", "hier_ar_b3", ... from the bucketed named scopes,
+    # ops/hier_reduce.hier_stage_scope); kept OUT of categories_ms so
+    # collective_ms never double-counts a marked op with its bucket row
+    hier_bucket_ms: Dict[str, float] = field(default_factory=dict)
     per_module_ms: Dict[str, float] = field(default_factory=dict)  # per-device
     host_span_ms: Dict[str, float] = field(default_factory=dict)   # host wall
     device_annotation_ms: Dict[str, float] = field(default_factory=dict)
@@ -279,6 +284,7 @@ def attribute(trace: TraceData,
     # tp/overlap_step annotation-coverage rebilling below
     bare_permutes: Dict[Tuple[int, int], List[Tuple[float, float]]] = {}
     cats: Dict[str, float] = {}
+    hier_buckets: Dict[str, float] = {}
     mods: Dict[str, float] = {}
     for pid, tid, ts, dur, name, mod, hint in dev_events:
         by_track.setdefault((pid, tid), []).append((ts, dur, name, mod))
@@ -296,6 +302,14 @@ def attribute(trace: TraceData,
             for marker, key in _HIER_MARKERS:
                 if marker in hint:
                     cat = key
+                    # bucketed schedules suffix a per-bucket stage id
+                    # (hier_stage_scope "hier_dp_rs_b3"): keep the
+                    # per-bucket split as DETAIL next to the base total
+                    mb = re.search(re.escape(marker) + r"_b(\d+)", hint)
+                    if mb is not None:
+                        bk = f"{key}_b{mb.group(1)}"
+                        hier_buckets[bk] = (hier_buckets.get(bk, 0.0)
+                                            + dur / 1000.0)
                     break
         cats[cat] = cats.get(cat, 0.0) + dur / 1000.0
         if mod:
@@ -396,6 +410,8 @@ def attribute(trace: TraceData,
             cats.pop("permute", None)
     if attr.tracks:
         attr.categories_ms = {k: v / attr.tracks for k, v in cats.items()}
+        attr.hier_bucket_ms = {k: v / attr.tracks
+                               for k, v in hier_buckets.items()}
     for name in step_spans:  # first marker that fired wins
         if step_counts.get(name):
             attr.steps = max(step_counts[name].values())
@@ -626,7 +642,11 @@ def predicted_comm_per_step(
         )
 
         cctx = CostContext(alpha_beta_algos=abalgos, hier_dp=True,
-                           dcn_slices=dcn_slices)
+                           dcn_slices=dcn_slices,
+                           # price the bucketed pipelined schedule the
+                           # plan actually runs (0 = monolithic)
+                           hier_bucket_mb=max(float(
+                               getattr(hpc, "hier_bucket_mb", 0.0)), 0.0))
         ss = SearchStrategy(pp=pp, tp=hier_acc["tp"], dp=hier_acc["dp"])
         gmb = hier_acc["mb"]
         cands = {}
@@ -793,6 +813,21 @@ def audit_plan(
                     arow["ratio"] = round(a_meas / alg_ms, 4)
                     arow["residual_ms"] = round(a_meas - alg_ms, 4)
             rows.append(arow)
+        if comp == "dp" and attr.hier_bucket_ms:
+            # per-bucket-stage rows (the bucketed pipelined schedule's
+            # hier_dp_{rs,ar,ag}_b{i} scopes): measured-only detail under
+            # the dp component — the per-bucket split is what shows
+            # whether the DCN stage really hid behind the ICI stages
+            _stage_rank = {"hier_rs": 0, "hier_ar": 1, "hier_ag": 2}
+
+            def _bkey(k: str) -> Tuple[int, int, str]:
+                stem, _, idx = k.rpartition("_b")
+                return (int(idx), _stage_rank.get(stem, 9), stem)
+
+            for bk in sorted(attr.hier_bucket_ms, key=_bkey):
+                rows.append({"component": f"dp[{bk}]",
+                             "measured_ms": round(
+                                 attr.hier_bucket_ms[bk] / n_steps, 4)})
 
     compute_row: Dict[str, Any] = {
         "component": "compute",

@@ -41,14 +41,38 @@ Mechanics (two halves):
   (:data:`HIER_DP_RS_SCOPE` etc.) so trace attribution and the census can
   bill them. ``telemetry.plan_collective_counts/bytes`` predict these
   counts and padded payload bytes EXACTLY from the same spec arithmetic
-  (:func:`hier_payload_elems`).
+  (:func:`hier_payload_elems` / :func:`hier_bucket_layout`).
+
+**Bucketed software pipelining** (``parallel.hier_bucket_mb > 0``): the
+concatenated payload splits into fixed-capacity buckets and the
+three-stage schedule is emitted in WAVEFRONT order across them — while
+bucket *i* runs its cross-slice all-reduce on the DCN links, bucket
+*i+1* runs its reduce-scatter and bucket *i−1* its all-gather on ICI.
+The per-bucket chains are data-independent and the two link classes are
+disjoint, so XLA's latency-hiding scheduler can overlap them: steady
+state approaches ``max(Σ T_ici, T_dcn) + ramp`` instead of the
+monolithic ``T_rs + T_ar + T_ag``. Each element still rides exactly the
+same rs→ar→ag association as the monolithic path (a bucket is a
+contiguous slice of the same payload), so results are bit-identical;
+the program contains ``3 × buckets`` collectives, each under a
+per-bucket-stage scope (``hier_dp_rs_b0`` …) that keeps trace
+attribution, the census exemptions, and the plan-audit rows honest.
+``hier_bucket_mb = 0`` (the default) is byte-for-byte today's single
+bucket. :func:`hier_bucket_layout` is the ONE source for the per-bucket
+(elems, padded) arithmetic — the runtime slicing and the census/flow
+predictions both call it, so they cannot drift.
 
 Eligibility lives in ``analysis/eligibility.py``
-(``hier_dp_unsupported_reason``): uniform Megatron-TP plans only — no
-cp/Ulysses (their grads are partial over more than dp), no dropout (lane
-mask streams would diverge from the flat path's), no shard_map kernels
-under the lane vmap (tp_overlap rings / flash / ring-cp cannot nest), and
-the vocab tp axes must stay off the dp lane axes.
+(``hier_dp_unsupported_reason``): uniform plans — cp/Ulysses layers ARE
+eligible (the lane vmap covers the dp axes; each lane's leftover
+cp/sequence-parallel partial sums stay an in-lane GSPMD reduction, and
+the runtime swaps their shard_map attention kernels for the GSPMD core),
+but not zigzag-cp (its pre-permuted data layout needs the ring kernel),
+no dropout (lane mask streams would diverge from the flat path's), no
+shard_map kernels under the lane vmap (tp_overlap rings / flash cannot
+nest — and the pp engines keep their stage-stacked cp/ulysses kernels,
+so pp>1 cp/sp plans stay flat), and the vocab tp axes must stay off the
+dp lane axes.
 """
 
 from __future__ import annotations
@@ -72,11 +96,54 @@ from hetu_galvatron_tpu.runtime.mesh import (
 # HLO-metadata markers (jax.named_scope) for the three hierarchical
 # collectives — trace attribution (observability/trace_analysis.py) bills
 # them to the dp component, and the sharding-flow reshard lint exempts the
-# deliberate hier_dp_ag re-materialization
+# deliberate hier_dp_ag re-materialization. Bucketed schedules suffix a
+# per-bucket stage id (hier_stage_scope: "hier_dp_rs_b3"); every consumer
+# matches by SUBSTRING of the base scope, so the suffix only ADDS detail.
 HIER_DP_RS_SCOPE = "hier_dp_rs"
 HIER_DP_AR_SCOPE = "hier_dp_ar"
 HIER_DP_AG_SCOPE = "hier_dp_ag"
 HIER_DP_SCOPES = (HIER_DP_RS_SCOPE, HIER_DP_AR_SCOPE, HIER_DP_AG_SCOPE)
+
+MB = 1024 * 1024
+
+
+def hier_stage_scope(base: str, bucket: int, n_buckets: int) -> str:
+    """named_scope for one bucket's stage: the bare base scope for the
+    monolithic (single-bucket) schedule — byte-compatible with pre-bucket
+    traces — else ``{base}_b{i}``. The base stays a prefix, so substring
+    consumers (trace attribution ``_HIER_MARKERS``, the flow pass's
+    ``hier_dp_ag`` gather exemption) see bucketed programs unchanged."""
+    return base if n_buckets <= 1 else f"{base}_b{bucket}"
+
+
+def hier_bucket_layout(local: int, intra: int,
+                       bucket_mb: float) -> List[Tuple[int, int]]:
+    """Per-bucket ``(elems, padded)`` split of the ``local`` per-device
+    payload elements: contiguous f32 slices of at most ``bucket_mb``
+    megabytes (rounded up to the intra-host degree so every full bucket
+    scatters evenly), each independently zero-padded to a multiple of
+    ``intra``. ``bucket_mb <= 0`` returns the single monolithic bucket —
+    identical to :func:`hier_payload_elems`'s (local, padded) pair.
+
+    This is THE bucket arithmetic: the runtime reducer slices its payload
+    with it and ``telemetry.plan_collective_counts/bytes`` predict
+    ``3 x len(layout)`` collectives with exactly these padded sizes —
+    one function, two callers, no drift."""
+    intra = max(intra, 1)
+    pad = lambda n: -(-n // intra) * intra
+    local = max(int(local), 0)
+    if bucket_mb <= 0 or local == 0:
+        return [(local, pad(local))]
+    # capacity: bucket_mb of f32 elems, floored to a multiple of intra
+    # (full buckets then scatter with zero padding), at least one tile
+    cap = max((int(bucket_mb * MB) // 4) // intra * intra, intra)
+    out: List[Tuple[int, int]] = []
+    off = 0
+    while off < local:
+        n = min(cap, local - off)
+        out.append((n, pad(n)))
+        off += n
+    return out
 
 
 def _is_axes(x: Any) -> bool:
@@ -155,7 +222,8 @@ class HierDpReducer:
     ``cross``/``intra`` the slice/host split of it. :meth:`reduce` takes a
     lane-stacked grad tree (leading ``[lanes]`` dim sharded over the dp
     axes, every other dim laid out per ``specs``) and returns the summed
-    tree with the lane dim gone — three explicit collectives total.
+    tree with the lane dim gone — three explicit collectives per bucket
+    (one bucket at ``bucket_mb = 0``), software-pipelined across buckets.
     """
 
     mesh: Mesh
@@ -169,6 +237,11 @@ class HierDpReducer:
     # the flat batch's [B, ...] spec (per_layer[0].batch_spec()); the lane
     # split re-pins dims past the lane one to it
     batch_spec: Optional[P] = None
+    # bucketed software pipelining (module docstring): the payload splits
+    # into ≤bucket_mb-MB buckets whose rs/ar/ag chains interleave so the
+    # DCN stage of bucket i overlaps the ICI stages of its neighbours.
+    # 0 = one monolithic bucket (byte-identical to the pre-bucket program)
+    bucket_mb: float = 0.0
 
     def __post_init__(self):
         self.lanes = axes_size(self.mesh, self.dp_axes)
@@ -226,31 +299,92 @@ class HierDpReducer:
 
     # -- the reduction ------------------------------------------------------
 
+    @staticmethod
+    def _bucket_segments(sizes: Sequence[int],
+                         layout: Sequence[Tuple[int, int]]
+                         ) -> List[List[Tuple[int, int, int]]]:
+        """Per-bucket ``(leaf index, lo, hi)`` segment lists covering the
+        flattened leaves in order — the bucket boundaries fall wherever
+        ``hier_bucket_layout`` put them, splitting a leaf mid-way when
+        needed. Each element is copied exactly once INTO its bucket and
+        once OUT (the same copy volume the monolithic concat/split pays),
+        so bucketing adds no extra payload traffic."""
+        segs: List[List[Tuple[int, int, int]]] = []
+        li, lo = 0, 0
+        for n, _padded in layout:
+            bucket: List[Tuple[int, int, int]] = []
+            need = n
+            while need > 0:
+                take = min(need, sizes[li] - lo)
+                bucket.append((li, lo, lo + take))
+                lo += take
+                need -= take
+                if lo == sizes[li]:
+                    li += 1
+                    lo = 0
+            segs.append(bucket)
+        return segs
+
     def _body(self, *blocks):
         """Local shard_map body: each block arrives ``[1, ...]`` (one lane
-        per device along the regrouped dp sub-axes); flatten-concat-pad to
-        one payload vector, run the three-level schedule, split back."""
+        per device along the regrouped dp sub-axes); flatten the leaves
+        into per-bucket payload vectors (hier_bucket_layout — ONE bucket
+        covering everything at bucket_mb = 0), run each bucket's
+        three-level schedule with the stage emissions interleaved in
+        wavefront order, and reassemble the leaves from the gathered
+        buckets."""
         intra = self.intra
         flats = [b[0].reshape(-1).astype(jnp.float32) for b in blocks]
         sizes = [f.size for f in flats]
-        v = jnp.concatenate(flats) if len(flats) > 1 else flats[0]
-        pad = (-v.size) % intra
-        if pad:
-            v = jnp.pad(v, (0, pad))
-        with jax.named_scope(HIER_DP_RS_SCOPE):
-            s = jax.lax.psum_scatter(v, HIER_HOST_AXIS,
-                                     scatter_dimension=0, tiled=True)
-        with jax.named_scope(HIER_DP_AR_SCOPE):
-            s = jax.lax.psum(s, HIER_SLICE_AXIS)
-        with jax.named_scope(HIER_DP_AG_SCOPE):
-            full = jax.lax.all_gather(s, HIER_HOST_AXIS, tiled=True)
-        if pad:
-            full = full[:sum(sizes)]
-        outs, off = [], 0
-        for b, n in zip(blocks, sizes):
-            outs.append(full[off:off + n].reshape(b.shape[1:])
-                        .astype(b.dtype))
-            off += n
+        layout = hier_bucket_layout(sum(sizes), intra, self.bucket_mb)
+        segs = self._bucket_segments(sizes, layout)
+        B = len(layout)
+        bufs = []
+        for bucket, (n, padded) in zip(segs, layout):
+            parts = [flats[li][lo:hi] if (lo, hi) != (0, sizes[li])
+                     else flats[li] for li, lo, hi in bucket]
+            v = jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+            if padded != n:
+                v = jnp.pad(v, (0, padded - n))
+            bufs.append(v)
+        # wavefront emission: at step t, bucket t enters rs-intra (ICI)
+        # while bucket t-1 runs ar-cross (DCN) and bucket t-2 ag-intra
+        # (ICI). The chains share no data, so the emission order is the
+        # overlap HINT the latency-hiding scheduler needs — with B = 1
+        # this degenerates to exactly the monolithic three-collective
+        # program (same scopes, same payload, same bytes).
+        rs_out: List[Any] = [None] * B
+        ar_out: List[Any] = [None] * B
+        ag_out: List[Any] = [None] * B
+        for t in range(B + 2):
+            if t < B:
+                with jax.named_scope(
+                        hier_stage_scope(HIER_DP_RS_SCOPE, t, B)):
+                    rs_out[t] = jax.lax.psum_scatter(
+                        bufs[t], HIER_HOST_AXIS, scatter_dimension=0,
+                        tiled=True)
+            j = t - 1
+            if 0 <= j < B:
+                with jax.named_scope(
+                        hier_stage_scope(HIER_DP_AR_SCOPE, j, B)):
+                    ar_out[j] = jax.lax.psum(rs_out[j], HIER_SLICE_AXIS)
+            k = t - 2
+            if 0 <= k < B:
+                with jax.named_scope(
+                        hier_stage_scope(HIER_DP_AG_SCOPE, k, B)):
+                    ag_out[k] = jax.lax.all_gather(
+                        ar_out[k], HIER_HOST_AXIS, tiled=True)
+        # reassemble each leaf from its (in-order) bucket segments
+        pieces: List[List[Any]] = [[] for _ in flats]
+        for bucket, (n, padded), g in zip(segs, layout, ag_out):
+            off = 0
+            for li, lo, hi in bucket:
+                pieces[li].append(g[off:off + (hi - lo)])
+                off += hi - lo
+        outs = []
+        for b, n, ps in zip(blocks, sizes, pieces):
+            leaf = jnp.concatenate(ps) if len(ps) > 1 else ps[0]
+            outs.append(leaf.reshape(b.shape[1:]).astype(b.dtype))
         return tuple(outs)
 
     def reduce(self, stacked: Any) -> Any:
@@ -278,6 +412,14 @@ class HierDpReducer:
         return hier_payload_elems(shapes, self._leaf_specs, self.hmesh,
                                   self.intra)
 
+    def bucket_layout(self, stacked_or_shapes: Any) -> List[Tuple[int, int]]:
+        """Per-bucket (elems, padded) split of this reducer's payload —
+        the exact slices :meth:`reduce` emits (``hier_bucket_layout`` over
+        :meth:`payload_elems`'s local count). One entry at
+        ``bucket_mb = 0``."""
+        local, _ = self.payload_elems(stacked_or_shapes)
+        return hier_bucket_layout(local, self.intra, self.bucket_mb)
+
 
 def make_hier_reducer(
     mesh: Mesh,
@@ -288,11 +430,14 @@ def make_hier_reducer(
     dcn_slices: int = 1,
     cross: Optional[int] = None,
     specs: Any = None,
+    bucket_mb: float = 0.0,
 ) -> HierDpReducer:
     """Build the reducer for a lowered plan: dp lane axes from the (uniform)
     first decoder layer, the slice/host split from ``dcn_slices`` (pp-first
-    absorption, ``mesh.hier_cross_degree``) unless ``cross`` pins it, and
-    grad specs from :func:`grad_reduce_specs` unless given."""
+    absorption, ``mesh.hier_cross_degree``) unless ``cross`` pins it, grad
+    specs from :func:`grad_reduce_specs` unless given, and the bucketed
+    pipelining granularity from ``bucket_mb`` (``parallel.hier_bucket_mb``;
+    0 = one monolithic bucket)."""
     from hetu_galvatron_tpu.runtime.mesh import hier_cross_degree
 
     sh = per_layer[0]
@@ -305,7 +450,7 @@ def make_hier_reducer(
         specs = grad_reduce_specs(axes_tree, per_layer, vocab)
     return HierDpReducer(mesh=mesh, dp_axes=dp_axes, cross=cross,
                          intra=dp_deg // cross, specs=specs,
-                         batch_spec=sh.batch_spec())
+                         batch_spec=sh.batch_spec(), bucket_mb=bucket_mb)
 
 
 # NOTE: per-lane grad computation is NOT wrapped here on purpose — every

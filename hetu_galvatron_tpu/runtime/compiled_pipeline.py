@@ -178,6 +178,7 @@ class CompiledPipelineEngine:
         use_flash: Optional[bool] = None,
         flash_interpret: bool = False,
         hier_dp: bool = False,
+        hier_bucket_mb: float = 0.0,
     ):
         """``tp_overlap`` swaps the (uniform) layer's projection matmuls for
         the stage-stacked ring ag/rs kernels (ops/overlap.py) when the layer
@@ -248,6 +249,7 @@ class CompiledPipelineEngine:
         # grad specs, which need the axes tree — built in split_params
         self.hier_dp = bool(hier_dp)
         self._dcn_slices = dcn_slices
+        self._hier_bucket_mb = float(hier_bucket_mb)
         self._hier = None
         if self.hier_dp:
             from hetu_galvatron_tpu.analysis.eligibility import (
@@ -370,7 +372,8 @@ class CompiledPipelineEngine:
         cross = hier_cross_degree(self.pp, dp_deg, self._dcn_slices)
         self._hier = HierDpReducer(
             mesh=self.mesh, dp_axes=dp_axes, cross=cross,
-            intra=dp_deg // cross, specs=self._stacked_grad_specs(axes))
+            intra=dp_deg // cross, specs=self._stacked_grad_specs(axes),
+            bucket_mb=self._hier_bucket_mb)
 
     def split_params(self, params: Params, axes: Params) -> Params:
         """Full (host/single-device) params tree -> the stacked layout:

@@ -64,6 +64,10 @@ class HybridParallelConfig:
     # ("hier_dp": 1 in the plan JSON) — the launcher enables the matching
     # runtime path (ops/hier_reduce.py; args.parallel.hier_dp ORs in).
     hier_dp: bool = False
+    # Bucketed software-pipelining granularity the search priced it at
+    # ("hier_bucket_mb" in the plan JSON; 0 = monolithic). The runtime
+    # buckets at the same size; a nonzero parallel.hier_bucket_mb wins.
+    hier_bucket_mb: float = 0.0
 
     @property
     def enc_strategies(self) -> List[LayerStrategy]:
@@ -146,6 +150,7 @@ def get_hybrid_parallel_config(
             n_layers, pp_deg * vpp)
         pred_layer_ms = extras.get("predicted_layer_compute_ms")
         hier_dp = bool(extras.get("hier_dp", False))
+        hier_bucket_mb = float(extras.get("hier_bucket_mb", 0.0) or 0.0)
     else:
         pp_deg = par.pp_deg
         r = eligibility.pp_world_reason(world_size, pp_deg)
@@ -180,6 +185,7 @@ def get_hybrid_parallel_config(
         chunks = get_chunks(args, world_size)
         pred_layer_ms = None
         hier_dp = False
+        hier_bucket_mb = 0.0
 
     # guard both branches (a JSON plan with pp*vpp > layers would otherwise
     # slip through as zero-layer chunks from default_pp_division): the
@@ -216,5 +222,5 @@ def get_hybrid_parallel_config(
         pipeline_type=pipeline_type, default_dp_type=default_dp,
         world_size=world_size, num_encoder_layers=n_enc, vpp_deg=vpp,
         cp_zigzag=cp_zigzag, predicted_layer_compute_ms=pred_layer_ms,
-        hier_dp=hier_dp,
+        hier_dp=hier_dp, hier_bucket_mb=hier_bucket_mb,
     )

@@ -119,11 +119,13 @@ class PipelineEngine:
         use_flash: Optional[bool] = None,
         flash_interpret: bool = False,
         hier_dp: bool = False,
+        hier_bucket_mb: float = 0.0,
     ):
         self.cfg = cfg
         self.hpc = hpc
         self.train = train
         self.compute_dtype = compute_dtype
+        self._hier_bucket_mb = float(hier_bucket_mb)
         # hierarchical dp gradient reduction (ops/hier_reduce.py): stage
         # backwards run per dp LANE (vmap over the lane-split microbatch)
         # so grads accumulate lane-stacked across the schedule, and ONE
@@ -140,6 +142,12 @@ class PipelineEngine:
 
             _reason = plan_hier_dp_reason(cfg, hpc)
             if _reason is None and tp_overlap:
+                _reason = HIER_KERNEL_REASON
+            if _reason is None and any(s.cp_size > 1 or s.sp
+                                       for s in hpc.layers):
+                # the stage programs keep their ring-cp / ulysses-a2a
+                # shard_map kernels (unlike the pp=1 SPMD path, which
+                # swaps them for the GSPMD core under the lane vmap)
                 _reason = HIER_KERNEL_REASON
             if _reason is None and (use_flash or (
                     use_flash is None and cfg.use_flash_attn
@@ -431,7 +439,8 @@ class PipelineEngine:
         reducer = HierDpReducer(
             mesh=st.mesh, dp_axes=sh0.dp_axes, cross=cross,
             intra=dp_deg // cross,
-            specs=self._stage_grad_specs(self._axes_tree, s))
+            specs=self._stage_grad_specs(self._axes_tree, s),
+            bucket_mb=self._hier_bucket_mb)
         return jax.jit(reducer.reduce)
 
     @property

@@ -64,6 +64,7 @@ def make_train_step(
     chunks: int = 1,
     aux_stats: bool = False,
     hier: Optional[Any] = None,
+    constrain_microbatches: Optional[Callable[[Any], Any]] = None,
 ) -> Callable:
     """Returns train_step(params, opt_state, batch) -> (params, opt_state,
     metrics). ``chunks`` splits the global batch into microbatches scanned
@@ -81,7 +82,22 @@ def make_train_step(
     (zero cross-dp bytes in-scan) and reduce ONCE per step via the
     reducer's three-collective reduce-scatter/all-reduce/all-gather
     program. Per-(microbatch, lane) token-share weighting keeps the
-    result equal to the flat path up to reduction reassociation."""
+    result equal to the flat path up to reduction reassociation.
+
+    ``constrain_microbatches`` is an optional hook applied to the
+    ``[chunks, B/chunks, ...]``-stacked batch tree right after the
+    reshape on the flat scanned path. The SPMD path pins the stack so
+    the CHUNK axis is replicated and the sample axis keeps the plan's
+    batch sharding: without the pin, the reshape naturally absorbs the
+    outer dp mesh axis into the chunk dim, every scanned microbatch
+    arrives sharded over only the INNER dp axes — and under ZeRO-3 the
+    partitioner's gradient program for that layout is numerically WRONG
+    (the ROADMAP embed-ZeRO-3 + vtp>1 + chunks>1 bug: wrong wte rows at
+    grad magnitude — and in fact every dp-sharded grad leaf drifts).
+    The pin makes each microbatch's embed-grad reduce-scatter
+    materialize per microbatch in the plan's own layout; the hier path
+    has always pinned (``hier.lane_batch``), which is why it was exact
+    where flat drifted."""
 
     if hier is not None and aux_stats:
         raise ValueError(
@@ -186,6 +202,8 @@ def make_train_step(
             mbs = jax.tree.map(
                 lambda x: x.reshape((chunks, x.shape[0] // chunks) + x.shape[1:]),
                 batch)
+            if constrain_microbatches is not None:
+                mbs = constrain_microbatches(mbs)
             if rng is not None:
                 mbs["dropout_rng"] = jax.random.split(rng, chunks)
             # token-weighted accumulation: each microbatch's masked-mean loss

@@ -174,6 +174,7 @@ def strategy_list2config(
     vpp_deg: Optional[int] = None,
     predicted_layer_compute_ms: Optional[Sequence[float]] = None,
     hier_dp: Optional[bool] = None,
+    hier_bucket_mb: float = 0.0,
 ) -> Dict[str, Any]:
     """Serialize per-layer strategies to the interchange dict.
 
@@ -249,6 +250,10 @@ def strategy_list2config(
         # hierarchical two-level schedule (ops/hier_reduce.py); the runtime
         # enables the matching execution path (args.parallel.hier_dp ORs in)
         cfg["hier_dp"] = 1
+        if hier_bucket_mb > 0:
+            # ...and pipelined it at this bucket granularity
+            # (cost.hier_dp_best_bucket); the runtime buckets identically
+            cfg["hier_bucket_mb"] = float(hier_bucket_mb)
     return cfg
 
 
@@ -384,6 +389,11 @@ def config2strategy(
                                if "num_encoder_layers" in cfg else None),
         "vpp_deg": _int_field(cfg, "vpp_deg", 1),
         "hier_dp": bool(_int_field(cfg, "hier_dp", 0)),
+        # bucketed software-pipelining granularity the search priced the
+        # hierarchical reduction at (0 = monolithic); the runtime
+        # pipelines at the same size unless parallel.hier_bucket_mb
+        # overrides
+        "hier_bucket_mb": float(cfg.get("hier_bucket_mb", 0.0) or 0.0),
         # optional per-layer compute prediction (see strategy_list2config);
         # a hand-edited plan whose vector no longer matches the layer count
         # is dropped rather than mis-attributed to the wrong layers

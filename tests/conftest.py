@@ -152,6 +152,7 @@ _SLOW_TESTS = {
     # the reshard layout units, the exit-17 gate, and the quiet-engine
     # swap contract.
     "test_elastic_drill_kill8_resume4_searched",
+    "test_elastic_drill_kill4_resume8_scale_up_searched",
     "test_elastic_resume_degree_adapt_replays_exactly",
     "test_reshard_exact_across_engines",
     "test_weight_swap_load_drill",

@@ -184,3 +184,56 @@ def test_elastic_drill_kill8_resume4_searched(tmp_path):
     outB = train(_args(resume_extra))
     np.testing.assert_allclose(outA["losses"], outB["losses"],
                                rtol=0, atol=0)
+
+
+def test_elastic_drill_kill4_resume8_scale_up_searched(tmp_path):
+    """Scale-UP drill (ROADMAP elastic follow-on): SIGTERM-kill a
+    4-device tp2 x pp2 run mid-training and resume on DOUBLE the world
+    (8 devices) through the same detect -> re-search -> gate -> reshard
+    -> replay path as the 8 -> 4 drill — N -> 2N rides the same code but
+    was unexercised. The re-searched plan must describe an 8-device
+    world and the resumed trajectory must be exactly reproducible from
+    the committed checkpoint."""
+    from hetu_galvatron_tpu.cli.train_dist import train
+    from hetu_galvatron_tpu.runtime.supervisor import (
+        EXIT_CODE_CHECKPOINT_AND_EXIT,
+    )
+
+    save = str(tmp_path / "ckpt")
+    plan4 = ["parallel.pp_deg=2", "parallel.global_tp_deg=2",
+             "parallel.chunks=2", "parallel.pipeline_type=pipedream_flush",
+             "parallel.vocab_tp=2", "parallel.num_devices=4"]
+    out4 = train(_args(plan4 + [
+        f"ckpt.save={save}",
+        "rerun.inject_kind=preempt", "rerun.inject_at_iter=2"]))
+    assert out4["exit_code"] == EXIT_CODE_CHECKPOINT_AND_EXIT
+    assert len(out4["losses"]) == 3  # iters 0..2, then the kill
+    assert os.path.isdir(os.path.join(save, "step_3"))
+
+    # the restarted attempt sees DOUBLE the world: detect -> re-search ->
+    # gate -> reshard -> replay
+    resume_extra = [f"ckpt.load={save}", "parallel.pp_deg=2",
+                    "parallel.global_tp_deg=2", "parallel.chunks=2",
+                    "parallel.pipeline_type=pipedream_flush",
+                    "parallel.vocab_tp=2", "parallel.num_devices=8",
+                    ] + SEARCH_FIXTURES
+    outA = train(_args(resume_extra))
+    assert outA["exit_code"] is None
+    assert len(outA["losses"]) == 3  # resumed at 3, finished 3..5
+    assert all(np.isfinite(outA["losses"]))
+    assert outA["goodput"]["totals"]["reshard"] > 0.0
+
+    # the re-searched plan landed next to the checkpoint root and
+    # actually uses the grown world (8 devices)
+    plans = glob.glob(os.path.join(save, "elastic_plan_8dev",
+                                   "galvatron_config_*.json"))
+    assert plans, "elastic re-search wrote no scale-up plan"
+    plan = json.load(open(plans[0]))
+    tp0 = int(str(plan["tp_sizes_enc"]).split(",")[0])
+    assert plan["pp_deg"] * tp0 <= 8
+
+    # fresh 8-device run from the SAME committed checkpoint:
+    # step-for-step equal (exact data position replayed)
+    outB = train(_args(resume_extra))
+    np.testing.assert_allclose(outA["losses"], outB["losses"],
+                               rtol=0, atol=0)

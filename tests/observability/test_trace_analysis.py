@@ -711,6 +711,69 @@ def test_audit_plan_emits_per_algorithm_rows(tmp_path):
     reg.flush()
 
 
+def test_attribute_bucketed_hier_markers(tmp_path):
+    """Bucketed hier scopes (hier_stage_scope ``hier_dp_rs_b{i}``) bill to
+    the SAME hier_* categories as the monolithic markers (the base scope
+    stays a prefix — substring match) AND surface the per-bucket split in
+    ``Attribution.hier_bucket_ms``, which never double-counts against
+    categories_ms (it is detail, not a category)."""
+    run = str(tmp_path / "plugins" / "profile" / "2026_01_01_00_00_00")
+    events = [
+        _ev(1, 1, 0, 1000, "reduce-scatter.1", hlo_op="reduce-scatter.1",
+            tf_op="hier_dp_rs_b0/psum_scatter"),
+        _ev(1, 1, 1000, 2000, "all-reduce.2", hlo_op="all-reduce.2",
+            tf_op="hier_dp_ar_b0/psum"),
+        _ev(1, 1, 3000, 500, "reduce-scatter.3", hlo_op="reduce-scatter.3",
+            tf_op="hier_dp_rs_b1/psum_scatter"),
+        _ev(1, 1, 3500, 700, "all-gather.4", hlo_op="all-gather.4",
+            tf_op="hier_dp_ag_b1/all_gather"),
+        # a monolithic (un-suffixed) marker: base category only, no bucket
+        _ev(1, 1, 4200, 300, "all-gather.5", hlo_op="all-gather.5",
+            tf_op="hier_dp_ag/all_gather"),
+    ]
+    _write_trace(run, events, procs={1: "/device:TPU:0"})
+    attr = attribute(load_trace(run))
+    assert attr.categories_ms["hier_rs"] == pytest.approx(1.5)
+    assert attr.categories_ms["hier_ar"] == pytest.approx(2.0)
+    assert attr.categories_ms["hier_ag"] == pytest.approx(1.0)
+    assert attr.hier_bucket_ms == pytest.approx({
+        "hier_rs_b0": 1.0, "hier_ar_b0": 2.0,
+        "hier_rs_b1": 0.5, "hier_ag_b1": 0.7})
+
+
+def test_audit_plan_per_bucket_rows_and_summarize(tmp_path):
+    """audit_plan emits measured-only ``dp[hier_rs_b0]``-style rows in
+    wavefront order (bucket index, then rs->ar->ag), and summarize
+    renders them + headlines the count (audit_hier_bucket_rows)."""
+    from hetu_galvatron_tpu.cli.summarize import summarize
+
+    path = str(tmp_path / "m.jsonl")
+    reg = MetricsRegistry([JsonlSink(path)])
+    ab = {"2_1": (0.05, 100.0), "2_0": (0.07, 80.0)}
+    attr = _measured_attr()
+    attr.categories_ms.update({"hier_rs": 1.0, "hier_ar": 0.2,
+                               "hier_ag": 0.8})
+    attr.hier_bucket_ms = {"hier_rs_b1": 0.4, "hier_rs_b0": 0.6,
+                           "hier_ar_b0": 0.2, "hier_ag_b1": 0.8}
+    hpc = _hpc([LayerStrategy(tp_size=2, dp_size=2)] * 2)
+    hpc.hier_dp = True
+    table = audit_plan(attr, hpc, CFG, registry=reg, alpha_beta=ab,
+                       alpha_beta_algos={"1_1": {}}, dcn_slices=1)
+    names = [r["component"] for r in table["rows"]]
+    assert [n for n in names if "_b" in n] == [
+        "dp[hier_rs_b0]", "dp[hier_ar_b0]",
+        "dp[hier_rs_b1]", "dp[hier_ag_b1]"]
+    rows = {r["component"]: r for r in table["rows"]}
+    assert rows["dp[hier_rs_b0]"]["measured_ms"] == pytest.approx(
+        0.6 / attr.steps)
+    assert "predicted_ms" not in rows["dp[hier_rs_b0]"]
+    reg.close()
+    buf = io.StringIO()
+    headline = summarize(path, out=buf)
+    assert headline["audit_hier_bucket_rows"] == 4
+    assert "dp[hier_rs_b0]" in buf.getvalue()
+
+
 def test_summarize_hardware_renders_algo_columns(tmp_path, capsys):
     from hetu_galvatron_tpu.cli.summarize import summarize_hardware
 

@@ -321,3 +321,37 @@ def test_alpha_beta_algos_roundtrip(cpu_devices):
     merged = {**flat, **algos}
     assert read_alpha_beta(merged) == read_alpha_beta(flat)
     assert read_alpha_beta_algos(flat) == {}
+    # single-process fleet: the dcn level is the strided PROXY and the
+    # fitted JSON says so in metadata (a proxy must never silently pass
+    # as a fleet measurement); the metadata key is invisible to parsers
+    assert algos.get("dcn_level_source") == "proxy-strided"
+    assert read_alpha_beta_algos({**algos}) == table
+
+
+def test_dcn_group_true_multihost_vs_proxy(cpu_devices, recwarn):
+    """_dcn_group_devices: with devices spanning processes, the group is
+    built round-robin across processes (every hop crosses the seam — a
+    true DCN group, tagged 'multihost'); a single-process fleet keeps
+    the strided proxy WITH a warning and the 'proxy-strided' tag."""
+    from types import SimpleNamespace
+
+    from hetu_galvatron_tpu.core.profiler.hardware_profiler import (
+        _dcn_group_devices,
+    )
+
+    multi = [SimpleNamespace(id=i, process_index=i // 2) for i in range(8)]
+    group, src = _dcn_group_devices(multi, 4, 8)
+    assert src == "multihost"
+    assert len(group) == 4
+    # adjacent group members always sit in DIFFERENT processes
+    procs = [d.process_index for d in group]
+    assert all(a != b for a, b in zip(procs, procs[1:]))
+
+    import warnings as _w
+
+    with _w.catch_warnings(record=True) as rec:
+        _w.simplefilter("always")
+        group, src = _dcn_group_devices(list(cpu_devices[:8]), 4, 8)
+    assert src == "proxy-strided"
+    assert len(group) == 4
+    assert any("strided intra-host PROXY" in str(w.message) for w in rec)

@@ -60,3 +60,15 @@ def test_derived_model_fields():
     assert args.model.head_dim == 64
     assert args.model.padded_vocab_size % 128 == 0
     assert args.model.kv_heads == 8
+
+
+def test_negative_hier_bucket_mb_rejected():
+    """parallel.hier_bucket_mb < 0 is a config error: the auto-sweep
+    convention is search-side only (search.hier_bucket_mb < 0) — a truthy
+    negative runtime value would silently override a plan's recorded
+    bucket size into the monolithic schedule."""
+    with pytest.raises(Exception, match="hier_bucket_mb"):
+        load_config({"parallel": {"hier_bucket_mb": -1.0}})
+    # the search-side auto mode stays accepted
+    assert load_config(
+        {"search": {"hier_bucket_mb": -1.0}}).search.hier_bucket_mb == -1.0

@@ -73,6 +73,11 @@ LEGS: Tuple[Tuple[str, str, bool], ...] = (
     # lane-accumulated rs/ar/ag once per step vs the flat GSPMD in-scan
     # all-reduce. A ratio, regresses UP.
     ("hier_dp", "hier_dp_vs_flat", False),
+    # bucketed software-pipelined hier schedule vs the monolithic
+    # three-collective program (hier vs hier, same plan): on the CPU mesh
+    # the ratio prices the bucketing overhead (<= ~1.0 — the pipelined
+    # program must not cost more than it hides); regresses UP.
+    ("hier_dp_bucketed", "hier_dp_bucketed_vs_mono", False),
 )
 
 
@@ -215,12 +220,15 @@ def smoke() -> int:
             "legs": {"mfu_pct": 40.0, "tokens_per_sec": 100000.0,
                      "compiled_vs_host": 0.7, "compiled_overlap": 0.75,
                      "serve_prefix": 0.3, "spec_decode": 1.4,
-                     "hier_dp": 0.85}}
+                     "hier_dp": 0.85, "hier_dp_bucketed": 0.95}}
     same = {"device": "TPU v5 lite",
             "legs": {"mfu_pct": 39.2, "tokens_per_sec": 98000.0,
                      "compiled_vs_host": 0.72, "compiled_overlap": 0.77,
                      "serve_prefix": 0.31, "spec_decode": 1.37,
-                     "hier_dp": 0.87}}
+                     # hier_dp_bucketed IMPROVING (dropping — the
+                     # pipelined schedule hiding more) must pass too:
+                     # both directions of the new leg ride the smoke
+                     "hier_dp": 0.87, "hier_dp_bucketed": 0.82}}
     bad = {"device": "TPU v5 lite",
            "legs": {"mfu_pct": 40.1, "tokens_per_sec": 80000.0,
                     "compiled_vs_host": 0.95, "compiled_overlap": 1.2,
@@ -228,8 +236,10 @@ def smoke() -> int:
                     # prefill), spec_decode DOWN (drafts stop paying)
                     "serve_prefix": 0.9, "spec_decode": 0.8,
                     # hier_dp regresses UP (the hierarchical schedule
-                    # stops beating the flat all-reduce)
-                    "hier_dp": 1.3}}
+                    # stops beating the flat all-reduce); the bucketed
+                    # leg regresses UP too (bucketing overhead outgrew
+                    # the overlap win)
+                    "hier_dp": 1.3, "hier_dp_bucketed": 1.25}}
     other_dev = {"device": "cpu", "legs": {"mfu_pct": 5.0}}
 
     rows, ok_same = compare(base, same, threshold=0.10)
@@ -247,7 +257,8 @@ def smoke() -> int:
     healthy = (ok_same and not ok_bad
                and regressed == {"tokens_per_sec", "compiled_vs_host",
                                  "compiled_overlap", "serve_prefix",
-                                 "spec_decode", "hier_dp"}
+                                 "spec_decode", "hier_dp",
+                                 "hier_dp_bucketed"}
                and ok_dev
                and all(r["status"].startswith("skipped") for r in rows)
                and "NO VERDICT" in buf.getvalue())

@@ -141,6 +141,9 @@ def build_bench_candidate():
     hd = _last_json_line(os.path.join(LOG_DIR, "hier_dp.log"))
     if hd and isinstance(hd.get("hier_dp_vs_flat"), (int, float)):
         base.setdefault("hier_dp_vs_flat", hd["hier_dp_vs_flat"])
+    if hd and isinstance(hd.get("hier_dp_bucketed_vs_mono"), (int, float)):
+        base.setdefault("hier_dp_bucketed_vs_mono",
+                        hd["hier_dp_bucketed_vs_mono"])
     path = os.path.join(LOG_DIR, "bench_candidate.json")
     with open(path, "w") as f:
         json.dump({"parsed": base}, f, indent=2)
