@@ -316,6 +316,118 @@ def run_lint(update_baseline: bool = False, prune_stale: bool = False,
     return 0 if not new and not stale else 1
 
 
+def run_calibration() -> int:
+    """Pass 6 — calibration self-check (``observability/calibration.py``):
+    a synthetic end-to-end exercise of the store -> re-fit -> regret loop
+    with known ground truth. Appends two runs' worth of residual points
+    drawn from a known α-β "truth" curve (plus a foreign-fingerprint
+    batch that must be excluded), re-fits, and asserts the calibrated
+    curve recovers the truth, the profile round-trips through BOTH α-β
+    parsers with provenance intact, and the regret sentinel triggers on a
+    seeded stale-plan case while staying quiet when calibrated == prior."""
+    import tempfile
+
+    from hetu_galvatron_tpu.core.search_engine.profiles import (
+        read_alpha_beta,
+        read_alpha_beta_algos,
+        read_profile_provenance,
+    )
+    from hetu_galvatron_tpu.observability.calibration import (
+        ResidualStore,
+        evaluate_plan_regret,
+        refit_profile,
+        write_calibrated_profile,
+    )
+
+    print("== calibration self-check ==")
+    failures: List[str] = []
+
+    def check(ok: bool, what: str) -> None:
+        print(f"  {'ok' if ok else 'FAIL'}: {what}")
+        if not ok:
+            failures.append(what)
+
+    with tempfile.TemporaryDirectory() as td:
+        store = ResidualStore(os.path.join(td, "residuals.jsonl"))
+        fp = {"device": "synthetic", "world": 8, "mesh": [2, 2, 2]}
+        alien = {"device": "synthetic", "world": 4, "mesh": [1, 2, 2]}
+        a_true, b_true = 0.05, 250.0
+        sizes = (1.0, 2.0, 4.0, 8.0, 16.0)
+
+        def batch(scale):
+            return [{"collective": "allreduce", "group": "4_1",
+                     "alg": "flat", "mb": mb,
+                     "ms": (a_true + mb / b_true) * scale, "w": 1.0}
+                    for mb in sizes]
+
+        store.append(batch(1.0), fingerprint=fp, run_id="run0")
+        store.append(batch(1.02), fingerprint=fp, run_id="run1")
+        # a foreign mesh's (wildly different) points must not pollute
+        store.append([{"collective": "allreduce", "group": "4_1",
+                       "alg": "flat", "mb": mb, "ms": 50.0, "w": 1.0}
+                      for mb in sizes], fingerprint=alien, run_id="alien")
+        pts = store.load(fingerprint=fp)
+        check(len(pts) == 2 * len(sizes), "store round-trip keeps only "
+              f"fingerprint-matched points ({len(pts)})")
+        prof, meta = refit_profile(pts, min_points=4)
+        pair = read_alpha_beta(prof).get("4_1")
+        check(pair is not None, "re-fit emitted a flat 4_1 curve")
+        if pair:
+            a_fit, b_fit = pair
+            check(abs(a_fit - a_true) < 0.02 * max(a_true, 1e-9) + 5e-3
+                  and abs(b_fit - b_true) / b_true < 0.05,
+                  f"fitted curve recovers truth (α {a_fit:.4f}~{a_true}, "
+                  f"β {b_fit:.1f}~{b_true})")
+        curve_meta = meta.get("curves", {}).get("4_1/flat", {})
+        check(curve_meta.get("method") == "regression"
+              and curve_meta.get("points", 0) >= 4,
+              "provenance records method + point count")
+        # file round-trip through both parsers, meta intact
+        prof["calibration_meta"] = dict(meta, fingerprint=fp)
+        prof["allreduce_size_4_consec_1_alg_ring_lvl_ici_alpha_ms"] = 0.04
+        prof["allreduce_size_4_consec_1_alg_ring_lvl_ici_beta_mb_per_ms"] \
+            = 260.0
+        path = write_calibrated_profile(
+            os.path.join(td, "calibrated_profile.json"), prof)
+        check("4_1" in read_alpha_beta(path)
+              and read_alpha_beta_algos(path)
+              .get("4_1", {}).get("ring_ici") is not None,
+              "profile file round-trips through both α-β parsers")
+        check(read_profile_provenance(path)
+              .get("source") == "runtime-calibrated",
+              "provenance survives the file round-trip")
+
+        # regret sentinel: calibration halves the comm-heavy runner-up's
+        # collective cost, so it overtakes a compute-identical incumbent
+        prior_ab = {"2_1": (0.1, 100.0), "4_0": (0.1, 100.0),
+                    "4_1": (0.1, 100.0)}
+        calib_ab = {"2_1": (0.05, 200.0), "4_0": (0.05, 200.0),
+                    "4_1": (0.05, 200.0)}
+        incumbent = {"time_cost_ms": 100.0, "pp": 1, "bsz": 8, "chunks": 2,
+                     "layers": [{"tp": 1, "dp": 2}] * 2}
+        hungry = {"time_cost_ms": 101.0, "pp": 1, "bsz": 8, "chunks": 2,
+                  "layers": [{"tp": 4, "dp": 2}] * 2}
+        # 64-MB tp activation messages make the runner-up comm-dominated:
+        # calibration (halved α, doubled β) shrinks ITS priced comm far
+        # more than the incumbent's small dp buffers, flipping the order
+        kw = dict(seq_len=4096, hidden_size=4096, param_mb=8.0,
+                  mixed_precision=True, threshold=0.001)
+        res = evaluate_plan_regret(incumbent, [hungry],
+                                   prior=(prior_ab, None),
+                                   calibrated=(calib_ab, None), **kw)
+        check(bool(res["triggered"]) and res["regret_ms"] > 0,
+              f"seeded stale plan triggers regret "
+              f"({res['regret_ms']:.3f} ms)")
+        quiet = evaluate_plan_regret(incumbent, [hungry],
+                                     prior=(prior_ab, None),
+                                     calibrated=(prior_ab, None), **kw)
+        check(not quiet["triggered"] and quiet["regret_ms"] == 0.0,
+              "calibrated == prior stays quiet")
+
+    print(f"calibration: {'OK' if not failures else 'FAILED'}")
+    return 0 if not failures else 1
+
+
 def run_all(hbm_gb: Optional[float] = None,
             schedule_impl: str = "compiled") -> int:
     """The CI gate: plan doctor over every committed example plan, the
@@ -333,6 +445,8 @@ def run_all(hbm_gb: Optional[float] = None,
     rc |= run_flow()
     print()
     rc |= run_lint()
+    print()
+    rc |= run_calibration()
     print()
     print(f"check --all: {'OK' if rc == 0 else 'FAILED'}")
     return rc
@@ -378,6 +492,10 @@ def main(argv=None) -> int:
                    "byte-level collective census with the exact "
                    "plan_collective_bytes cross-check, reshard "
                    "detection, and the donation audit")
+    p.add_argument("--calibration", action="store_true",
+                   help="run the calibration self-check (Pass 6): "
+                   "synthetic residual store -> α-β re-fit -> plan-regret "
+                   "sentinel round-trip with known ground truth")
     p.add_argument("--all", action="store_true",
                    help="every pass on the committed examples (the CI "
                    "step)")
@@ -398,6 +516,8 @@ def main(argv=None) -> int:
                                     schedule_impl=a.schedule_impl)
     if a.flow:
         rc = (rc or 0) | run_flow()
+    if a.calibration:
+        rc = (rc or 0) | run_calibration()
     if a.lint or a.update_baseline or a.prune_baseline:
         rc = (rc or 0) | run_lint(update_baseline=a.update_baseline,
                                   prune_stale=a.prune_baseline)

@@ -268,12 +268,22 @@ def summarize_hardware(cfg: Dict[str, Any], path: str, out=None
     w(f"== hardware profile: {path} ==")
     algo_cols = ("ring_ici", "tree_ici", "ring_dcn", "tree_dcn")
     has_algos = any("_alg_" in k for k in cfg)
+    # provenance (observability/calibration.py refit_profile): per-curve
+    # {"points": n, "method": "regression"|"scale"} entries keyed
+    # "{n}_{c}/flat" or "{n}_{c}/{alg}_{lvl}"
+    meta = cfg.get("calibration_meta")
+    meta_curves = (meta.get("curves") if isinstance(meta, dict) else
+                   None) or {}
+    has_prov = bool(meta_curves)
     header = f"{'group':<14}{'bw MB/ms':>10}{'alpha ms':>12}{'beta MB/ms':>12}"
+    if has_prov:
+        header += f"{'source':>20}{'points':>8}"
     if has_algos:
         header += "".join(f"{c:>18}" for c in algo_cols)
     w(header)
     headline: Dict[str, Any] = {"groups": 0, "alpha_beta_groups": 0,
-                                "algo_groups": 0}
+                                "algo_groups": 0,
+                                "calibrated_curves": len(meta_curves)}
     for key in sorted(cfg):
         if not (key.startswith("allreduce_size_")
                 and key.split("_")[-1] in ("0", "1")):
@@ -290,6 +300,15 @@ def summarize_hardware(cfg: Dict[str, Any], path: str, out=None
                     f"{_fmt(beta):>12}")
         else:
             line = f"{label:<14}{_fmt(cfg[key]):>10}{'-':>12}{'-':>12}"
+        if has_prov:
+            cm = meta_curves.get(f"{n}_{c}/flat")
+            if isinstance(cm, dict):
+                line += (f"{'runtime-calibrated':>20}"
+                         f"{_fmt(cm.get('points')):>8}")
+            elif alpha is not None and beta is not None:
+                line += f"{'profiled':>20}{'—':>8}"
+            else:
+                line += f"{'—':>20}{'—':>8}"
         if has_algos:
             row_has_algo = False
             for col in algo_cols:
@@ -313,6 +332,16 @@ def summarize_hardware(cfg: Dict[str, Any], path: str, out=None
         w("(per-algorithm columns are alpha/beta of the fitted "
           "ring/halving-doubling schedules per level; the cost model "
           "prices each collective as the min over available curves)")
+    if has_prov:
+        src = meta.get("source", "runtime-calibrated")
+        fp = meta.get("fingerprint")
+        w(f"(calibration: {len(meta_curves)} curve(s) {src}"
+          + (f" on {fp.get('device')} world={fp.get('world')}"
+             if isinstance(fp, dict) else "")
+          + "; uncolumned curves: "
+          + (", ".join(f"{k}[{v.get('method')},{v.get('points')}pt]"
+                       for k, v in sorted(meta_curves.items())
+                       if not k.endswith("/flat")) or "none") + ")")
     return headline
 
 
@@ -440,6 +469,32 @@ def summarize(path: str, out=None,
         if sd is not None:
             headline["audit_step_device_ms"] = sd
             w(f"device busy ms/step  {_fmt(float(sd))}")
+
+    # -- self-calibration (observability/calibration.py gauges/events) --
+    cal_keys = (("calibration/points_appended", "residual points appended"),
+                ("calibration/points_total", "residual points accumulated"),
+                ("calibration/curves_fitted", "curves re-fit"),
+                ("calibration/drift_score", "drift score"),
+                ("calibration/plan_regret_ms", "plan regret ms/step"))
+    if any(get("gauge", k) for k, _ in cal_keys):
+        w()
+        w("-- calibration --")
+        for key, label in cal_keys:
+            g = get("gauge", key)
+            if g is not None:
+                headline[key.replace("calibration/", "cal_")] = g["value"]
+                w(f"{label:<28} {_fmt(g['value'])}")
+        regrets = [r for r in records if r.get("kind") == "event"
+                   and r.get("name") == "plan_regret"]
+        if regrets:
+            d = regrets[-1].get("data", {})
+            headline["plan_regret_ms"] = d.get("regret_ms")
+            headline["plan_regret_events"] = len(regrets)
+            w(f"PLAN REGRET: runner-up #{d.get('best_runner_up')} beats "
+              f"the incumbent by {_fmt(d.get('regret_ms'))} ms/step "
+              f"({_fmt(100.0 * (d.get('regret_frac') or 0.0))}% > "
+              f"{_fmt(100.0 * (d.get('threshold') or 0.0))}% threshold) "
+              "under calibrated curves — consider re-searching the plan")
 
     # -- compiled-program cost accounting (cost/* gauges) --
     costs = [(json.loads(lb).get("program", "?"), n.split("/", 1)[1], r)
@@ -648,7 +703,8 @@ def summarize(path: str, out=None,
     rest = [((k, n, lb), r) for (k, n, lb), r in sorted(latest.items())
             if k in ("counter", "gauge")
             and not n.startswith(("train/", "device/", "plan/", "serve/",
-                                  "tp/", "audit/", "cost/", "goodput/"))]
+                                  "tp/", "audit/", "cost/", "goodput/",
+                                  "calibration/"))]
     if rest:
         w()
         w("-- other counters/gauges --")

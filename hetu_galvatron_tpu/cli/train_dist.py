@@ -735,6 +735,55 @@ def train(args) -> Dict[str, Any]:
                             "the plan_audit event / audit/* gauges in the "
                             "metrics stream (cli/summarize.py renders the "
                             "table)")
+                    if table and args.observability.calibration_dir:
+                        # close the OTHER half of the loop: feed the
+                        # audit's residuals into the persistent store,
+                        # re-fit the α-β curves over everything
+                        # accumulated on this hardware, and run the
+                        # plan-regret sentinel over the plan's embedded
+                        # runner-ups (calibration/* gauges + at most one
+                        # plan_regret event; never raises)
+                        from hetu_galvatron_tpu.observability.calibration \
+                            import run_calibration
+
+                        cal = run_calibration(
+                            table, hpc, cfg,
+                            calibration_dir=(
+                                args.observability.calibration_dir),
+                            registry=telemetry.registry,
+                            prior_config=(
+                                args.observability.audit_hardware_config),
+                            world=world,
+                            min_points=(
+                                args.observability.calibration_min_points),
+                            regret_threshold=(
+                                args.observability.regret_threshold),
+                            plan_path=(
+                                args.parallel.galvatron_config_path
+                                if args.parallel.config_mode == "json"
+                                else None),
+                            mixed_precision=(
+                                args.parallel.mixed_precision != "fp32"),
+                            recorder=recorder)
+                        if cal.get("error"):
+                            state.log("warning: calibration failed: "
+                                      f"{cal['error']}")
+                        else:
+                            msg = (f"calibration: +{cal['points_appended']}"
+                                   f" residual point(s) "
+                                   f"({cal['points_total']} total), "
+                                   f"{cal['curves_fitted']} curve(s) "
+                                   "re-fit")
+                            if cal.get("profile_path"):
+                                msg += f" -> {cal['profile_path']}"
+                            reg = cal.get("regret")
+                            if reg and reg.get("triggered"):
+                                msg += (" — PLAN REGRET: a runner-up now "
+                                        "beats the incumbent by "
+                                        f"{reg['regret_ms']:.3f} ms/step "
+                                        "under calibrated curves "
+                                        "(plan_regret event emitted)")
+                            state.log(msg)
                 except Exception as e:  # noqa: BLE001 — never mask the crash
                     state.log(f"warning: plan audit failed: {e}")
             if telemetry is not None:

@@ -375,6 +375,29 @@ class ObservabilityArgs(BaseModel):
     # recorder even with enabled=false
     flight_dir: Optional[str] = None
     flight_events: int = 256
+    # self-calibrating cost model (observability/calibration.py): a
+    # directory enables the loop-exit calibration pass — every plan audit
+    # appends its per-curve residual points to
+    # <calibration_dir>/residuals.jsonl (fingerprint-keyed, accumulated
+    # across runs) and re-fits α-β curves over the accumulated points,
+    # writing <calibration_dir>/calibrated_profile.json in the same key
+    # namespace audit_hardware_config uses, provenance-tagged under
+    # "calibration_meta" ({"source": "runtime-calibrated", per-curve
+    # point counts + fit method, fit window, fingerprint}) — point
+    # audit_hardware_config (or the search engine's
+    # allreduce_bandwidth_config_path) at it to consume the posterior.
+    # None = calibration off (audit-only, the pre-calibration behaviour)
+    calibration_dir: Optional[str] = None
+    # minimum accumulated points per curve before the re-fitter trusts a
+    # full regression; below it a prior-anchored scale calibration (or
+    # nothing, with no prior) is used instead
+    calibration_min_points: int = 4
+    # plan-regret sentinel alarm threshold, as a fraction of the
+    # incumbent's adjusted step time: a plan_regret event fires when a
+    # stored runner-up, re-priced under the calibrated curves, beats the
+    # incumbent by more than this (the calibration/plan_regret_ms gauge
+    # publishes the margin regardless)
+    regret_threshold: float = 0.05
 
 
 class ServingArgs(BaseModel):
@@ -597,6 +620,13 @@ class SearchArgs(BaseModel):
     # ("hier_bucket_mb"); 0 keeps the monolithic three-collective price,
     # byte-identical goldens.
     hier_bucket_mb: float = 0.0
+    # Plan-regret sentinel support (observability/calibration.py): embed
+    # this many runner-up candidates — the feasible plans the search
+    # almost picked, deduped + throughput-ordered, each with its priced
+    # time_cost_ms and per-layer degrees — in the winning plan JSON as
+    # "runner_ups" (plus the winner's own "predicted_time_cost_ms").
+    # config2strategy ignores the extra keys; 0 disables the embedding.
+    runner_up_k: int = 3
 
 
 class ModelProfileArgs(BaseModel):

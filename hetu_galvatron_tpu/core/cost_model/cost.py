@@ -973,3 +973,79 @@ def pipeline_time_cost(
                 strategy_list):
             result += ctx0.dispatch_us * 1e-6 * 2 * pp_size * chunks
     return result
+
+
+# ---------------------------------------------------------------------------
+# stored-plan re-pricing (calibration / plan-regret sentinel)
+# ---------------------------------------------------------------------------
+
+
+def reprice_stored_plan_ms(
+    plan: Dict[str, Any],
+    *,
+    seq_len: int,
+    hidden_size: int,
+    param_mb: float,
+    mixed_precision: bool = True,
+    alpha_beta: Optional[Dict[str, Tuple[float, float]]] = None,
+    alpha_beta_algos: Optional[
+        Dict[str, Dict[str, Tuple[float, float]]]] = None,
+) -> Optional[float]:
+    """Per-device per-step collective ms of a stored strategy spec under a
+    given α-β curve set — the pricing half of the plan-regret sentinel
+    (``observability.calibration``).
+
+    ``plan`` is the shape ``SearchEngine.save_results`` embeds per
+    runner-up: ``{"layers": [{"tp", "dp", "cp", "sp", "ckpt",
+    "consec"}, ...], "pp", "bsz", "chunks"}``. The arithmetic mirrors
+    ``trace_analysis.predicted_comm_per_step``'s flat tp/dp pricing (same
+    message sizes, counts and per-pp scaling), so re-pricing a plan under
+    the curves the calibrator fit from audit residuals compares
+    like-for-like with the audit's own predictions. Returns None when no
+    curve prices any component (then the caller must not fabricate a
+    regret from a half-priced plan)."""
+    mb_unit = 1024 * 1024
+    ab = alpha_beta or {}
+    ab_algos = alpha_beta_algos or {}
+    pp = max(int(plan.get("pp", 1) or 1), 1)
+    chunks = max(int(plan.get("chunks", 1) or 1), 1)
+    bsz = max(int(plan.get("bsz", 1) or 1), 1)
+    elem = 2 if mixed_precision else 4
+    total = 0.0
+    priced = False
+    for layer in plan.get("layers") or []:
+        if not isinstance(layer, dict):
+            continue
+        tp_full = max(int(layer.get("tp", 1) or 1), 1)
+        sp = bool(layer.get("sp", 0))
+        tp = 1 if sp else tp_full
+        dp = max(int(layer.get("dp", 1) or 1), 1)
+        cp = max(int(layer.get("cp", 1) or 1), 1)
+        ckpt = bool(layer.get("ckpt", 0))
+        if tp > 1:
+            lbsz = max(bsz // chunks // dp, 1)
+            act_mb = lbsz * seq_len * hidden_size * elem / mb_unit
+            n_msgs = 6 * chunks * (1.5 if ckpt else 1.0)
+            scale = n_msgs * 0.5 / pp
+            cands = []
+            pair = ab.get(f"{tp}_1")
+            if pair:
+                cands.append((pair[0] + act_mb / pair[1]) * scale)
+            for alg_lvl, (alpha, beta) in (
+                    ab_algos.get(f"{tp}_1") or {}).items():
+                if alg_lvl.endswith("_ici") and beta:
+                    cands.append((alpha + act_mb / beta) * scale)
+            if cands:
+                total += min(cands)
+                priced = True
+        sdp = max(dp * cp * (tp_full if sp else 1), 1)
+        if sdp > 1:
+            consec = 1 if tp == 1 else 0
+            pair = (ab.get(f"{sdp}_{consec}") or ab.get(f"{sdp}_1")
+                    or ab.get(f"{sdp}_0"))
+            if pair:
+                grad_mb = param_mb / max(tp, 1) * \
+                    (0.5 if mixed_precision else 1.0)
+                total += (pair[0] + grad_mb / pair[1]) / pp
+                priced = True
+    return total if priced else None

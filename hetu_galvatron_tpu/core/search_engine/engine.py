@@ -20,7 +20,7 @@ from __future__ import annotations
 import math
 import os
 from dataclasses import dataclass
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -277,8 +277,57 @@ class SearchEngine:
                 best = r
         self._write_search_trace(tasks, results, best)
         if best.throughput > 0:
-            self.save_results(best)
+            self.save_results(best, runner_ups=self._runner_ups(results,
+                                                                best))
         return best.throughput
+
+    def _runner_ups(self, results: List[TaskResult], best: TaskResult
+                    ) -> List[Dict[str, Any]]:
+        """The top-``args.runner_up_k`` feasible non-winning candidates
+        (deduped by plan signature, throughput-ordered) in the stored
+        shape ``cost_model.reprice_stored_plan_ms`` prices — embedded in
+        the plan JSON so the runtime's plan-regret sentinel
+        (``observability.calibration``) can re-price "the plans the search
+        almost picked" under calibrated curves long after the search
+        ran."""
+        from hetu_galvatron_tpu.utils.strategy import form_strategy
+
+        k = max(int(getattr(self.args, "runner_up_k", 0) or 0), 0)
+        if k == 0:
+            return []
+
+        def sig(r: TaskResult) -> Tuple:
+            return (r.bsz, r.chunks, r.pp_size,
+                    tuple(s.to_runtime().key() for s in r.strategy_list),
+                    tuple(r.pp_stage_list or ()))
+
+        seen = {sig(best)} if best.strategy_list is not None else set()
+        out: List[Dict[str, Any]] = []
+        for r in sorted((r for r in results
+                         if r.strategy_list is not None
+                         and r.throughput > 0),
+                        key=lambda r: -r.throughput):
+            s = sig(r)
+            if s in seen:
+                continue
+            seen.add(s)
+            layers = []
+            for st in r.strategy_list:
+                rt = st.to_runtime()
+                layers.append({
+                    "tp": rt.tp_size, "dp": rt.dp_size, "cp": rt.cp_size,
+                    "sp": int(rt.sp), "ckpt": int(rt.checkpoint),
+                    "consec": int(rt.tp_consecutive)})
+            out.append({
+                "throughput": round(r.throughput, 6),
+                "time_cost_ms": round(r.time_cost * 1e3, 6),
+                "bsz": r.bsz, "chunks": r.chunks, "pp": r.pp_size,
+                "strategies": [form_strategy(st.to_runtime())
+                               for st in r.strategy_list],
+                "layers": layers})
+            if len(out) >= k:
+                break
+        return out
 
     def _write_search_trace(self, tasks, results, best: TaskResult) -> None:
         """Audit trail: one JSONL event per explored task + the winner
@@ -679,9 +728,15 @@ class SearchEngine:
 
     # ---------------- output ----------------
 
-    def save_results(self, best: TaskResult) -> str:
+    def save_results(self, best: TaskResult,
+                     runner_ups: Optional[List[Dict[str, Any]]] = None
+                     ) -> str:
         """Write the interchange JSON (reference save_results,
-        search_engine.py:749-785)."""
+        search_engine.py:749-785). ``runner_ups`` (see
+        :meth:`_runner_ups`) and the winner's own priced total ride along
+        as extra keys — ``config2strategy`` ignores them, so old readers
+        are unaffected — giving the runtime's plan-regret sentinel its
+        re-pricing baseline."""
         default_dp = DPType.from_name(self.default_dp_type)
         runtime = []
         for s in best.strategy_list:
@@ -748,6 +803,10 @@ class SearchEngine:
             num_encoder_layers=getattr(self, "num_encoder_layers", None),
             predicted_layer_compute_ms=pred_ms,
             hier_dp=hier_chosen, hier_bucket_mb=hier_bucket)
+        if best.time_cost != float("inf"):
+            cfg["predicted_time_cost_ms"] = round(best.time_cost * 1e3, 6)
+        if runner_ups:
+            cfg["runner_ups"] = runner_ups
         a = self.args
         off = [name for flag, name in (
             (a.disable_dp, "dp"), (a.disable_tp, "tp"), (a.disable_pp, "pp"),

@@ -138,6 +138,31 @@ def read_alpha_beta_algos(config: Any
     return out
 
 
+def read_profile_provenance(config: Any) -> Dict[str, Any]:
+    """The ``calibration_meta`` provenance payload of a hardware profile
+    (written by ``observability.calibration.refit_profile``: source tag,
+    per-curve point counts + fit method, fit window, fingerprint), or
+    ``{}`` for plain profiled JSONs. Both α-β parsers above skip the key
+    entirely, so provenance is free to ride along in the same file."""
+    env = read_json(config) if isinstance(config, str) else config
+    meta = env.get("calibration_meta") if isinstance(env, dict) else None
+    return meta if isinstance(meta, dict) else {}
+
+
+def merge_calibrated_profile(prior: Dict[str, Any],
+                             calibrated: Dict[str, Any]) -> Dict[str, Any]:
+    """Overlay runtime-calibrated curves on a profiled prior: calibrated
+    α-β (and ``calibration_meta``) keys win, every other prior key — bare
+    bandwidth entries, p2p tables, anything the profiler wrote — carries
+    over untouched. The result is a complete standalone hardware profile:
+    point ``allreduce_bandwidth_config_path`` (or the audit hook) at it
+    and curves the traces re-fit replace the one-shot ones while
+    unfitted curves keep their prior."""
+    out = dict(prior or {})
+    out.update(calibrated or {})
+    return out
+
+
 def read_p2p_bandwidth(config: Any) -> Tuple[Dict[int, float], Dict[int, float]]:
     """pp_size -> (bandwidth, 1/bandwidth) (reference config_utils.py:77-89)."""
     env = read_json(config) if isinstance(config, str) else config

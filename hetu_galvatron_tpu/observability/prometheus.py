@@ -117,6 +117,7 @@ class MetricsHTTPServer:
         self.health_fn = health_fn
         self._t_start: Optional[float] = None
         self._last_step_t: Optional[float] = None
+        self._last_audit_t: Optional[float] = None
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -124,6 +125,13 @@ class MetricsHTTPServer:
         """Mark one unit of forward progress (engine/train step); the
         ``/healthz`` ``last_step_age_s`` field reads this."""
         self._last_step_t = time.monotonic()
+
+    def note_audit(self) -> None:
+        """Mark a completed plan-audit/calibration pass; the ``/healthz``
+        ``last_audit_age_s`` field reads this (null until the first pass
+        — a monitor alerting on staleness can tell "never audited" from
+        "audited long ago")."""
+        self._last_audit_t = time.monotonic()
 
     def health(self) -> Dict[str, Any]:
         now = time.monotonic()
@@ -133,6 +141,9 @@ class MetricsHTTPServer:
                          if self._t_start is not None else 0.0),
             "last_step_age_s": (now - self._last_step_t
                                 if self._last_step_t is not None else None),
+            "last_audit_age_s": (
+                now - self._last_audit_t
+                if self._last_audit_t is not None else None),
         }
         if self.health_fn is not None:
             try:

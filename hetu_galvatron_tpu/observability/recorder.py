@@ -76,6 +76,11 @@ class FlightRecorder:
         self.prefix = prefix
         self._ring: "collections.deque[Dict[str, Any]]" = collections.deque(
             maxlen=self.capacity)
+        # pinned records (retain()): keyed by name, latest wins, never
+        # evicted by ring pressure — calibration state (last plan_audit /
+        # plan_regret) must survive into a dump taken thousands of events
+        # later
+        self._retained: Dict[str, Dict[str, Any]] = {}
         self.dumped: List[str] = []  # paths of successful dumps
         self.last_error: Optional[BaseException] = None
 
@@ -99,6 +104,16 @@ class FlightRecorder:
         """Record one ad-hoc entry (timestamps like the event stream)."""
         self.record(name, {"ev": name, "tm": time.monotonic() * 1000.0,
                            **data})
+
+    def retain(self, name: str, data: Dict[str, Any]) -> None:
+        """Pin one record outside the ring: the LAST ``retain(name, ...)``
+        per name is carried in every later :meth:`snapshot` under
+        ``retained`` regardless of how many ring events have since
+        evicted it. Used for low-frequency, high-value state — the last
+        ``plan_audit`` / ``plan_regret`` — so post-crash triage sees the
+        calibration picture at failure time."""
+        self._retained[str(name)] = {"name": str(name),
+                                     "t": time.time(), "data": data}
 
     def events(self) -> List[Dict[str, Any]]:
         return list(self._ring)
@@ -124,6 +139,7 @@ class FlightRecorder:
             "pid": os.getpid(),
             "exception": None,
             "events": list(self._ring),
+            "retained": dict(self._retained),
             "metrics": metrics,
         }
         if exc is not None:

@@ -42,32 +42,42 @@ class JsonlSink:
     loop never blocks on file I/O; ``close()`` flushes. The file is opened
     lazily on first flush so constructing a sink for a run that emits
     nothing leaves no artifact behind.
+
+    Each flush lands as ONE ``os.write`` on an ``O_APPEND`` descriptor:
+    when several processes (supervisor + restarted attempt, or a
+    calibration sidecar) append to the same stream, their batches
+    interleave at whole-flush granularity instead of mid-line — the
+    reader-side contract (``cli/summarize.py`` skips unparseable lines
+    with a warning) then only ever faces a torn FINAL line from a crash
+    mid-write, not interior corruption.
     """
 
     def __init__(self, path: str):
         self.path = path
         self._buf: List[str] = []
-        self._f = None
+        self._fd: Optional[int] = None
 
     def write(self, record: Dict[str, Any]) -> None:
         self._buf.append(json.dumps(record, separators=(",", ":"),
                                     default=_jsonable))
+
     def flush(self) -> None:
         if not self._buf:
             return
-        if self._f is None:
+        if self._fd is None:
             d = os.path.dirname(os.path.abspath(self.path))
             os.makedirs(d, exist_ok=True)
-            self._f = open(self.path, "a")
-        self._f.write("\n".join(self._buf) + "\n")
-        self._f.flush()
+            self._fd = os.open(self.path,
+                               os.O_WRONLY | os.O_CREAT | os.O_APPEND,
+                               0o644)
+        os.write(self._fd, ("\n".join(self._buf) + "\n").encode("utf-8"))
         self._buf.clear()
 
     def close(self) -> None:
         self.flush()
-        if self._f is not None:
-            self._f.close()
-            self._f = None
+        if self._fd is not None:
+            os.close(self._fd)
+            self._fd = None
 
 
 def _jsonable(x):

@@ -108,6 +108,39 @@ def test_healthz_endpoint():
             assert "serve_submitted_total 1.0" in resp.read().decode()
 
 
+def test_healthz_last_audit_age_and_calibration_scrape():
+    """The calibration loop's freshness is visible on BOTH routes: the
+    /healthz liveness payload carries last_audit_age_s (null until the
+    first audit — "never audited" is distinguishable from "stale"), and
+    the calibration/* gauges render under sanitized names on /metrics."""
+    import json
+    import time
+
+    reg = MetricsRegistry()
+    reg.gauge("calibration/plan_regret_ms").set(3.41)
+    reg.gauge("calibration/drift_score").set(0.25)
+    with MetricsHTTPServer(reg, port=0, host="127.0.0.1") as srv:
+        hurl = f"http://127.0.0.1:{srv.port}/healthz"
+        with urllib.request.urlopen(hurl, timeout=5) as resp:
+            assert json.loads(resp.read())["last_audit_age_s"] is None
+        srv.note_audit()
+        time.sleep(0.01)
+        with urllib.request.urlopen(hurl, timeout=5) as resp:
+            payload = json.loads(resp.read())
+        assert 0.0 < payload["last_audit_age_s"] < 5.0
+        # a step does not refresh the audit age (they age independently)
+        srv.note_step()
+        with urllib.request.urlopen(hurl, timeout=5) as resp:
+            payload2 = json.loads(resp.read())
+        assert payload2["last_audit_age_s"] >= payload["last_audit_age_s"]
+        murl = f"http://127.0.0.1:{srv.port}/metrics"
+        with urllib.request.urlopen(murl, timeout=5) as resp:
+            body = resp.read().decode()
+        assert "# TYPE calibration_plan_regret_ms gauge" in body
+        assert "calibration_plan_regret_ms 3.41" in body
+        assert "calibration_drift_score 0.25" in body
+
+
 def test_healthz_health_fn_failure_keeps_probe_alive():
     import json
 

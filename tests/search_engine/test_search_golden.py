@@ -96,6 +96,27 @@ def test_fine_grained_golden(tmp_path):
     expect = (["1-4*-2f-c"] * 14) + (["1-4*-2f"] * 12) + (["1-4*-2"] * 2)
     assert got == expect, got
 
+    # the plan-regret sentinel's inputs ride along in the same file: the
+    # winner's priced step time and the deduped top-k runner-ups, each in
+    # the stored-strategy shape cost_model.reprice_stored_plan_ms prices.
+    # config2strategy ignores both keys, so config_mode=json loads are
+    # unaffected (the golden layer strings above already proved that)
+    assert cfg["predicted_time_cost_ms"] == pytest.approx(24164.538105)
+    rups = cfg["runner_ups"]
+    assert len(rups) == 3  # search.runner_up_k default
+    for r in rups:
+        assert r["throughput"] < GOLDEN_FINE
+        assert r["time_cost_ms"] > cfg["predicted_time_cost_ms"]
+        assert r["bsz"] == 64
+        assert r["pp"] >= 1
+        assert r["strategies"]
+        assert all(set(lay) == {"tp", "dp", "cp", "sp", "ckpt", "consec"}
+                   for lay in r["layers"])
+    # ranked best-first, distinct plans
+    assert [r["throughput"] for r in rups] == sorted(
+        (r["throughput"] for r in rups), reverse=True)
+    assert len({json.dumps(r["layers"]) + str(r["pp"]) for r in rups}) == 3
+
 
 def test_coarse_golden(tmp_path):
     eng = _make_engine(tmp_path, settle_chunks=8, fine_grained=0)

@@ -261,10 +261,53 @@ def smoke() -> int:
                                  "hier_dp_bucketed"}
                and ok_dev
                and all(r["status"].startswith("skipped") for r in rows)
-               and "NO VERDICT" in buf.getvalue())
+               and "NO VERDICT" in buf.getvalue()
+               and _regret_smoke())
     print(f"bench gate --smoke: "
           f"{'self-check OK' if healthy else 'SELF-CHECK FAILED'}")
     return 0 if healthy else 1
+
+
+def _regret_smoke() -> bool:
+    """Synthetic plan-regret detection case (observability/calibration):
+    calibrated curves that halve the collective cost must flip a
+    comm-heavy runner-up past the incumbent (triggered), while calibrated
+    == prior must not. Keeps the regret sentinel's arithmetic under the
+    same no-bench-data self-check the perf legs get."""
+    try:
+        try:
+            from hetu_galvatron_tpu.observability.calibration import (
+                evaluate_plan_regret,
+            )
+        except ImportError:
+            # run as a bare script (python tools/bench_gate.py): the repo
+            # root is not on sys.path — add it and retry
+            sys.path.insert(0, os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__))))
+            from hetu_galvatron_tpu.observability.calibration import (
+                evaluate_plan_regret,
+            )
+    except ImportError as e:
+        print(f"regret smoke: calibration module unavailable ({e})")
+        return False
+    prior = {"2_1": (0.1, 100.0), "4_1": (0.1, 100.0)}
+    calib = {"2_1": (0.05, 200.0), "4_1": (0.05, 200.0)}
+    incumbent = {"time_cost_ms": 100.0, "pp": 1, "bsz": 8, "chunks": 2,
+                 "layers": [{"tp": 1, "dp": 2}] * 2}
+    hungry = {"time_cost_ms": 101.0, "pp": 1, "bsz": 8, "chunks": 2,
+              "layers": [{"tp": 4, "dp": 2}] * 2}
+    kw = dict(seq_len=4096, hidden_size=4096, param_mb=8.0,
+              mixed_precision=True, threshold=0.001)
+    hit = evaluate_plan_regret(incumbent, [hungry], prior=(prior, None),
+                               calibrated=(calib, None), **kw)
+    quiet = evaluate_plan_regret(incumbent, [hungry], prior=(prior, None),
+                                 calibrated=(prior, None), **kw)
+    ok = (bool(hit["triggered"]) and hit["regret_ms"] > 0
+          and not quiet["triggered"] and quiet["regret_ms"] == 0.0)
+    print(f"regret smoke: {'ok' if ok else 'FAILED'} "
+          f"(triggered {hit['regret_ms']:.3f} ms; quiet "
+          f"{quiet['regret_ms']:.3f} ms)")
+    return ok
 
 
 def main(argv: Optional[List[str]] = None) -> int:
