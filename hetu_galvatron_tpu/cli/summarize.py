@@ -496,6 +496,54 @@ def summarize(path: str, out=None,
               f"{_fmt(100.0 * (d.get('threshold') or 0.0))}% threshold) "
               "under calibrated curves — consider re-searching the plan")
 
+    # -- supervisor timeline (cli/supervise.py events) + RPO table --
+    sup_ev = [r for r in records if r.get("kind") == "event"
+              and r.get("name") == "supervisor"]
+    if sup_ev:
+        headline["supervisor_events"] = len(sup_ev)
+        t0 = sup_ev[0].get("t")
+        w()
+        w("-- supervisor timeline (cross-process restarts) --")
+        w(f"{'t+s':>8}  {'event':<14}{'attempt':>8}{'code':>6}"
+          f"{'commit':>8}{'RPO s':>8}")
+        exits = []
+        for r in sup_ev:
+            d = r.get("data", {})
+            if not isinstance(d, dict):
+                continue
+            rel = (r.get("t") - t0) if isinstance(r.get("t"), (int, float)) \
+                and isinstance(t0, (int, float)) else None
+            code = d.get("code")
+            rpo = d.get("rpo_s")
+            w(f"{(_fmt(rel) if rel is not None else '-'):>8}  "
+              f"{str(d.get('event', '?')):<14}"
+              f"{str(d.get('attempt', '-')):>8}"
+              f"{(str(code) if code is not None else '-'):>6}"
+              f"{(str(d.get('commit_step')) if d.get('commit_step') is not None else '-'):>8}"
+              f"{(_fmt(rpo) if rpo is not None else '-'):>8}")
+            if d.get("event") == "child_exit":
+                exits.append(d)
+        final = sup_ev[-1].get("data", {})
+        headline["supervisor_final_event"] = final.get("event")
+        headline["supervisor_attempts"] = max(
+            (d.get("attempt", 0) for d in exits), default=None)
+        if exits:
+            # RPO table: wall-clock of un-checkpointed work lost at each
+            # child death — the bound ckpt.interval_s buys
+            rpos = [d["rpo_s"] for d in exits
+                    if isinstance(d.get("rpo_s"), (int, float))]
+            nonzero = [d for d in exits if d.get("code")]
+            headline["supervisor_child_exits"] = len(exits)
+            if rpos:
+                headline["supervisor_rpo_max_s"] = max(rpos)
+                w(f"child exits      {len(exits)} "
+                  f"({len(nonzero)} abnormal) | RPO max "
+                  f"{_fmt(max(rpos))}s mean "
+                  f"{_fmt(sum(rpos) / len(rpos))}s")
+            progressed = sum(1 for d in exits if d.get("progressed"))
+            w(f"progress         {progressed}/{len(exits)} exits had "
+              "committed new work (restart budget resets)")
+
     # -- compiled-program cost accounting (cost/* gauges) --
     costs = [(json.loads(lb).get("program", "?"), n.split("/", 1)[1], r)
              for (k, n, lb), r in latest.items()

@@ -1,0 +1,192 @@
+"""Cross-process elastic training supervisor — the production restart
+loop around ``train_dist``.
+
+::
+
+    python -m hetu_galvatron_tpu.cli.supervise <config.yaml> [k=v ...]
+
+Spawns ``python -m hetu_galvatron_tpu.cli.train_dist`` with the same
+config/overrides (plus ``supervisor.mode=inprocess
+supervisor.auto_restart=false`` so the child never recurses, and a
+per-attempt ``ckpt.load=<ckpt.save>`` once a committed checkpoint
+exists) and relaunches it per the exit-code contract. Unlike the
+in-process loop (``run_with_restarts``), a relaunch re-reads the fleet:
+device loss/gain shows up as a world change, and a SIGKILL'd child
+costs one attempt, not the run.
+
+Exit-code contract (child -> supervisor action):
+
+====  =====================================  =========================
+code  meaning                                supervisor action
+====  =====================================  =========================
+0     training complete                      stop (success)
+16    rerun machine: resume-to-disambiguate  restart from last commit
+17    persistent validation fault / elastic  TERMINAL — restarting
+      OOM rejection                          reproduces the fault
+18    preempted (SIGTERM trapped, ckpt       restart from last commit
+      committed at the step boundary)
+130   operator SIGINT (deliberate stop)      TERMINAL — never
+                                             resurrect a ^C'd run
+< 0   child killed by a signal (OOM killer,  crash: restart while the
+      SIGKILL mid-save, segfault)            budget lasts; surfaced
+                                             terminally as 128+signum
+1     unhandled exception in the child       crash: restart while the
+                                             budget lasts
+other (2 = argparse usage error, ...)        TERMINAL — restarting a
+                                             misconfiguration only
+                                             burns the budget
+====  =====================================  =========================
+
+The restart budget (``supervisor.max_restarts``) counts CONSECUTIVE
+no-progress restarts: a new committed checkpoint — or a changed world,
+``supervisor.max_world_changes`` times — resets it, so a long run on a
+preemptible fleet survives unbounded preemptions while a crash loop
+still terminates. Backoff between relaunches is full-jitter
+exponential (``supervisor.backoff_base_s``/``backoff_max_s``).
+
+Supervisor state (attempt count, budgets, last-commit receipt) persists
+tmp+rename-atomically in ``supervisor.state_file`` (default
+``<ckpt.save>/SUPERVISOR_STATE.json``), so a supervisor that is itself
+preempted resumes with the budgets it had. Before every relaunch the
+``RESUME_PIN`` lease is stamped so the child's retention GC cannot
+prune the very step dir the relaunch resumes from.
+
+Observability: the supervisor appends ``supervisor`` events to the SAME
+metrics JSONL the child writes (``JsonlSink`` appends are O_APPEND +
+single-``write`` atomic, so interleaving is safe), dumps a flight
+record per child death when a flight dir is configured, and serves
+``/healthz`` (attempt count, last child exit code, backoff state,
+last-commit age = live RPO) on ``supervisor.metrics_port``.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import List, Optional, Sequence
+
+from hetu_galvatron_tpu.runtime import ckpt_paths
+from hetu_galvatron_tpu.runtime.supervisor import (
+    ProcessSupervisor,
+    SupervisorState,
+)
+
+# overrides forced onto every child, AFTER the operator's own (later
+# dotted overrides win): the child must run exactly one attempt
+_CHILD_FORCED = ("supervisor.mode=inprocess",
+                 "supervisor.auto_restart=false")
+
+
+def _metrics_path_of(args) -> Optional[str]:
+    """The same metrics-JSONL derivation train_dist's telemetry uses
+    (trainer.make_telemetry), so supervisor events land in the child's
+    stream."""
+    obs = args.observability
+    if not obs.enabled and not obs.metrics_path:
+        return None
+    return obs.metrics_path or os.path.join(
+        args.logging.tensorboard_dir or ".", "metrics.jsonl")
+
+
+def _flight_dir_of(args) -> Optional[str]:
+    """Match train_dist._flight_dir_of: explicit flight_dir, else the
+    metrics stream's directory — supervisor dumps sit next to child
+    dumps."""
+    obs = args.observability
+    if obs.flight_dir is None and not obs.enabled:
+        return None
+    if obs.flight_dir is not None:
+        return obs.flight_dir
+    return os.path.dirname(os.path.abspath(
+        obs.metrics_path or os.path.join(
+            args.logging.tensorboard_dir or ".", "metrics.jsonl")))
+
+
+def child_argv(base_argv: Sequence[str], args,
+               state: SupervisorState) -> List[str]:
+    """The child command line for one attempt: the operator's argv,
+    then the forced single-attempt overrides, then (once a commit
+    exists) the resume override — appended LAST so they win."""
+    cmd = [sys.executable, "-m", "hetu_galvatron_tpu.cli.train_dist"]
+    cmd.extend(base_argv)
+    cmd.extend(_CHILD_FORCED)
+    if args.ckpt.save and \
+            ckpt_paths.latest_committed_step(args.ckpt.save) is not None:
+        # resume from this run's own progress as soon as it exists — a
+        # warm-start ckpt.load pointing elsewhere must not make every
+        # restart retrain from the warm-start step
+        cmd.append(f"ckpt.load={args.ckpt.save}")
+    return cmd
+
+
+def run_supervised(args, base_argv: Sequence[str]) -> int:
+    """Supervise ``train_dist`` children built from ``base_argv`` until
+    the run completes, turns terminal, or the budget is spent. Jax-free:
+    this process must not touch the accelerator its children need."""
+    sup = args.supervisor
+    registry = None
+    metrics_path = _metrics_path_of(args)
+    if metrics_path:
+        from hetu_galvatron_tpu.observability.registry import configure
+
+        registry = configure(jsonl_path=metrics_path)
+    recorder = None
+    flight_dir = _flight_dir_of(args)
+    if flight_dir:
+        from hetu_galvatron_tpu.observability.recorder import FlightRecorder
+
+        recorder = FlightRecorder(registry=registry, out_dir=flight_dir,
+                                  prefix="flight_supervisor",
+                                  capacity=args.observability.flight_events)
+
+    supervisor = ProcessSupervisor(
+        lambda state: child_argv(base_argv, args, state),
+        save_dir=args.ckpt.save or None,
+        state_file=sup.state_file,
+        max_restarts=sup.max_restarts,
+        max_world_changes=sup.max_world_changes,
+        base_delay=sup.backoff_base_s,
+        max_delay=sup.backoff_max_s,
+        restart_on_error=sup.restart_on_error,
+        term_grace_s=sup.term_grace_s,
+        poll_interval=sup.poll_interval_s,
+        registry=registry,
+        recorder=recorder,
+    )
+
+    server = None
+    if sup.metrics_port >= 0:
+        from hetu_galvatron_tpu.observability.prometheus import (
+            MetricsHTTPServer,
+        )
+
+        server = MetricsHTTPServer(registry=registry,
+                                   port=sup.metrics_port,
+                                   health_fn=supervisor.health)
+        port = server.start()
+        print(f"supervisor: /healthz and /metrics on "
+              f"http://127.0.0.1:{port}", flush=True)
+    try:
+        rc = supervisor.run()
+    finally:
+        if server is not None:
+            server.stop()
+        if registry is not None:
+            try:
+                registry.close()
+            except Exception as e:  # noqa: BLE001 — exit code is decided
+                print(f"supervisor: warning: metrics close failed "
+                      f"({type(e).__name__}: {e})", flush=True)
+    return rc
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    from hetu_galvatron_tpu.core.arguments import args_from_cli
+
+    base_argv = list(argv if argv is not None else sys.argv[1:])
+    args = args_from_cli(base_argv, mode="train_dist")
+    return run_supervised(args, base_argv)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

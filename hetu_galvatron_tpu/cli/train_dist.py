@@ -60,12 +60,14 @@ def train(args) -> Dict[str, Any]:
     from hetu_galvatron_tpu.models.builder import init_causal_lm
     from hetu_galvatron_tpu.parallel.spmd import make_spmd_train_step, shard_params
     from hetu_galvatron_tpu.runtime.checkpoint import (
+        CheckpointCadence,
+        clear_resume_pin,
         latest_checkpoint,
-        load_checkpoint,
-        read_checkpoint_meta,
+        load_latest_resilient,
         save_checkpoint,
-        wait_for_checkpoints,
+        try_read_checkpoint_meta,
     )
+    from hetu_galvatron_tpu.runtime.chaos import make_chaos
     from hetu_galvatron_tpu.runtime.dataloader import (
         get_train_valid_test_data_iterators,
         skip_batches,
@@ -110,7 +112,7 @@ def train(args) -> Dict[str, Any]:
 
         live_world = visible_world_size(args)
         ckdir0 = latest_checkpoint(args.ckpt.load)
-        stored_plan = (read_checkpoint_meta(ckdir0)
+        stored_plan = (try_read_checkpoint_meta(ckdir0)[0]
                        .get("hybrid_parallel_config") if ckdir0 else None)
         stored_world = (stored_plan or {}).get("world_size")
         if stored_world and int(stored_world) != live_world:
@@ -197,6 +199,16 @@ def train(args) -> Dict[str, Any]:
     guard = PreemptionGuard(enabled=args.supervisor.graceful_signals,
                             recorder=recorder)
     drill = FaultDrill(args.rerun)
+    # chaos fault plan (runtime/chaos.py): step-targeted crashes/signals
+    # plus mid-save and retry-seam faults, one-shot across process
+    # restarts via marker files next to the checkpoints
+    chaos = make_chaos(args,
+                       registry=(telemetry.registry if telemetry is not None
+                                 else None),
+                       log=state.log)
+    if chaos is not None:
+        chaos.install()
+        state.log(f"chaos: armed faults {chaos.pending()}")
     start_iter = 0
 
     # overlapped-TP collectives (tp_overlap.enable, ops/overlap.py):
@@ -366,16 +378,20 @@ def train(args) -> Dict[str, Any]:
             ts["rerun"] = rerun.state_dict()
         return ts
 
+    # one save policy for both cadences (step interval + ckpt.interval_s
+    # wall cadence) and both write modes (sync/orbax-async, or the
+    # on-device-snapshot writer thread when ckpt.snapshot_async) —
+    # chaos mid-save faults ride the same hooks seam production uses
+    cadence = CheckpointCadence(
+        args.ckpt, hpc=hpc, goodput=goodput, log=state.log,
+        hooks=(chaos.save_hooks() if chaos is not None else None))
+
     def maybe_save(it, sp, so):
-        ck = args.ckpt
-        if ck.save and ck.save_interval and (it + 1) % ck.save_interval == 0:
-            with goodput.measure("checkpoint_save"):
-                save_checkpoint(ck.save, it + 1, sp, so, hpc=hpc,
-                                async_save=ck.async_save,
-                                train_state=train_state_at(
-                                    it + 1, consumed_box[0],
-                                    batches=data_iter.batches_consumed),
-                                keep_last=ck.keep_last)
+        if cadence.due(it):
+            cadence.save(it + 1, sp, so,
+                         train_state=train_state_at(
+                             it + 1, consumed_box[0],
+                             batches=data_iter.batches_consumed))
             state.log(f"saved checkpoint at iter {it + 1}")
 
     def maybe_resume(sp, so):
@@ -445,13 +461,33 @@ def train(args) -> Dict[str, Any]:
                         f"({elastic['stored_world']} -> {world} devices) "
                         f"onto plan [{hpc.describe()}] at iter {start}")
                 else:
+                    # resilient restore: a corrupted newest checkpoint
+                    # (truncated meta.json, missing payload leaf, stray
+                    # COMMITTED marker over a torn payload) falls back to
+                    # the previous committed step with a warning, never a
+                    # traceback — losing save_interval steps beats losing
+                    # the run
                     with goodput.measure("resume_replay"):
-                        sp, so, start = load_checkpoint(
-                            ckdir, sp, so, hpc=hpc,
+                        res = load_latest_resilient(
+                            args.ckpt.load, sp, so, hpc=hpc,
                             strict_plan=args.ckpt.distributed_checkpoint,
-                            expected_world=world)
+                            expected_world=world, log=state.log)
+                    if res is None:
+                        state.log(f"warning: {args.ckpt.load}: committed "
+                                  "checkpoint vanished before resume; "
+                                  "starting fresh")
+                        return sp, so, 0
+                    sp, so, start, ckdir = res
                     state.log(f"resumed from {ckdir} at iter {start}")
-                meta = read_checkpoint_meta(ckdir)
+                # the supervisor's cross-process GC lease protected this
+                # restore; now that the read landed, retention may proceed
+                clear_resume_pin(args.ckpt.load)
+                meta, meta_err = try_read_checkpoint_meta(ckdir)
+                if meta_err is not None:
+                    state.log(f"warning: {ckdir}/meta.json unreadable "
+                              f"({meta_err}); resuming without the "
+                              "train_state payload (position reconstructed "
+                              "from the step number)")
                 stored = meta.get("hybrid_parallel_config") or {}
                 ts = meta.get("train_state") or {}
                 if ts.get("goodput"):
@@ -549,6 +585,11 @@ def train(args) -> Dict[str, Any]:
                 profiler.time_start(it)
                 it_t0 = time.perf_counter()
                 consumed_prev = consumed_box[0]
+                if chaos is not None:
+                    # fault plan fires BEFORE the update: 'crash at step
+                    # k' loses exactly the steps since the last commit —
+                    # the RPO the drill asserts on
+                    chaos.on_step(it)
                 if calc is not None:
                     if calc.update(consumed_box[0]):
                         state.log(f"ramping global batch size to "
@@ -629,7 +670,7 @@ def train(args) -> Dict[str, Any]:
                             # never race an in-flight save; the drain is
                             # save time too (async saves bill their wall
                             # here, not at dispatch)
-                            wait_for_checkpoints()
+                            cadence.drain()
                             save_checkpoint(
                                 args.ckpt.save, it, prev[0], prev[1],
                                 hpc=hpc,
@@ -638,7 +679,8 @@ def train(args) -> Dict[str, Any]:
                                 train_state=train_state_at(
                                     it, consumed_prev,
                                     batches=data_iter.batches_consumed - 1),
-                                keep_last=args.ckpt.keep_last)
+                                keep_last=args.ckpt.keep_last,
+                                hooks=cadence.hooks)
                     break
                 if guard.requested():
                     # preemption/interrupt at a step boundary: the update
@@ -657,13 +699,14 @@ def train(args) -> Dict[str, Any]:
                         # the interval save above did not already cover
                         # this exact step
                         with goodput.measure("checkpoint_save"):
-                            wait_for_checkpoints()
+                            cadence.drain()
                             save_checkpoint(
                                 ck.save, it + 1, sp, so, hpc=hpc,
                                 train_state=train_state_at(
                                     it + 1, consumed_box[0],
                                     batches=data_iter.batches_consumed),
-                                keep_last=ck.keep_last)
+                                keep_last=ck.keep_last,
+                                hooks=cadence.hooks)
                     break
         except BaseException as e:
             # crash forensics BEFORE re-raising: the dump (ring + metric
@@ -674,6 +717,8 @@ def train(args) -> Dict[str, Any]:
             raise
         finally:
             guard.__exit__()
+            if chaos is not None:
+                chaos.uninstall()
             try:
                 # drain async saves even on the crash path: a supervised
                 # in-process restart must never inherit live background
@@ -681,7 +726,7 @@ def train(args) -> Dict[str, Any]:
                 # The blocking drain IS checkpoint time — async saves
                 # bill their real wall here, not at dispatch
                 with goodput.measure("checkpoint_save"):
-                    wait_for_checkpoints()
+                    cadence.drain()
             except Exception as e:  # noqa: BLE001 — never mask the crash
                 state.log(f"warning: async checkpoint drain failed: {e}")
             # crash-safe: flush an open XLA trace window + the metrics
@@ -911,7 +956,7 @@ def train(args) -> Dict[str, Any]:
         sp, so = run_loop(sp, so, finish_tp_overlap_setup(spmd_step))
 
     with goodput.measure("checkpoint_save"):
-        wait_for_checkpoints()
+        cadence.drain()
     test_loss = None
     if (test_iter is not None and "fn" in eval_box and exit_code is None
             and losses):
@@ -949,9 +994,19 @@ def _finish(out: Dict[str, Any]) -> int:
 def main(argv=None) -> int:
     from hetu_galvatron_tpu.core.arguments import args_from_cli
 
-    args = args_from_cli(argv if argv is not None else sys.argv[1:],
-                         mode="train_dist")
+    base_argv = list(argv if argv is not None else sys.argv[1:])
+    args = args_from_cli(base_argv, mode="train_dist")
     sup = args.supervisor
+    if sup.auto_restart and sup.mode == "process":
+        # production restart loop: delegate to the cross-process
+        # supervisor (cli/supervise.py), which relaunches this module as
+        # a child per attempt — exit codes, restart budget, RESUME_PIN,
+        # and world changes are then real across the process boundary.
+        # Nothing jax-flavored has run yet in this process, so the
+        # supervisor stays off the accelerator its children need.
+        from hetu_galvatron_tpu.cli.supervise import run_supervised
+
+        return run_supervised(args, base_argv)
     if not sup.auto_restart:
         return _finish(train(args))
 

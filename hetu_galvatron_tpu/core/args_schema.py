@@ -304,6 +304,20 @@ class CheckpointArgs(BaseModel):
     # retention: keep only the newest N committed step dirs (0 = keep all);
     # partial dirs from crashed saves are garbage-collected either way
     keep_last: int = 0
+    # time-based cadence alongside save_interval (seconds; 0 = step
+    # cadence only): a save triggers when EITHER is due, so elastic RPO
+    # is bounded in wall-clock even when steps slow down
+    interval_s: float = 0.0
+    # split each save into an on-step jitted device snapshot (bounded
+    # stall, measured as checkpoint/snapshot_stall_ms) + a background
+    # host-gather/write/commit thread (runtime/checkpoint.AsyncCheckpointer;
+    # single-controller only — multi-process pods fall back to the
+    # orbax async path with a logged reason)
+    snapshot_async: bool = False
+    # watchdog deadline for one background write: an in-flight save older
+    # than this is declared hung (checkpoint/hung_saves) and the exit
+    # drain stops waiting on it instead of blocking shutdown forever
+    save_timeout_s: float = 120.0
 
 
 class DataArgs(BaseModel):
@@ -508,6 +522,44 @@ class RerunArgs(BaseModel):
         return v
 
 
+class ChaosArgs(BaseModel):
+    """Seeded fault-injection harness knobs (runtime/chaos.py) — the
+    generalization of ``rerun.inject_kind`` from one at-step drill to a
+    fault PLAN driven through the real process supervisor."""
+
+    enable: bool = False
+    # JSON fault-plan file ({"seed": n, "faults": [{"kind", "at_iter",
+    # ...}, ...]}); wins over the inline kind/at_iter pair below
+    plan: Optional[str] = None
+    # inline single-fault plan (the chaos matrix cases):
+    #   crash         — raise InjectedCrash at the step boundary
+    #   sigterm       — deliver a real SIGTERM mid-step (preempt path)
+    #   sigkill       — SIGKILL the process mid-step (no cleanup at all)
+    #   kill_mid_save — SIGKILL from inside the save's pre-commit hook
+    #                   (torn staging dir, no COMMITTED marker)
+    #   hung_save     — stall the pre-commit hook past the watchdog
+    #   corrupt_meta  — overwrite the newest commit's meta.json with junk
+    #   truncate_meta — truncate the newest commit's meta.json mid-record
+    #   io_error      — transient OSErrors through utils/retrying.py
+    kind: Literal["none", "crash", "sigterm", "sigkill", "kill_mid_save",
+                  "hung_save", "corrupt_meta", "truncate_meta",
+                  "io_error"] = "none"
+    at_iter: int = -1
+    seed: int = 0
+    # io_error: how many injected failures before the op succeeds (must
+    # stay under the retry attempt budget to model a TRANSIENT fault)
+    io_error_count: int = 2
+    # io_error: only retry ops whose label contains this substring are
+    # targeted ("" = every op)
+    io_error_op: str = "checkpoint"
+    # hung_save: how long the pre-commit hook stalls
+    hang_s: float = 5.0
+    # cross-process one-shot markers (CHAOS_FIRED_<i>) live here so a
+    # fault does not re-fire on the relaunched attempt; None derives
+    # ckpt.save (the dir that already survives the process boundary)
+    state_dir: Optional[str] = None
+
+
 class SupervisorArgs(BaseModel):
     """Preemption/restart supervisor knobs (runtime/supervisor.py)."""
 
@@ -521,6 +573,30 @@ class SupervisorArgs(BaseModel):
     backoff_base_s: float = 1.0
     backoff_max_s: float = 60.0
     restart_on_error: bool = True
+    # how the restart loop runs (only with auto_restart):
+    #   inprocess — run_with_restarts re-invokes train() in THIS process
+    #               (drills; world/device list frozen at backend init)
+    #   process   — cli/supervise.py relaunches train_dist as a child
+    #               process per attempt (production: exit codes, restart
+    #               budget, RESUME_PIN and world changes are real across
+    #               the process boundary)
+    mode: Literal["inprocess", "process"] = "inprocess"
+    # process mode: SIGTERM forwarded to the child escalates to SIGKILL
+    # after this grace window (Cloud TPU preemption grants ~30s total;
+    # the supervisor must leave headroom for its own shutdown)
+    term_grace_s: float = 15.0
+    # process mode: tmp+rename-atomic supervisor state file (attempt
+    # count, restart budget, world-change budget, last-commit receipt);
+    # None derives <ckpt.save>/SUPERVISOR_STATE.json
+    state_file: Optional[str] = None
+    # process mode: how many observed topology changes may reset the
+    # restart budget before a flapping fleet stops counting as progress
+    max_world_changes: int = 8
+    # process mode: serve supervisor liveness on /healthz (+/metrics);
+    # -1 = off, 0 = ephemeral port (logged), >0 = fixed port
+    metrics_port: int = -1
+    # process mode: child poll + commit-receipt refresh cadence
+    poll_interval_s: float = 0.5
 
 
 class SearchArgs(BaseModel):
@@ -699,6 +775,7 @@ class CoreArgs(BaseModel):
     observability: ObservabilityArgs = Field(default_factory=ObservabilityArgs)
     serving: ServingArgs = Field(default_factory=ServingArgs)
     rerun: RerunArgs = Field(default_factory=RerunArgs)
+    chaos: ChaosArgs = Field(default_factory=ChaosArgs)
     supervisor: SupervisorArgs = Field(default_factory=SupervisorArgs)
     search: SearchArgs = Field(default_factory=SearchArgs)
     model_profiler: ModelProfileArgs = Field(default_factory=ModelProfileArgs)

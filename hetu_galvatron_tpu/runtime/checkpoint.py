@@ -19,8 +19,10 @@ from __future__ import annotations
 import json
 import os
 import shutil
-from dataclasses import dataclass
-from typing import Any, Dict, List, Optional
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
@@ -28,6 +30,12 @@ import numpy as np
 import orbax.checkpoint as ocp
 
 from hetu_galvatron_tpu.core.args_schema import ModelArgs
+from hetu_galvatron_tpu.runtime import ckpt_paths
+from hetu_galvatron_tpu.runtime.ckpt_paths import (
+    clear_resume_pin,
+    read_resume_pin,
+    write_resume_pin,
+)
 from hetu_galvatron_tpu.utils.retrying import retry_call
 
 Params = Dict[str, Any]
@@ -64,14 +72,25 @@ class WorldSizeMismatchError(ValueError):
 # garbage from a mid-save crash: never selected, eligible for GC. The
 # marker (not just the rename) is kept because object stores mounted via
 # FUSE can surface a directory rename non-atomically.
-COMMIT_MARKER = "COMMITTED"
-_TMP_SUFFIX = ".tmp"
-_OLD_SUFFIX = ".old"  # previous committed payload during an overwrite
+# The protocol's pure-path half (these constants, step parsing, commit
+# detection, the cross-process RESUME_PIN lease) is defined ONCE in
+# runtime/ckpt_paths.py so the jax-free process supervisor speaks the
+# same protocol; the aliases below keep this module's historical names.
+COMMIT_MARKER = ckpt_paths.COMMIT_MARKER
+_TMP_SUFFIX = ckpt_paths.TMP_SUFFIX
+_OLD_SUFFIX = ckpt_paths.OLD_SUFFIX
 
 # transient-read retry policy for checkpoint I/O (flaky object-store
 # mounts); override attempts via HGTPU_CKPT_RETRIES
 def _io_retries() -> int:
     return max(int(os.environ.get("HGTPU_CKPT_RETRIES", "3")), 1)
+
+
+# total-elapsed watchdog for one retried checkpoint I/O call (meta read
+# or shard restore): a mount that hangs rather than erroring must not
+# stall resume for attempts x hang; override via HGTPU_CKPT_DEADLINE_S
+def _io_deadline() -> float:
+    return max(float(os.environ.get("HGTPU_CKPT_DEADLINE_S", "120")), 0.1)
 
 
 def _count(name: str, **labels) -> None:
@@ -80,24 +99,10 @@ def _count(name: str, **labels) -> None:
     get_registry().counter(f"checkpoint/{name}", **labels).inc()
 
 
-def _step_of(entry: str) -> Optional[int]:
-    """``step_<int>`` -> int; anything else (orbax temp dirs,
-    ``step_5.partial``, our ``.tmp`` staging dirs) -> None."""
-    if not entry.startswith("step_"):
-        return None
-    suffix = entry[len("step_"):]
-    if not suffix.isdigit():
-        return None
-    return int(suffix)
-
-
-def is_committed(ckpt_dir: str) -> bool:
-    """A step dir counts as committed when it carries the commit marker
-    (new protocol) or a meta.json (pre-marker checkpoints, which wrote
-    meta.json last) — partial dirs from a mid-save crash have neither
-    under their final name."""
-    return (os.path.exists(os.path.join(ckpt_dir, COMMIT_MARKER))
-            or os.path.exists(os.path.join(ckpt_dir, "meta.json")))
+# ``step_<int>`` -> int (else None) / committed-dir detection: shared
+# with the jax-free supervisor via ckpt_paths
+_step_of = ckpt_paths.step_of
+is_committed = ckpt_paths.is_committed
 
 
 def _plan_fingerprint(hpc) -> Dict[str, Any]:
@@ -113,6 +118,13 @@ def _plan_fingerprint(hpc) -> Dict[str, Any]:
     return cfg
 
 
+class PlanMismatchError(ValueError):
+    """``strict_plan`` resume found a different plan fingerprint in the
+    checkpoint. Typed (vs a bare ValueError) so the resilient resume
+    loop can tell an OPERATOR error that reproduces on every candidate
+    apart from per-checkpoint corruption it should fall back past."""
+
+
 @dataclass
 class _PendingSave:
     """An async save still being written by orbax: the commit (marker +
@@ -123,6 +135,10 @@ class _PendingSave:
     final_dir: str
     root: str
     keep_last: int = 0
+    # chaos/test seam: hooks["before_commit"](tmp_dir) runs after the
+    # payload is fully staged, before the marker/rename — the window a
+    # kill-mid-save drill tears
+    hooks: Dict[str, Callable[..., Any]] = field(default_factory=dict)
 
 
 _PENDING: List[_PendingSave] = []
@@ -163,6 +179,7 @@ def save_checkpoint(
     async_save: bool = False,
     train_state: Optional[Dict[str, Any]] = None,
     keep_last: int = 0,
+    hooks: Optional[Dict[str, Callable[..., Any]]] = None,
 ) -> str:
     """Write step directory ``<path>/step_<n>`` with params/opt_state plus
     the hybrid-parallel plan JSON (reference hybrid_parallel_configs.json).
@@ -209,7 +226,8 @@ def save_checkpoint(
             json.dump(meta, f, indent=2)
     _count("saved")
     pending = _PendingSave(ckptrs, tmp_dir, ckpt_dir,
-                           os.path.abspath(path), keep_last)
+                           os.path.abspath(path), keep_last,
+                           dict(hooks or {}))
     if async_save:
         # orbax streams shards in the background; training overlaps the
         # write and wait_for_checkpoints() commits it at the next barrier
@@ -237,6 +255,13 @@ def _finish(p: _PendingSave) -> None:
     # orbax, but exactly one performs the marker/rename commit and the
     # retention GC (shared filesystem)
     if jax.process_index() == 0:
+        before_commit = p.hooks.get("before_commit")
+        if before_commit is not None:
+            # fully staged, not yet committed: the exact window a
+            # kill-mid-save chaos drill tears (and a hung-save drill
+            # stalls) — real faults die here too, so resume must treat
+            # the unmarked staging dir as garbage
+            before_commit(p.tmp_dir)
         _commit(p.tmp_dir, p.final_dir)
         if p.keep_last > 0:
             gc_checkpoints(p.root, keep_last=p.keep_last)
@@ -273,9 +298,11 @@ def _in_flight_dirs() -> set:
 # selection here; the NEXT selection on the same root releases the
 # previous one, so a long run retains at most one extra step dir.
 # SCOPE: process-local — it closes the in-process race (the async-save
-# commit GC and maybe_resume share this process). A SEPARATE process
-# reading the root (cli/serve.py watch=) still relies on the shared
-# retry/backoff policies; cross-process leases are future work.
+# commit GC and maybe_resume share this process). The CROSS-process half
+# is the RESUME_PIN lease (runtime/ckpt_paths.py): the relaunching
+# supervisor stamps the step dir the next child attempt will restore
+# from, and gc_checkpoints below holds a live (unexpired) pin out of the
+# retention prune set even though the pinning process is not this one.
 _RESUME_PROTECTED: Dict[str, str] = {}
 
 
@@ -308,7 +335,14 @@ def gc_checkpoints(path: str, *, keep_last: int = 0) -> List[str]:
         return []
     _recover_orphaned_old(path)
     busy = _in_flight_dirs()
-    protected = _RESUME_PROTECTED.get(os.path.abspath(path))
+    protected = {_RESUME_PROTECTED.get(os.path.abspath(path))}
+    # cross-process lease: a supervisor that just relaunched a child has
+    # pinned the step dir that child is about to restore from — this
+    # process's retention GC must not prune it mid-restore
+    pinned = read_resume_pin(path)
+    if pinned is not None:
+        protected.add(os.path.abspath(pinned))
+    protected.discard(None)
     removed: List[str] = []
     committed: List[tuple] = []
     for entry in sorted(os.listdir(path)):
@@ -333,9 +367,9 @@ def gc_checkpoints(path: str, *, keep_last: int = 0) -> List[str]:
     if keep_last > 0 and len(committed) > keep_last:
         committed.sort()
         for _, full in committed[:-keep_last]:
-            if protected and os.path.abspath(full) == protected:
-                # a live resume selected this step: hold it out of the
-                # prune set until the next selection releases it
+            if os.path.abspath(full) in protected:
+                # a live resume (in-process selection or cross-process
+                # RESUME_PIN) holds this step out of the prune set
                 _count("gc_protected")
                 continue
             shutil.rmtree(full, ignore_errors=True)
@@ -387,7 +421,82 @@ def read_checkpoint_meta(ckpt_dir: str) -> Dict[str, Any]:
 
     return retry_call(_read, attempts=_io_retries(), base=0.2, cap=5.0,
                       retryable=lambda e: isinstance(e, OSError),
-                      op="checkpoint.read_meta")
+                      op="checkpoint.read_meta",
+                      deadline_s=_io_deadline())
+
+
+def try_read_checkpoint_meta(
+        ckpt_dir: str) -> Tuple[Dict[str, Any], Optional[Exception]]:
+    """:func:`read_checkpoint_meta` that never raises: ``(meta, None)``
+    on success, ``({}, error)`` on a corrupt/truncated/unreadable
+    meta.json. Resume paths must degrade to the previous committed step
+    (or a fresh start) with a warning, not a traceback."""
+    try:
+        return read_checkpoint_meta(ckpt_dir), None
+    except Exception as e:  # noqa: BLE001 — defensive read by contract
+        return {}, e
+
+
+def committed_checkpoints(path: str) -> List[str]:
+    """Every committed step dir under ``path``, NEWEST first — the
+    candidate order for a resilient resume (try the newest, fall back
+    on corruption)."""
+    return [d for _, d in reversed(ckpt_paths.committed_steps(path))]
+
+
+def load_latest_resilient(
+    path: str,
+    params_target: Params,
+    opt_target: Any = None,
+    hpc=None,
+    *,
+    strict_plan: bool = False,
+    expected_world: Optional[int] = None,
+    log: Callable[[str], None] = lambda m: print(m, flush=True),
+) -> Optional[Tuple[Params, Any, int, str]]:
+    """Restore from the newest READABLE committed checkpoint under
+    ``path``: corruption (truncated/garbled meta.json, a missing payload
+    leaf, a stray COMMITTED marker over a torn payload) falls back to
+    the previous committed step with a warning
+    (``checkpoint/corrupt_fallback``), never a traceback.
+
+    Returns ``(params, opt_state, step, ckpt_dir)`` or None when no
+    committed checkpoint exists. Two error classes still PROPAGATE by
+    contract: :class:`WorldSizeMismatchError` (the elastic resume
+    trigger — a topology change is not corruption) and
+    :class:`PlanMismatchError` (a strict-plan operator error reproduces
+    on every candidate; silently "falling back" to an older step would
+    train the wrong plan). If candidates exist but every one is
+    unreadable, raises RuntimeError naming them — silently restarting a
+    long run from scratch is worse than a loud stop."""
+    candidates = committed_checkpoints(path)
+    if not candidates:
+        return None
+    last_err: Optional[Exception] = None
+    for ckdir in candidates:
+        try:
+            params, opt_state, step = load_checkpoint(
+                ckdir, params_target, opt_target, hpc=hpc,
+                strict_plan=strict_plan, expected_world=expected_world)
+        except (WorldSizeMismatchError, PlanMismatchError):
+            raise
+        except Exception as e:  # noqa: BLE001 — corruption class varies
+            # (json decode errors, orbax restore errors, missing files,
+            # OSErrors that exhausted the retry budget)
+            last_err = e
+            _count("corrupt_fallback")
+            log(f"warning: checkpoint {ckdir} is unreadable "
+                f"({type(e).__name__}: {e}); falling back to the "
+                "previous committed step")
+            continue
+        # success: shield the selection from retention pruning (same
+        # registration latest_checkpoint performs)
+        _RESUME_PROTECTED[os.path.abspath(path)] = os.path.abspath(ckdir)
+        return params, opt_state, step, ckdir
+    raise RuntimeError(
+        f"all {len(candidates)} committed checkpoint(s) under {path} are "
+        f"unreadable (last error: {type(last_err).__name__}: {last_err}); "
+        "refusing to silently restart from scratch")
 
 
 def load_checkpoint(
@@ -424,7 +533,7 @@ def load_checkpoint(
         stored = meta.get("hybrid_parallel_config")
         current = _plan_fingerprint(hpc)
         if stored != current:
-            raise ValueError(
+            raise PlanMismatchError(
                 f"checkpoint plan mismatch:\nstored  {stored}\n"
                 f"current {current}")
     ckptr = ocp.StandardCheckpointer()
@@ -434,7 +543,8 @@ def load_checkpoint(
             lambda: ckptr.restore(os.path.join(ckpt_dir, sub), target),
             attempts=_io_retries(), base=0.2, cap=5.0,
             retryable=lambda e: isinstance(e, OSError),
-            op="checkpoint.restore")
+            op="checkpoint.restore",
+            deadline_s=_io_deadline())
 
     params = _restore("params", params_target)
     opt_state = None
@@ -442,6 +552,317 @@ def load_checkpoint(
             os.path.join(ckpt_dir, "opt_state")):
         opt_state = _restore("opt_state", opt_target)
     return params, opt_state, meta["step"]
+
+
+# ---------------------------------------------------------------------------
+# Async snapshot checkpointing
+# ---------------------------------------------------------------------------
+
+
+def _gauge(name: str, value: float) -> None:
+    try:
+        from hetu_galvatron_tpu.observability.registry import get_registry
+
+        get_registry().gauge(f"checkpoint/{name}").set(float(value))
+    except Exception:  # noqa: BLE001 — observability is best-effort
+        pass
+
+
+@dataclass
+class _Snapshot:
+    """A donation-safe on-device copy of the model state, queued for the
+    background writer."""
+
+    step: int
+    params: Any
+    opt_state: Any
+    train_state: Optional[Dict[str, Any]] = None
+
+
+class AsyncCheckpointer:
+    """Split saves: on-step jitted device snapshot + background commit.
+
+    ``snapshot(step, params, opt_state)`` dispatches ONE jitted
+    copy-program over the state's device arrays (donation-safe: XLA's
+    data dependencies order the copies before the next step may reuse
+    donated buffers) and returns immediately — the measured dispatch
+    stall is the only step time a save costs, exported as the
+    ``checkpoint/snapshot_stall_ms`` gauge. A single daemon writer
+    thread host-gathers the copies (``jax.device_get`` blocks until the
+    device copies land) and writes/commits through
+    :func:`save_checkpoint`'s atomic COMMITTED-marker protocol.
+
+    Single-writer overlap rule: the queue holds at most ONE pending
+    snapshot — a new snapshot supersedes an unstarted write
+    (``checkpoint/snapshot_superseded``; the newer state strictly
+    dominates), but never interrupts a STARTED write (a half-written
+    staging dir would just be torn garbage for GC).
+
+    A hung write (exceeding ``save_timeout_s``) is declared by the
+    watchdog (``checkpoint/hung_saves``) and :meth:`drain` stops waiting
+    on it — the daemon thread cannot block process exit. Writer errors
+    are latched and re-raised at the next ``snapshot()``/``drain()``.
+
+    Single-controller only: the writer thread cannot participate in
+    multi-process save barriers (``CheckpointCadence`` falls back to the
+    orbax async path on pods, with a logged reason).
+    """
+
+    def __init__(self, root: str, *, hpc=None, keep_last: int = 0,
+                 save_timeout_s: float = 120.0,
+                 hooks: Optional[Dict[str, Callable[..., Any]]] = None,
+                 log: Callable[[str], None] = lambda m: print(m,
+                                                              flush=True)):
+        self.root = root
+        self.hpc = hpc
+        self.keep_last = keep_last
+        self.save_timeout_s = float(save_timeout_s)
+        self.hooks = dict(hooks or {})
+        self._log = log
+        self._cv = threading.Condition()
+        self._queue: Optional[_Snapshot] = None
+        self._inflight: Optional[_Snapshot] = None
+        self._started_at: Optional[float] = None
+        self._hung_step: Optional[int] = None
+        self._closed = False
+        self._thread: Optional[threading.Thread] = None
+        self._copy_fn = None
+        self.error: Optional[BaseException] = None
+        self.last_commit: Optional[Dict[str, Any]] = None
+
+    # -- on-step half -------------------------------------------------------
+
+    def _device_copy(self, tree):
+        import jax.numpy as jnp
+
+        leaves, treedef = jax.tree.flatten(tree)
+        idx = [i for i, x in enumerate(leaves) if isinstance(x, jax.Array)]
+        if idx:
+            if self._copy_fn is None:
+                self._copy_fn = jax.jit(
+                    lambda xs: tuple(jnp.copy(x) for x in xs))
+            copies = self._copy_fn(tuple(leaves[i] for i in idx))
+            for i, c in zip(idx, copies):
+                leaves[i] = c
+        return jax.tree.unflatten(treedef, leaves)
+
+    def snapshot(self, step: int, params: Params, opt_state: Any = None,
+                 *, train_state: Optional[Dict[str, Any]] = None) -> float:
+        """Queue a device snapshot of the state at ``step``; returns the
+        dispatch stall in ms (the step's entire save cost)."""
+        self.check_watchdog()
+        if self.error is not None:
+            err, self.error = self.error, None
+            raise err
+        t0 = time.perf_counter()
+        params_c, opt_c = self._device_copy((params, opt_state))
+        stall_ms = (time.perf_counter() - t0) * 1e3
+        _gauge("snapshot_stall_ms", stall_ms)
+        _count("snapshots")
+        snap = _Snapshot(step, params_c, opt_c, train_state)
+        with self._cv:
+            if self._queue is not None:
+                _count("snapshot_superseded")
+                self._log(
+                    f"checkpoint: snapshot at step {step} supersedes the "
+                    f"unstarted write at step {self._queue.step}")
+            self._queue = snap
+            self._cv.notify_all()
+        self._ensure_thread()
+        return stall_ms
+
+    # -- background half ----------------------------------------------------
+
+    def _ensure_thread(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._worker, daemon=True, name="ckpt-writer")
+            self._thread.start()
+
+    def _worker(self) -> None:
+        while True:
+            with self._cv:
+                while self._queue is None and not self._closed:
+                    self._cv.wait(timeout=0.2)
+                if self._queue is None:
+                    return  # closed and drained
+                snap, self._queue = self._queue, None
+                self._inflight = snap
+                self._started_at = time.monotonic()
+            try:
+                before_write = self.hooks.get("before_write")
+                if before_write is not None:
+                    before_write(snap.step)
+                # device_get blocks until the on-device copies land, then
+                # the write streams from host memory — the training loop
+                # is untouched either way
+                host_params, host_opt = jax.device_get(
+                    (snap.params, snap.opt_state))
+                save_checkpoint(
+                    self.root, snap.step, host_params, host_opt,
+                    hpc=self.hpc, async_save=False,
+                    train_state=snap.train_state,
+                    keep_last=self.keep_last, hooks=self.hooks)
+                self.last_commit = {"step": snap.step,
+                                    "t_wall": time.time()}
+                _count("async_committed")
+            except BaseException as e:  # noqa: BLE001 — latched for caller
+                self.error = e
+                _count("async_save_errors")
+                try:
+                    self._log("warning: async checkpoint write at step "
+                              f"{snap.step} failed: {e}")
+                except Exception:  # noqa: BLE001 — log must not kill worker
+                    pass
+            finally:
+                with self._cv:
+                    self._inflight = None
+                    self._started_at = None
+                    self._cv.notify_all()
+
+    # -- watchdog / drain ---------------------------------------------------
+
+    def check_watchdog(self) -> bool:
+        """True when the in-flight write has exceeded ``save_timeout_s``
+        (counted once per hung save as ``checkpoint/hung_saves``)."""
+        with self._cv:
+            started, inflight = self._started_at, self._inflight
+        if (started is None or inflight is None
+                or time.monotonic() - started <= self.save_timeout_s):
+            return False
+        if self._hung_step != inflight.step:
+            self._hung_step = inflight.step
+            _count("hung_saves")
+            self._log(f"warning: checkpoint write at step {inflight.step} "
+                      f"exceeded the {self.save_timeout_s:.1f}s watchdog "
+                      "deadline; it will not be waited on")
+        return True
+
+    def pending(self) -> bool:
+        with self._cv:
+            return self._queue is not None or self._inflight is not None
+
+    def drain(self, timeout_s: Optional[float] = None) -> bool:
+        """Block until queued + in-flight writes finish. Returns False
+        (after declaring the save hung) instead of blocking forever when
+        the writer exceeds the deadline; re-raises a latched writer
+        error once drained."""
+        if timeout_s is None:
+            timeout_s = self.save_timeout_s
+        deadline = time.monotonic() + timeout_s
+        with self._cv:
+            while self._queue is not None or self._inflight is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._cv.wait(timeout=min(remaining, 0.2))
+            drained = self._queue is None and self._inflight is None
+        if not drained:
+            self.check_watchdog()
+            if self._hung_step is None:
+                # not yet past the per-save watchdog, but the caller's
+                # drain budget is spent — same give-up contract
+                _count("hung_saves")
+                self._hung_step = (self._inflight.step
+                                   if self._inflight else -1)
+        if self.error is not None:
+            err, self.error = self.error, None
+            raise err
+        return drained
+
+    def close(self, timeout_s: Optional[float] = None) -> bool:
+        drained = self.drain(timeout_s)
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        if self._thread is not None and drained:
+            self._thread.join(timeout=5.0)
+        return drained
+
+
+class CheckpointCadence:
+    """One save policy for both cadences and both write modes.
+
+    ``due(it)`` is true when the step cadence (``ckpt.save_interval``)
+    OR the wall-clock cadence (``ckpt.interval_s``) has elapsed — the
+    time cadence bounds elastic RPO in seconds even when steps slow
+    down. ``save(step, ...)`` dispatches through the
+    :class:`AsyncCheckpointer` snapshot path when ``ckpt.snapshot_async``
+    is set (single-controller), else through the classic synchronous /
+    orbax-async :func:`save_checkpoint`. Goodput booking matches the
+    mode: async saves bill only the snapshot stall (+ the final drain)
+    to ``checkpoint_save``, moving write time out of
+    ``productive_step``."""
+
+    def __init__(self, ck, *, hpc=None, goodput=None,
+                 log: Callable[[str], None] = lambda m: print(m,
+                                                              flush=True),
+                 hooks: Optional[Dict[str, Callable[..., Any]]] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.ck = ck
+        self.hpc = hpc
+        self.goodput = goodput
+        self.hooks = dict(hooks or {})
+        self._log = log
+        self._clock = clock
+        self._last_save_t = clock()
+        self.async_ckptr: Optional[AsyncCheckpointer] = None
+        if ck.save and ck.snapshot_async:
+            if jax.process_count() > 1:
+                log("ckpt.snapshot_async: multi-process pod — the writer "
+                    "thread cannot join save barriers; falling back to "
+                    "the synchronous/orbax-async path")
+            else:
+                self.async_ckptr = AsyncCheckpointer(
+                    ck.save, hpc=hpc, keep_last=ck.keep_last,
+                    save_timeout_s=ck.save_timeout_s, hooks=self.hooks,
+                    log=log)
+
+    def due(self, it: int) -> bool:
+        ck = self.ck
+        if not ck.save:
+            return False
+        if ck.save_interval and (it + 1) % ck.save_interval == 0:
+            return True
+        if ck.interval_s and \
+                self._clock() - self._last_save_t >= ck.interval_s:
+            return True
+        return False
+
+    def save(self, step: int, params: Params, opt_state: Any = None,
+             *, train_state: Optional[Dict[str, Any]] = None) -> None:
+        self._last_save_t = self._clock()
+        if self.async_ckptr is not None:
+            stall_ms = self.async_ckptr.snapshot(
+                step, params, opt_state, train_state=train_state)
+            if self.goodput is not None:
+                # only the dispatch stall steals step time; the write
+                # overlaps training and its drain bills at exit
+                self.goodput.add("checkpoint_save", stall_ms / 1e3)
+            return
+
+        def _save():
+            save_checkpoint(self.ck.save, step, params, opt_state,
+                            hpc=self.hpc, async_save=self.ck.async_save,
+                            train_state=train_state,
+                            keep_last=self.ck.keep_last, hooks=self.hooks)
+
+        if self.goodput is not None:
+            with self.goodput.measure("checkpoint_save"):
+                _save()
+        else:
+            _save()
+
+    def drain(self) -> None:
+        """Exit/preempt barrier: nothing in-flight may outlive (or race)
+        what follows — a synchronous exit save, or process exit. A hung
+        async write is abandoned after its watchdog deadline rather than
+        blocking shutdown."""
+        if self.async_ckptr is not None:
+            if not self.async_ckptr.drain():
+                self._log("warning: abandoning a hung checkpoint write "
+                          "at exit (see checkpoint/hung_saves)")
+        wait_for_checkpoints()
 
 
 # ---------------------------------------------------------------------------

@@ -247,6 +247,7 @@ def train_loop(
     telemetry: Optional[Any] = None,
     preemption: Optional[Any] = None,
     goodput: Optional[Any] = None,
+    checkpoint: Optional[Any] = None,
 ) -> Tuple[Any, Any, list]:
     """Host-side iteration driver (reference train_dist.py:49-73): fetch
     batch, run jitted step, invoke profiler/logging hooks. Returns final
@@ -268,7 +269,13 @@ def train_loop(
     ``observability.goodput.GoodputTracker``: each iteration's host wall
     is booked as ``productive_step`` (the first iteration as
     ``recompile`` — it pays the jit), so even this minimal loop feeds
-    the goodput partition; flushing/persistence stay the caller's job."""
+    the goodput partition; flushing/persistence stay the caller's job.
+    ``checkpoint`` is an optional
+    ``runtime.checkpoint.CheckpointCadence``: when its cadence (step
+    interval or wall interval) is due, the post-update state is saved
+    through it (async snapshot or sync write per its config) and any
+    in-flight write is drained when the loop exits — even on error, so
+    a crashing attempt never leaks a background writer."""
     from hetu_galvatron_tpu.models.modules import compute_dtype_of
     from hetu_galvatron_tpu.observability.tracing import span
 
@@ -327,11 +334,22 @@ def train_loop(
             if goodput is not None:
                 goodput.add("recompile" if it == 0 else "productive_step",
                             time.perf_counter() - it_t0)
+            if checkpoint is not None and checkpoint.due(it):
+                # after the goodput booking: the cadence books its own
+                # wall (snapshot stall or full sync write) to
+                # checkpoint_save, not to this step's productive time
+                checkpoint.save(it + 1, params, opt_state)
             if preemption is not None and preemption.requested():
                 # step boundary: the update above is complete and safe to
                 # checkpoint; never abandon a step mid-flight
                 break
     finally:
+        if checkpoint is not None:
+            try:
+                checkpoint.drain()
+            except Exception as e:  # noqa: BLE001 — never mask loop error
+                print(f"warning: checkpoint drain at loop exit failed "
+                      f"({type(e).__name__}: {e})", flush=True)
         # a loop-owned telemetry is closed here; a caller-supplied one is
         # only final-flushed (the caller may reuse it across loops and
         # closes it when done — close() re-arms on the next __call__)

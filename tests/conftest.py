@@ -157,6 +157,18 @@ _SLOW_TESTS = {
     "test_reshard_exact_across_engines",
     "test_weight_swap_load_drill",
     "test_swap_invalidates_prefix_cache",
+    # chaos matrix: each case spawns real supervised train_dist children
+    # through cli/supervise.py and compares bit-exact resumed
+    # trajectories against a shared baseline run. Fast tier keeps the
+    # in-process crash smoke (test_chaos_crash_smoke_resumes_bit_exact)
+    # and the synthetic-children harness smoke.
+    "test_chaos_matrix_crash",
+    "test_chaos_matrix_preempt",
+    "test_chaos_matrix_kill_mid_save",
+    "test_chaos_matrix_corrupt_meta",
+    "test_chaos_matrix_transient_io",
+    "test_chaos_matrix_hung_save",
+    "test_chaos_matrix_budget",
 }
 
 

@@ -1,0 +1,341 @@
+"""Async snapshot checkpointing (runtime/checkpoint.AsyncCheckpointer +
+CheckpointCadence): snapshot/commit roundtrip, the single-writer
+supersede rule, the hung-save watchdog, wall-clock cadence, latched
+writer errors, and corrupted-checkpoint resume via
+load_latest_resilient (truncated meta, missing payload leaf, stray
+COMMITTED marker — fall back with a warning, never traceback)."""
+
+import json
+import os
+import shutil
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from hetu_galvatron_tpu.runtime.checkpoint import (
+    AsyncCheckpointer,
+    CheckpointCadence,
+    latest_checkpoint,
+    load_checkpoint,
+    load_latest_resilient,
+    save_checkpoint,
+    try_read_checkpoint_meta,
+)
+
+pytestmark = pytest.mark.robustness
+
+
+def _tree(scale=1.0):
+    return {"w": jnp.arange(12, dtype=jnp.float32).reshape(3, 4) * scale,
+            "b": jnp.ones((4,), dtype=jnp.float32) * scale}
+
+
+def _target():
+    return {"w": jnp.zeros((3, 4), dtype=jnp.float32),
+            "b": jnp.zeros((4,), dtype=jnp.float32)}
+
+
+def _assert_tree_equal(a, b):
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+# -- AsyncCheckpointer -------------------------------------------------------
+
+
+def test_snapshot_drain_commit_roundtrip(tmp_path):
+    ac = AsyncCheckpointer(str(tmp_path), log=lambda m: None)
+    stall_ms = ac.snapshot(3, _tree(), _tree(2.0),
+                           train_state={"consumed_samples": 24})
+    assert stall_ms >= 0.0
+    assert ac.drain()
+    assert ac.last_commit["step"] == 3
+    d = latest_checkpoint(str(tmp_path))
+    assert d.endswith("step_3")
+    p, o, step = load_checkpoint(d, _target(), _target())
+    assert step == 3
+    _assert_tree_equal(p, _tree())
+    _assert_tree_equal(o, _tree(2.0))
+    meta, err = try_read_checkpoint_meta(d)
+    assert err is None
+    assert meta["train_state"]["consumed_samples"] == 24
+    assert not [n for n in os.listdir(tmp_path) if n.endswith(".tmp")]
+    ac.close()
+
+
+def test_snapshot_isolates_from_later_mutation(tmp_path):
+    """The on-step device copy is the donation shield: mutating (or
+    donating) the live buffers after snapshot() must not change what
+    the background writer commits."""
+    ac = AsyncCheckpointer(str(tmp_path), log=lambda m: None)
+    live = _tree()
+    ac.snapshot(1, live)
+    # simulate the next step reusing the buffers
+    live["w"] = live["w"] * 100.0
+    live["b"] = live["b"] * 100.0
+    assert ac.drain()
+    p, _, _ = load_checkpoint(latest_checkpoint(str(tmp_path)), _target())
+    _assert_tree_equal(p, _tree())
+    ac.close()
+
+
+def test_new_snapshot_supersedes_unstarted_write(tmp_path):
+    """Queue depth is ONE: while a write is in flight, the newest
+    queued snapshot wins and the middle one is never written — but the
+    STARTED write always completes."""
+    gate = threading.Event()
+    logs = []
+    ac = AsyncCheckpointer(str(tmp_path), log=logs.append,
+                           hooks={"before_write": lambda step: gate.wait(30)})
+    ac.snapshot(1, _tree())  # picked up by the worker, blocks on gate
+    deadline = time.monotonic() + 10
+    while ac._inflight is None:  # wait for the worker to take it
+        assert time.monotonic() < deadline
+        time.sleep(0.01)
+    ac.snapshot(2, _tree(2.0))  # queued
+    ac.snapshot(3, _tree(3.0))  # supersedes step 2
+    gate.set()
+    assert ac.drain(timeout_s=30)
+    steps = sorted(n for n in os.listdir(tmp_path) if n.startswith("step_"))
+    assert steps == ["step_1", "step_3"]  # step 2 never hit the disk
+    assert any("supersedes" in m for m in logs)
+    ac.close()
+
+
+def test_hung_save_watchdog_and_drain_give_up(tmp_path):
+    """A wedged write must not block shutdown: drain() returns False
+    after the watchdog deadline instead of hanging, and the hang is
+    logged once."""
+    gate = threading.Event()
+    logs = []
+    ac = AsyncCheckpointer(str(tmp_path), save_timeout_s=0.2,
+                           log=logs.append,
+                           hooks={"before_write": lambda step: gate.wait(60)})
+    ac.snapshot(5, _tree())
+    t0 = time.monotonic()
+    assert ac.drain(timeout_s=0.5) is False
+    assert time.monotonic() - t0 < 10.0  # gave up, did not wait the 60s
+    time.sleep(0.25)  # age the in-flight write past save_timeout_s
+    assert ac.check_watchdog() is True
+    assert any("watchdog" in m for m in logs)
+    gate.set()  # unwedge so the daemon thread exits cleanly
+    ac.drain(timeout_s=30)
+
+
+def test_writer_error_latches_and_reraises(tmp_path):
+    def boom(step):
+        raise OSError("disk on fire")
+
+    ac = AsyncCheckpointer(str(tmp_path), log=lambda m: None,
+                           hooks={"before_write": boom})
+    ac.snapshot(1, _tree())
+    with pytest.raises(OSError, match="disk on fire"):
+        ac.drain(timeout_s=30)
+    # the error is consumed: the next save works
+    ac.hooks.pop("before_write")
+    ac.snapshot(2, _tree(2.0))
+    assert ac.drain(timeout_s=30)
+    assert latest_checkpoint(str(tmp_path)).endswith("step_2")
+    ac.close()
+
+
+# -- CheckpointCadence -------------------------------------------------------
+
+
+class _Ck:
+    """A CheckpointArgs stand-in with just the cadence fields."""
+
+    def __init__(self, **kw):
+        self.save = kw.get("save")
+        self.load = None
+        self.save_interval = kw.get("save_interval", 0)
+        self.interval_s = kw.get("interval_s", 0.0)
+        self.snapshot_async = kw.get("snapshot_async", False)
+        self.save_timeout_s = kw.get("save_timeout_s", 120.0)
+        self.async_save = False
+        self.keep_last = kw.get("keep_last", 0)
+
+
+def test_cadence_step_interval():
+    ck = _Ck(save="/nope", save_interval=3)
+    cad = CheckpointCadence(ck, log=lambda m: None)
+    assert [cad.due(it) for it in range(6)] == [
+        False, False, True, False, False, True]
+
+
+def test_cadence_wall_clock_bounds_rpo():
+    """``ckpt.interval_s`` fires on elapsed wall-clock even when no step
+    cadence is configured — the elastic RPO bound when steps slow down."""
+    now = [100.0]
+    ck = _Ck(save="/nope", interval_s=30.0)
+    cad = CheckpointCadence(ck, log=lambda m: None, clock=lambda: now[0])
+    assert not cad.due(0)
+    now[0] += 29.0
+    assert not cad.due(1)
+    now[0] += 2.0
+    assert cad.due(2)
+
+
+def test_cadence_save_resets_time_base(tmp_path):
+    now = [0.0]
+    ck = _Ck(save=str(tmp_path), interval_s=10.0)
+    cad = CheckpointCadence(ck, log=lambda m: None, clock=lambda: now[0])
+    now[0] = 11.0
+    assert cad.due(0)
+    cad.save(1, _tree())
+    assert not cad.due(1)  # the save re-based the clock
+    now[0] = 22.0
+    assert cad.due(2)
+
+
+def test_cadence_no_save_dir_never_due():
+    cad = CheckpointCadence(_Ck(save=None, save_interval=1),
+                            log=lambda m: None)
+    assert not cad.due(0)
+
+
+def test_cadence_async_books_only_stall(tmp_path):
+    """Goodput sees the dispatch stall, not the write: the wall-clock of
+    the booked 'checkpoint_save' interval must be far below the actual
+    write time (which overlaps training)."""
+
+    class Goodput:
+        def __init__(self):
+            self.booked = []
+
+        def add(self, name, seconds):
+            self.booked.append((name, seconds))
+
+    gp = Goodput()
+    ck = _Ck(save=str(tmp_path), save_interval=1, snapshot_async=True)
+    cad = CheckpointCadence(ck, goodput=gp, log=lambda m: None)
+    assert cad.async_ckptr is not None
+    cad.save(1, _tree())
+    cad.drain()
+    assert [n for n, _ in gp.booked] == ["checkpoint_save"]
+    assert gp.booked[0][1] < 5.0  # the stall, not a blocking write
+    assert latest_checkpoint(str(tmp_path)).endswith("step_1")
+
+
+# -- resilient resume --------------------------------------------------------
+
+
+def _two_commits(root):
+    save_checkpoint(root, 1, _tree(), _tree(2.0))
+    save_checkpoint(root, 2, _tree(10.0), _tree(20.0))
+
+
+def test_resilient_falls_back_on_truncated_meta(tmp_path):
+    root = str(tmp_path)
+    _two_commits(root)
+    meta = os.path.join(root, "step_2", "meta.json")
+    txt = open(meta).read()
+    with open(meta, "w") as f:
+        f.write(txt[: len(txt) // 2])  # torn write
+    logs = []
+    got = load_latest_resilient(root, _target(), _target(),
+                                log=logs.append)
+    assert got is not None
+    p, o, step, ckdir = got
+    assert step == 1 and ckdir.endswith("step_1")
+    _assert_tree_equal(p, _tree())
+    assert any("falling back" in m for m in logs)
+
+
+def test_resilient_falls_back_on_garbled_meta(tmp_path):
+    root = str(tmp_path)
+    _two_commits(root)
+    with open(os.path.join(root, "step_2", "meta.json"), "w") as f:
+        f.write("{this is not json")
+    got = load_latest_resilient(root, _target(), _target(),
+                                log=lambda m: None)
+    assert got is not None and got[2] == 1
+
+
+def test_resilient_falls_back_on_missing_payload_leaf(tmp_path):
+    root = str(tmp_path)
+    _two_commits(root)
+    shutil.rmtree(os.path.join(root, "step_2", "params"))
+    logs = []
+    got = load_latest_resilient(root, _target(), _target(),
+                                log=logs.append)
+    assert got is not None and got[2] == 1
+    assert any("falling back" in m for m in logs)
+
+
+def test_resilient_skips_stray_committed_marker(tmp_path):
+    """A COMMITTED marker over a torn payload (a crash between marker
+    fsync and payload rename cannot produce this, but operators can) is
+    corruption, not a candidate."""
+    root = str(tmp_path)
+    _two_commits(root)
+    stray = os.path.join(root, "step_9")
+    os.makedirs(stray)
+    with open(os.path.join(stray, "COMMITTED"), "w") as f:
+        f.write("committed\n")
+    got = load_latest_resilient(root, _target(), _target(),
+                                log=lambda m: None)
+    assert got is not None and got[2] == 2  # newest REAL commit
+
+
+def test_resilient_none_when_no_commits(tmp_path):
+    assert load_latest_resilient(str(tmp_path), _target()) is None
+
+
+def test_resilient_raises_when_all_unreadable(tmp_path):
+    """Every candidate corrupt -> a loud RuntimeError naming the count,
+    never a silent fresh start."""
+    root = str(tmp_path)
+    _two_commits(root)
+    for s in (1, 2):
+        with open(os.path.join(root, f"step_{s}", "meta.json"), "w") as f:
+            f.write("{nope")
+        shutil.rmtree(os.path.join(root, f"step_{s}", "params"))
+    with pytest.raises(RuntimeError, match="2 committed checkpoint"):
+        load_latest_resilient(root, _target(), log=lambda m: None)
+
+
+def test_try_read_meta_never_raises(tmp_path):
+    d = str(tmp_path / "step_1")
+    os.makedirs(d)
+    with open(os.path.join(d, "meta.json"), "w") as f:
+        f.write("{torn")
+    meta, err = try_read_checkpoint_meta(d)
+    assert meta == {} and err is not None
+    # an ABSENT meta.json is not corruption: {} with no error by contract
+    meta, err = try_read_checkpoint_meta(str(tmp_path / "absent"))
+    assert meta == {} and err is None
+    with open(os.path.join(d, "meta.json"), "w") as f:
+        json.dump({"step": 1}, f)
+    meta, err = try_read_checkpoint_meta(d)
+    assert err is None and meta["step"] == 1
+
+
+def test_checkpoint_reads_absorb_transient_io_faults(tmp_path):
+    """The retry seam under checkpoint I/O: a fault injector failing the
+    first two read attempts is absorbed by backoff — the read still
+    succeeds and resume never sees the flake."""
+    from hetu_galvatron_tpu.utils.retrying import set_fault_injector
+
+    root = str(tmp_path)
+    save_checkpoint(root, 1, _tree())
+    budget = [2]
+
+    def inject(op):
+        if "checkpoint" in op and budget[0] > 0:
+            budget[0] -= 1
+            return OSError("chaos: injected transient I/O error")
+        return None
+
+    prev = set_fault_injector(inject)
+    try:
+        got = load_latest_resilient(root, _target(), log=lambda m: None)
+    finally:
+        set_fault_injector(prev)
+    assert got is not None and got[2] == 1
+    assert budget[0] == 0  # the injector actually fired

@@ -1,0 +1,304 @@
+"""Chaos fault-injection harness (runtime/chaos.py + tools/chaos_drill.py):
+plan parsing, cross-process one-shot markers, the retry-seam injector,
+a fast in-process crash-resume smoke (tier-1), the drill harness
+self-check, and the full cross-process chaos matrix (slow tier — each
+case drives real ``cli/supervise.py`` children and asserts bit-exact
+resumed trajectories)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from hetu_galvatron_tpu.core.args_schema import ChaosArgs
+from hetu_galvatron_tpu.runtime.chaos import (
+    ChaosCrash,
+    ChaosMonkey,
+    make_chaos,
+    parse_plan,
+)
+
+pytestmark = [pytest.mark.robustness, pytest.mark.chaos]
+
+
+@pytest.fixture(autouse=True)
+def _pristine_package_logger():
+    """The package logger's StreamHandler is created lazily by the first
+    initialize() call and binds THAT moment's sys.stderr. The launcher
+    tests create it under their own capsys stream and assert on [INFO]
+    lines; the in-process smoke here runs without a capture fixture, so
+    a handler it creates would pin the fd-capture tmpfile and blind
+    every later capsys assertion. Restore the pre-test handler set."""
+    import logging
+
+    lg = logging.getLogger("hetu_galvatron_tpu")
+    handlers, level, propagate = list(lg.handlers), lg.level, lg.propagate
+    yield
+    lg.handlers[:] = handlers
+    lg.setLevel(level)
+    lg.propagate = propagate
+
+ZOO = os.path.join(os.path.dirname(__file__), "..", "..",
+                   "hetu_galvatron_tpu", "models", "configs")
+
+TINY = [
+    "model.hidden_size=32", "model.num_hidden_layers=2",
+    "model.num_attention_heads=2", "model.vocab_size=64",
+    "model.seq_length=8", "model.max_position_embeddings=16",
+    "model.make_vocab_size_divisible_by=1",
+    "train.train_iters=6", "parallel.mixed_precision=fp32",
+    "parallel.global_train_batch_size=8",
+]
+
+
+# -- plan parsing ------------------------------------------------------------
+
+
+def test_parse_plan_string():
+    faults = parse_plan(ChaosArgs(enable=True,
+                                  plan="corrupt_meta@4, crash@5, io_error"))
+    assert [(f.kind, f.at_iter) for f in faults] == [
+        ("corrupt_meta", 4), ("crash", 5), ("io_error", -1)]
+    assert [f.index for f in faults] == [0, 1, 2]
+
+
+def test_parse_plan_single_kind_fallback():
+    faults = parse_plan(ChaosArgs(enable=True, kind="sigterm", at_iter=3))
+    assert [(f.kind, f.at_iter) for f in faults] == [("sigterm", 3)]
+    assert parse_plan(ChaosArgs(enable=True)) == []  # kind="none"
+
+
+def test_parse_plan_rejects_unknown_kind():
+    with pytest.raises(ValueError, match="unknown kind"):
+        parse_plan(ChaosArgs(enable=True, plan="crash@2,meltdown@3"))
+
+
+def test_parse_plan_plumbs_io_knobs():
+    (f,) = parse_plan(ChaosArgs(enable=True, kind="io_error",
+                                io_error_count=5, io_error_op="dataset",
+                                hang_s=9.0))
+    assert f.count == 5 and f.op == "dataset" and f.hang_s == 9.0
+
+
+def test_make_chaos_gating():
+    class A:
+        chaos = ChaosArgs()
+
+        class ckpt:
+            save = None
+
+    assert make_chaos(A()) is None  # not enabled
+
+    class B(A):
+        chaos = ChaosArgs(enable=True)  # enabled but an empty plan
+
+    assert make_chaos(B()) is None
+
+
+# -- one-shot markers --------------------------------------------------------
+
+
+def test_marker_one_shot_across_instances(tmp_path):
+    """The fired marker is persisted BEFORE the fault fires, so a
+    relaunched attempt (a fresh ChaosMonkey over the same state_dir)
+    does not re-die at the same step."""
+    cfg = ChaosArgs(enable=True, kind="crash", at_iter=2)
+    m1 = ChaosMonkey(cfg, state_dir=str(tmp_path), log=lambda m: None)
+    m1.on_step(0)
+    m1.on_step(1)
+    with pytest.raises(ChaosCrash):
+        m1.on_step(2)
+    assert os.path.exists(tmp_path / "CHAOS_FIRED_0_crash")
+    # the "relaunched" attempt
+    m2 = ChaosMonkey(cfg, state_dir=str(tmp_path), log=lambda m: None)
+    assert m2.pending() == []
+    for it in range(6):
+        m2.on_step(it)  # never raises
+
+
+def test_unfired_faults_rearm_on_relaunch(tmp_path):
+    """A multi-fault plan unfolds across attempts: only the FIRED entry
+    is consumed by the relaunch."""
+    cfg = ChaosArgs(enable=True, plan="crash@1,crash@4")
+    m1 = ChaosMonkey(cfg, state_dir=str(tmp_path), log=lambda m: None)
+    with pytest.raises(ChaosCrash):
+        m1.on_step(1)
+    m2 = ChaosMonkey(cfg, state_dir=str(tmp_path), log=lambda m: None)
+    assert m2.pending() == ["crash"]
+    m2.on_step(3)
+    with pytest.raises(ChaosCrash):
+        m2.on_step(4)
+
+
+def test_corrupt_meta_waits_for_a_commit(tmp_path):
+    """corrupt_meta stays ARMED until a committed checkpoint exists —
+    firing into an empty save dir would test nothing."""
+    from hetu_galvatron_tpu.runtime import ckpt_paths
+
+    cfg = ChaosArgs(enable=True, kind="corrupt_meta", at_iter=1)
+    m = ChaosMonkey(cfg, state_dir=str(tmp_path), log=lambda m: None)
+    m.on_step(1)
+    m.on_step(2)
+    assert m.pending() == ["corrupt_meta"]  # nothing to corrupt yet
+    d = tmp_path / "step_3"
+    os.makedirs(d)
+    ckpt_paths.atomic_write_json(str(d / "meta.json"), {"step": 3})
+    with open(d / ckpt_paths.COMMIT_MARKER, "w") as f:
+        f.write("ok")
+    m.on_step(3)
+    assert m.pending() == []
+    with open(d / "meta.json") as f:
+        assert f.read().startswith("{this is not json")
+
+
+# -- the retry seam ----------------------------------------------------------
+
+
+def test_io_faults_inject_through_retry_call(tmp_path):
+    from hetu_galvatron_tpu.utils.retrying import retry_call
+
+    cfg = ChaosArgs(enable=True, kind="io_error", io_error_count=2,
+                    io_error_op="checkpoint")
+    m = ChaosMonkey(cfg, state_dir=str(tmp_path), log=lambda m: None)
+    m.install()
+    calls = []
+    try:
+        out = retry_call(lambda: calls.append(1) or "ok", attempts=4,
+                         op="checkpoint.read_meta", sleep=lambda s: None)
+        assert out == "ok"
+        assert len(calls) == 1  # two attempts eaten by injection
+        # non-matching ops pass through untouched
+        assert retry_call(lambda: "ok", attempts=1, op="dataset.fetch",
+                          sleep=lambda s: None) == "ok"
+    finally:
+        m.uninstall()
+    assert m.pending() == []  # exhausted count == fired
+    # uninstalled: no injection remains
+    assert retry_call(lambda: "ok", attempts=1, op="checkpoint.read_meta",
+                      sleep=lambda s: None) == "ok"
+
+
+def test_io_fault_gated_by_at_iter(tmp_path):
+    cfg = ChaosArgs(enable=True, plan="io_error@3")
+    m = ChaosMonkey(cfg, state_dir=str(tmp_path), log=lambda m: None)
+    m.on_step(1)
+    assert m._io_fault("checkpoint.restore") is None  # not yet armed
+    m.on_step(3)
+    assert isinstance(m._io_fault("checkpoint.restore"), OSError)
+
+
+def test_hung_save_hook_gated_by_step(tmp_path):
+    """The before_commit hook's step gate: a save of an EARLIER step than
+    at_iter must not trip the hang."""
+    import time
+
+    cfg = ChaosArgs(enable=True, kind="hung_save", at_iter=4)
+    cfg.hang_s = 0.2
+    m = ChaosMonkey(cfg, state_dir=str(tmp_path), log=lambda m: None)
+    hook = m.save_hooks()["before_commit"]
+    t0 = time.monotonic()
+    hook(str(tmp_path / "step_2.tmp"))
+    assert time.monotonic() - t0 < 0.15  # below at_iter: no stall
+    assert m.pending() == ["hung_save"]
+    hook(str(tmp_path / "step_4.tmp"))
+    assert time.monotonic() - t0 >= 0.2
+    assert m.pending() == []
+
+
+# -- in-process crash smoke (tier-1) -----------------------------------------
+
+
+@pytest.mark.distributed
+def test_chaos_crash_smoke_resumes_bit_exact(tmp_path):
+    """The fast chaos leg: a ChaosCrash at step 3 through the REAL
+    training loop + in-process restart supervisor; the stitched loss
+    trajectory must equal the uninterrupted baseline bit for bit."""
+    from hetu_galvatron_tpu.core.arguments import args_from_cli
+    from hetu_galvatron_tpu.cli.train_dist import train
+    from hetu_galvatron_tpu.runtime.supervisor import run_with_restarts
+
+    def _args(extra):
+        return args_from_cli(
+            [os.path.join(ZOO, "gpt2-small.yaml")] + TINY + extra,
+            mode="train_dist")
+
+    baseline = train(_args([]))["losses"]
+    assert len(baseline) == 6
+
+    args = _args([f"ckpt.save={tmp_path}", "ckpt.save_interval=2",
+                  "chaos.enable=true", "chaos.plan=crash@3"])
+    outs = []
+
+    def attempt():
+        if args.ckpt.save and not args.ckpt.load:
+            args.ckpt.load = args.ckpt.save
+        # ChaosCrash propagates: raised exceptions ARE the in-process
+        # supervisor's crash-restart path (returned codes are contracts)
+        out = train(args)
+        outs.append(out)
+        return out.get("exit_code") or 0
+
+    rc = run_with_restarts(attempt, max_restarts=3, base_delay=0.0,
+                           sleep=lambda s: None, log=lambda m: None)
+    assert rc == 0
+    assert len(outs) == 1  # attempt 1 crashed before returning
+    assert os.path.exists(tmp_path / "CHAOS_FIRED_0_crash")
+    # attempt 2 resumed from step_2 (the commit at iter 1) and replayed
+    # steps 2..5: its trajectory must be the baseline tail exactly
+    np.testing.assert_array_equal(np.asarray(outs[0]["losses"]),
+                                  np.asarray(baseline[2:]))
+
+
+def test_chaos_drill_harness_smoke(tmp_path):
+    """tools/chaos_drill.py --smoke: the supervisor/exit-code/receipt/pin
+    machinery with synthetic children (no jax) — also run by
+    ``__graft_entry__.dryrun_multichip``."""
+    from tools.chaos_drill import smoke
+
+    smoke(str(tmp_path))
+
+
+# -- the full matrix (slow tier: real supervised train_dist children) --------
+
+
+@pytest.fixture(scope="session")
+def chaos_baseline(tmp_path_factory):
+    from tools.chaos_drill import run_baseline
+
+    return run_baseline(str(tmp_path_factory.mktemp("chaos_matrix")))
+
+
+def _matrix_case(name, tmp_path_factory, baseline):
+    from tools.chaos_drill import run_case
+
+    msg = run_case(name, str(tmp_path_factory.mktemp(f"chaos_{name}")),
+                   baseline=baseline)
+    assert name.split("_")[0] in msg
+
+
+def test_chaos_matrix_crash(tmp_path_factory, chaos_baseline):
+    _matrix_case("crash", tmp_path_factory, chaos_baseline)
+
+
+def test_chaos_matrix_preempt(tmp_path_factory, chaos_baseline):
+    _matrix_case("preempt", tmp_path_factory, chaos_baseline)
+
+
+def test_chaos_matrix_kill_mid_save(tmp_path_factory, chaos_baseline):
+    _matrix_case("kill_mid_save", tmp_path_factory, chaos_baseline)
+
+
+def test_chaos_matrix_corrupt_meta(tmp_path_factory, chaos_baseline):
+    _matrix_case("corrupt_meta", tmp_path_factory, chaos_baseline)
+
+
+def test_chaos_matrix_transient_io(tmp_path_factory, chaos_baseline):
+    _matrix_case("transient_io", tmp_path_factory, chaos_baseline)
+
+
+def test_chaos_matrix_hung_save(tmp_path_factory, chaos_baseline):
+    _matrix_case("hung_save", tmp_path_factory, chaos_baseline)
+
+
+def test_chaos_matrix_budget(tmp_path_factory, chaos_baseline):
+    _matrix_case("budget", tmp_path_factory, chaos_baseline)

@@ -1,0 +1,375 @@
+"""Cross-process supervisor (runtime/supervisor.ProcessSupervisor +
+cli/supervise.py): exit-code contract against real child PROCESSES
+(cheap ``python -c`` children — the full train_dist drills live in
+test_chaos.py's slow tier), atomic state persistence, commit receipts
+resetting the restart budget, the RESUME_PIN lease lifecycle, signal
+forwarding with grace escalation, and the /healthz liveness payload."""
+
+import json
+import os
+import signal
+import sys
+import urllib.request
+
+import pytest
+
+from hetu_galvatron_tpu.runtime import ckpt_paths
+from hetu_galvatron_tpu.runtime.supervisor import (
+    ProcessSupervisor,
+    SupervisorState,
+)
+
+pytestmark = pytest.mark.robustness
+
+
+def _await_file(path, timeout_s=20.0):
+    import time
+
+    deadline = time.monotonic() + timeout_s
+    while not os.path.exists(path):
+        if time.monotonic() > deadline:
+            raise TimeoutError(f"child never signalled ready at {path}")
+        time.sleep(0.01)
+
+
+def _sup(argv_fn, **kw):
+    kw.setdefault("base_delay", 0.0)
+    kw.setdefault("max_delay", 0.0)
+    kw.setdefault("poll_interval", 0.02)
+    kw.setdefault("sleep", lambda s: None)
+    kw.setdefault("log", lambda m: None)
+    return ProcessSupervisor(argv_fn, **kw)
+
+
+def _exit_child(code):
+    return lambda st: [sys.executable, "-c", f"import sys; sys.exit({code})"]
+
+
+def _commit(root, step, world=1):
+    d = os.path.join(root, f"step_{step}")
+    os.makedirs(d, exist_ok=True)
+    ckpt_paths.atomic_write_json(
+        os.path.join(d, "meta.json"),
+        {"iteration": step, "hybrid_parallel_config": {"world_size": world}})
+    with open(os.path.join(d, ckpt_paths.COMMIT_MARKER), "w") as f:
+        f.write("ok")
+    return d
+
+
+# -- exit-code contract ------------------------------------------------------
+
+
+def test_clean_exit_stops(tmp_path):
+    sup = _sup(_exit_child(0), state_file=str(tmp_path / "s.json"))
+    assert sup.run() == 0
+    assert sup.state.attempt == 1
+
+
+def test_code_17_terminal_no_restart(tmp_path):
+    sup = _sup(_exit_child(17), state_file=str(tmp_path / "s.json"))
+    assert sup.run() == 17
+    assert sup.state.attempt == 1  # never relaunched
+
+
+def test_sigint_130_terminal(tmp_path):
+    sup = _sup(_exit_child(130), state_file=str(tmp_path / "s.json"))
+    assert sup.run() == 130
+    assert sup.state.attempt == 1
+
+
+def test_usage_error_terminal(tmp_path):
+    """Positive codes outside the contract (argparse's 2) are a
+    misconfiguration: restarting only burns the budget."""
+    sup = _sup(_exit_child(2), state_file=str(tmp_path / "s.json"))
+    assert sup.run() == 2
+    assert sup.state.attempt == 1
+
+
+def test_restartable_codes_relaunch_until_budget(tmp_path):
+    sup = _sup(_exit_child(18), state_file=str(tmp_path / "s.json"),
+               max_restarts=2)
+    assert sup.run() == 18  # budget spent, code surfaced
+    assert sup.state.attempt == 3
+
+
+def test_crash_code_1_restarts_when_enabled(tmp_path):
+    sup = _sup(_exit_child(1), state_file=str(tmp_path / "s.json"),
+               max_restarts=1)
+    assert sup.run() == 1
+    assert sup.state.attempt == 2
+
+
+def test_crash_terminal_when_restart_on_error_off(tmp_path):
+    sup = _sup(_exit_child(1), state_file=str(tmp_path / "s.json"),
+               restart_on_error=False)
+    assert sup.run() == 1
+    assert sup.state.attempt == 1
+
+
+def test_signal_death_surfaces_128_plus_signum(tmp_path):
+    kill = lambda st: [sys.executable, "-c",
+                       "import os, signal; os.kill(os.getpid(), 9)"]
+    sup = _sup(kill, state_file=str(tmp_path / "s.json"), max_restarts=1)
+    assert sup.run() == 137  # shell convention for SIGKILL
+    assert sup.state.attempt == 2  # a signal death IS restartable
+
+
+# -- progress receipts -------------------------------------------------------
+
+
+def test_commit_receipt_resets_restart_budget(tmp_path):
+    """A child that commits a NEW checkpoint before dying never exhausts
+    the budget — the cross-process analogue of run_with_restarts'
+    progress_fn."""
+    root = str(tmp_path / "ck")
+    os.makedirs(root)
+    script = (
+        "import os, sys\n"
+        f"root = {root!r}\n"
+        "steps = sorted(int(d[5:]) for d in os.listdir(root)\n"
+        "               if d.startswith('step_') and d[5:].isdigit())\n"
+        "n = (steps[-1] if steps else 0) + 1\n"
+        "if n > 3:\n"
+        "    sys.exit(0)\n"
+        "d = os.path.join(root, f'step_{n}')\n"
+        "os.makedirs(d)\n"
+        "import json\n"
+        "json.dump({'iteration': n,\n"
+        "           'hybrid_parallel_config': {'world_size': 1}},\n"
+        "          open(os.path.join(d, 'meta.json'), 'w'))\n"
+        "open(os.path.join(d, 'COMMITTED'), 'w').write('ok')\n"
+        "sys.exit(18)\n")
+    sup = _sup(lambda st: [sys.executable, "-c", script],
+               save_dir=root, max_restarts=1)  # budget 1, but 3 preempts
+    assert sup.run() == 0
+    assert sup.state.attempt == 4
+    assert sup.state.last_commit_step == 3
+
+
+def test_world_change_is_progress_within_budget(tmp_path):
+    root = str(tmp_path / "ck")
+    os.makedirs(root)
+    _commit(root, 1, world=4)
+    # run() probes once at init, then once per attempt: world 4 at init
+    # and attempt 1, shrinks to 2 at attempt 2
+    worlds = iter([4, 4, 2])
+    sup = _sup(_exit_child(18), save_dir=root, max_restarts=2,
+               max_world_changes=8, world_fn=lambda: next(worlds, 2))
+    assert sup.run() == 18
+    # attempts: 1 (r0->1), 2 (world change resets, r0->1), 3 (r1->2),
+    # 4 (budget spent)
+    assert sup.state.attempt == 4
+    assert sup.state.world_changes == 1
+
+
+def test_world_change_budget_bounds_flapping(tmp_path):
+    """A fleet that flaps topology every attempt must still terminate:
+    past max_world_changes, a change no longer resets the budget."""
+    root = str(tmp_path / "ck")
+    os.makedirs(root)
+    _commit(root, 1)
+    w = [0]
+
+    def world():
+        w[0] += 1
+        return w[0]  # different every probe
+
+    sup = _sup(_exit_child(18), save_dir=root, max_restarts=1,
+               max_world_changes=2, world_fn=world)
+    assert sup.run() == 18
+    assert sup.state.world_changes == 2  # budget pinned at the cap
+
+
+# -- state persistence -------------------------------------------------------
+
+
+def test_state_roundtrip_atomic(tmp_path):
+    p = str(tmp_path / "s.json")
+    st = SupervisorState(attempt=5, restarts=2, world_changes=1,
+                        last_exit_code=18, last_commit_step=40,
+                        last_commit_wall=123.0, last_world=8, backoff_s=1.5)
+    st.save(p)
+    st2 = SupervisorState.load(p)
+    assert st2 == st
+    assert not os.path.exists(p + ".tmp")
+
+
+def test_state_survives_supervisor_restart(tmp_path):
+    """A preempted supervisor resumes with the budgets it had, not a
+    fresh allowance."""
+    p = str(tmp_path / "s.json")
+    sup = _sup(_exit_child(18), state_file=p, max_restarts=2)
+    assert sup.run() == 18
+    sup2 = _sup(_exit_child(18), state_file=p, max_restarts=2)
+    # budget already spent in the previous incarnation: no relaunch
+    assert sup2.run() == 18
+    assert sup2.state.attempt == sup.state.attempt + 1
+
+
+def test_corrupt_state_file_degrades_to_fresh(tmp_path):
+    p = str(tmp_path / "s.json")
+    with open(p, "w") as f:
+        f.write("{torn")
+    st = SupervisorState.load(p)
+    assert st == SupervisorState()
+
+
+# -- RESUME_PIN lease --------------------------------------------------------
+
+
+def test_pin_written_before_relaunch_and_cleared_on_success(tmp_path):
+    root = str(tmp_path / "ck")
+    os.makedirs(root)
+    _commit(root, 7)
+    seen = []
+
+    def argv(st):
+        seen.append(ckpt_paths.read_resume_pin(root))
+        return _exit_child(0)(st)
+
+    sup = _sup(argv, save_dir=root)
+    assert sup.run() == 0
+    assert seen == [os.path.join(root, "step_7")]  # pinned at spawn time
+    assert ckpt_paths.read_resume_pin(root) is None  # cleared on success
+
+
+def test_pin_respected_by_gc(tmp_path):
+    """The cross-process half of the GC race fix: retention in ANOTHER
+    process must not prune the pinned step dir."""
+    from hetu_galvatron_tpu.runtime.checkpoint import gc_checkpoints
+
+    root = str(tmp_path / "ck")
+    os.makedirs(root)
+    for s in (1, 2, 3):
+        _commit(root, s)
+    ckpt_paths.write_resume_pin(root, os.path.join(root, "step_1"))
+    removed = gc_checkpoints(root, keep_last=1)
+    assert os.path.isdir(os.path.join(root, "step_1"))  # pinned survivor
+    assert os.path.isdir(os.path.join(root, "step_3"))  # newest survivor
+    assert os.path.join(root, "step_2") in removed
+
+
+def test_expired_pin_reads_absent(tmp_path):
+    root = str(tmp_path)
+    d = _commit(root, 1)
+    ckpt_paths.write_resume_pin(root, d)
+    assert ckpt_paths.read_resume_pin(root) == os.path.abspath(d)
+    assert ckpt_paths.read_resume_pin(root, ttl_s=0.0) is None
+
+
+# -- signal forwarding -------------------------------------------------------
+
+
+def test_sigterm_forwarded_child_exits_loop_terminal(tmp_path):
+    """SIGTERM to the supervisor forwards to the child and makes the
+    loop terminal — the fleet preempted US; never relaunch."""
+    ready = str(tmp_path / "ready")
+    script = ("import signal, sys, time\n"
+              "signal.signal(signal.SIGTERM, lambda *a: sys.exit(18))\n"
+              f"open({ready!r}, 'w').write('up')\n"
+              "time.sleep(30)\n")
+    sup = _sup(lambda st: [sys.executable, "-c", script],
+               state_file=str(tmp_path / "s.json"), term_grace_s=10.0)
+    fired = []
+    orig_wait = sup._wait
+
+    def wait_and_signal(child):
+        if not fired:
+            fired.append(1)
+            _await_file(ready)  # handler installed before we deliver
+            # deliver the stop the way the handler would (tests run on
+            # pytest's main thread but the handler itself is thread-safe)
+            sup._child = child
+            sup._on_signal(signal.SIGTERM, None)
+        return orig_wait(child)
+
+    sup._wait = wait_and_signal
+    assert sup.run() == 18
+    assert sup.state.attempt == 1
+    assert not sup.escalated  # the child honored the grace window
+
+
+def test_grace_escalation_kills_a_wedged_child(tmp_path):
+    """A child that ignores SIGTERM is SIGKILL'd after term_grace_s —
+    a preempted supervisor must hand back before the fleet's deadline."""
+    ready = str(tmp_path / "ready")
+    script = ("import signal, time\n"
+              "signal.signal(signal.SIGTERM, signal.SIG_IGN)\n"
+              f"open({ready!r}, 'w').write('up')\n"
+              "time.sleep(60)\n")
+    sup = _sup(lambda st: [sys.executable, "-c", script],
+               state_file=str(tmp_path / "s.json"), term_grace_s=0.3)
+    fired = []
+    orig_wait = sup._wait
+
+    def wait_and_signal(child):
+        if not fired:
+            fired.append(1)
+            _await_file(ready)  # SIG_IGN installed before we deliver
+            sup._child = child
+            sup._on_signal(signal.SIGTERM, None)
+        return orig_wait(child)
+
+    sup._wait = wait_and_signal
+    rc = sup.run()
+    assert sup.escalated  # the kill timer had to fire
+    assert sup.state.attempt == 1  # terminal, not a restart
+    assert rc == 18  # surfaced as the preemption code
+
+
+# -- liveness ----------------------------------------------------------------
+
+
+def test_health_payload_fields(tmp_path):
+    root = str(tmp_path / "ck")
+    os.makedirs(root)
+    _commit(root, 9)
+    sup = _sup(_exit_child(18), save_dir=root, max_restarts=1)
+    assert sup.run() == 18
+    h = sup.health()
+    assert h["supervisor_attempt"] == 2
+    assert h["last_child_exit_code"] == 18
+    assert h["last_commit_step"] == 9
+    assert h["last_commit_age_s"] >= 0.0
+    assert h["child_alive"] is False
+    json.dumps(h)  # must be wire-serializable for /healthz
+
+
+def test_healthz_endpoint_serves_supervisor_fields(tmp_path):
+    from hetu_galvatron_tpu.observability.prometheus import MetricsHTTPServer
+
+    root = str(tmp_path / "ck")
+    os.makedirs(root)
+    _commit(root, 3)
+    sup = _sup(_exit_child(0), save_dir=root)
+    assert sup.run() == 0
+    server = MetricsHTTPServer(port=0, health_fn=sup.health)
+    port = server.start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=5) as r:
+            payload = json.loads(r.read())
+    finally:
+        server.stop()
+    assert payload["status"] == "ok"
+    assert payload["supervisor_attempt"] == 1
+    assert payload["last_commit_step"] == 3
+
+
+def test_supervisor_events_emitted(tmp_path):
+    """The supervisor timeline (spawn / child_exit / done events) lands
+    in the registry's sinks — cli/summarize.py renders it."""
+    from hetu_galvatron_tpu.observability.registry import MetricsRegistry
+    from hetu_galvatron_tpu.observability.sinks import JsonlSink
+
+    path = str(tmp_path / "m.jsonl")
+    reg = MetricsRegistry([JsonlSink(path)])
+    sup = _sup(_exit_child(0), state_file=str(tmp_path / "s.json"),
+               registry=reg)
+    assert sup.run() == 0
+    reg.close()
+    events = [json.loads(l)["data"]["event"] for l in open(path)
+              if json.loads(l).get("name") == "supervisor"]
+    assert events[0] == "spawn"
+    assert "child_exit" in events
+    assert events[-1] == "done"

@@ -11,6 +11,7 @@ from hetu_galvatron_tpu.utils.retrying import (
     backoff_delay,
     backoff_delays,
     retry_call,
+    set_fault_injector,
 )
 
 pytestmark = [pytest.mark.utils, pytest.mark.robustness]
@@ -106,3 +107,98 @@ def test_on_retry_hook_sees_error_and_delay():
         attempts=2, sleep=lambda s: None,
         on_retry=lambda e, a, d: seen.append((type(e).__name__, a)))
     assert seen == [("OSError", 0)]  # IOError is an OSError alias
+
+
+def test_deadline_caps_total_elapsed():
+    """A slow failing fn must surface its error once deadline_s of wall
+    has elapsed, even with attempts remaining — the attempt budget alone
+    would let a hung mount stall a resume for attempts x hang time."""
+    now = [0.0]
+    calls = []
+
+    def slow_fail():
+        calls.append(1)
+        now[0] += 4.0  # each attempt burns 4s of (fake) wall
+        raise IOError("mount hung")
+
+    with pytest.raises(IOError, match="mount hung"):
+        retry_call(slow_fail, attempts=10, sleep=lambda s: None,
+                   deadline_s=10.0, clock=lambda: now[0])
+    assert len(calls) == 3  # 4s + 4s + 4s crossed the 10s deadline
+
+
+def test_deadline_clamps_backoff_sleep():
+    """The last pre-deadline sleep is truncated to the remaining budget,
+    not the full jittered envelope."""
+    now = [0.0]
+    sleeps = []
+
+    def fake_sleep(s):
+        sleeps.append(s)
+        now[0] += s
+
+    def fail():
+        now[0] += 1.0
+        raise IOError("x")
+
+    with pytest.raises(IOError):
+        retry_call(fail, attempts=50, base=100.0, cap=100.0,
+                   sleep=fake_sleep, deadline_s=5.0,
+                   clock=lambda: now[0],
+                   rng=random.Random(0))
+    assert sleeps and all(s <= 5.0 for s in sleeps)
+
+
+def test_deadline_counts_in_registry(monkeypatch):
+    from hetu_galvatron_tpu.observability import registry as reg_mod
+
+    reg = reg_mod.MetricsRegistry()
+    monkeypatch.setattr(reg_mod, "get_registry", lambda: reg)
+    now = [0.0]
+
+    def fail():
+        now[0] += 9.0
+        raise IOError("x")
+
+    with pytest.raises(IOError):
+        retry_call(fail, attempts=5, op="test.op", sleep=lambda s: None,
+                   deadline_s=8.0, clock=lambda: now[0])
+    assert reg.counter("retry/deadline_exceeded", op="test.op").value == 1
+
+
+def test_fault_injector_fires_by_op_and_restores():
+    """The chaos seam: an installed injector fails matching ops (counted
+    against the SAME retry budget), and set_fault_injector returns the
+    previous injector so harnesses can nest/restore."""
+    hits = []
+
+    def inject(op):
+        if "checkpoint" in op and len(hits) < 2:
+            hits.append(op)
+            return OSError("injected")
+        return None
+
+    prev = set_fault_injector(inject)
+    try:
+        out = retry_call(lambda: "ok", attempts=3, op="checkpoint.read",
+                         sleep=lambda s: None)
+        assert out == "ok"
+        assert hits == ["checkpoint.read", "checkpoint.read"]
+        # a non-matching op is untouched
+        assert retry_call(lambda: "ok", attempts=1, op="dataset.fetch",
+                          sleep=lambda s: None) == "ok"
+    finally:
+        restored = set_fault_injector(prev)
+        assert restored is inject
+
+
+def test_fault_injector_exhausting_budget_raises_injected():
+    def inject(op):
+        return OSError("always down")
+
+    prev = set_fault_injector(inject)
+    try:
+        with pytest.raises(OSError, match="always down"):
+            retry_call(lambda: "ok", attempts=2, sleep=lambda s: None)
+    finally:
+        set_fault_injector(prev)

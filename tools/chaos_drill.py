@@ -1,0 +1,504 @@
+#!/usr/bin/env python
+"""Chaos drill matrix: fault-inject a real supervised training run and
+assert the recovery invariants.
+
+::
+
+    python tools/chaos_drill.py                # full matrix (slow, CPU)
+    python tools/chaos_drill.py --case crash   # one case
+    python tools/chaos_drill.py --smoke        # harness self-check (fast)
+
+Each matrix case runs the REAL stack: a baseline ``cli.train_dist``
+child establishes the uninterrupted loss trajectory, then
+``cli.supervise`` drives fault-injected children
+(``runtime/chaos.py``) through the cross-process supervisor, and the
+case asserts by name on exit codes, restart counts, bit-exact resumed
+trajectories (per-step ``train/loss`` gauges from the metrics JSONL —
+full float precision, unlike the 4-decimal stdout log), bit-exact
+final parameters (the final committed checkpoint's arrays), bounded
+RPO, torn-staging-dir cleanup, and parseable flight-recorder dumps.
+
+``--smoke`` validates the harness itself with synthetic (jax-free)
+children in a few seconds — the leg ``__graft_entry__.dryrun_multichip``
+runs on every dryrun. The pytest wrappers live in
+``tests/core/test_chaos.py`` (slow tier).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import shutil
+import subprocess
+import sys
+import tempfile
+from typing import Any, Dict, List, Optional, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ZOO = os.path.join(REPO, "hetu_galvatron_tpu", "models", "configs")
+
+TINY = [
+    "model.hidden_size=32", "model.num_hidden_layers=2",
+    "model.num_attention_heads=2", "model.vocab_size=64",
+    "model.seq_length=8", "model.max_position_embeddings=16",
+    "model.make_vocab_size_divisible_by=1",
+    "train.train_iters=6", "train.seed=1234",
+    "parallel.mixed_precision=fp32",
+    "parallel.global_train_batch_size=8",
+    "logging.log_interval=1",
+    "observability.enabled=true", "observability.flush_interval=1",
+]
+
+CASES = ("crash", "preempt", "kill_mid_save", "corrupt_meta",
+         "transient_io", "hung_save", "budget")
+
+
+def _child_env() -> Dict[str, str]:
+    """Children run on exactly ONE virtual CPU device: drills measure
+    the recovery protocol, not the mesh, and a single device keeps the
+    trajectories deterministic and the compiles cheap."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    env.setdefault("JAX_ENABLE_X64", "0")
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def _run(module: str, overrides: List[str], *,
+         timeout_s: float = 420.0) -> subprocess.CompletedProcess:
+    cmd = [sys.executable, "-m", module,
+           os.path.join(ZOO, "gpt2-small.yaml")] + TINY + overrides
+    return subprocess.run(cmd, env=_child_env(), cwd=REPO,
+                          capture_output=True, text=True,
+                          timeout=timeout_s)
+
+
+def _trajectory(metrics_path: str) -> Dict[int, float]:
+    """step -> loss from the metrics JSONL's ``train/loss`` gauge
+    records. Last write per step wins: a resumed attempt re-flushing a
+    step supersedes the dead attempt's value (they must be bit-equal
+    anyway — asserted by the caller)."""
+    traj: Dict[int, float] = {}
+    with open(metrics_path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # a torn tail line from a killed writer
+            if rec.get("kind") == "gauge" and \
+                    rec.get("name") == "train/loss" and \
+                    rec.get("step") is not None:
+                traj[int(rec["step"])] = float(rec["value"])
+    return traj
+
+
+def _final_params(ckpt_root: str):
+    """Arrays of the NEWEST committed checkpoint (flat path -> np)."""
+    import numpy as np
+
+    from hetu_galvatron_tpu.runtime import ckpt_paths
+
+    latest = ckpt_paths.latest_committed_step(ckpt_root)
+    assert latest is not None, f"no committed checkpoint under {ckpt_root}"
+    step, ckdir = latest
+    import orbax.checkpoint as ocp
+
+    with ocp.StandardCheckpointer() as ckptr:
+        tree = ckptr.restore(os.path.join(ckdir, "params"))
+    import jax
+
+    flat = {
+        jax.tree_util.keystr(path): np.asarray(leaf)  # off-device compare
+        for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]
+    }
+    return step, flat
+
+
+def _assert_bit_equal_params(root_a: str, root_b: str) -> int:
+    import numpy as np
+
+    step_a, a = _final_params(root_a)
+    step_b, b = _final_params(root_b)
+    assert step_a == step_b, \
+        f"final committed steps differ: {step_a} vs {step_b}"
+    assert a.keys() == b.keys()
+    for k in a:
+        assert np.array_equal(np.asarray(a[k]), np.asarray(b[k])), \
+            f"params leaf {k} differs at step {step_a}"
+    return step_a
+
+
+def _assert_traj_matches(base: Dict[int, float], got: Dict[int, float],
+                         *, require_last: bool = True) -> None:
+    """Every step both runs logged must agree BIT-EXACTLY, and the
+    chaos run must reach the baseline's final step. (A killed writer
+    may lose its last un-flushed record, so strict superset is not
+    required of intermediate steps.)"""
+    common = sorted(set(base) & set(got))
+    assert common, f"no common steps: baseline {sorted(base)}, " \
+                   f"chaos {sorted(got)}"
+    for s in common:
+        assert base[s] == got[s], \
+            f"step {s}: loss {got[s]!r} != baseline {base[s]!r}"
+    if require_last:
+        last = max(base)
+        assert last in got, \
+            f"chaos run never reached final step {last} (got {sorted(got)})"
+
+
+def _flight_dumps(d: str, prefix: str = "flight") -> List[Dict[str, Any]]:
+    out = []
+    for p in sorted(glob.glob(os.path.join(d, f"{prefix}*.json"))):
+        with open(p) as f:
+            out.append(json.load(f))  # parseable or the case fails
+    return out
+
+
+def _supervisor_events(metrics_path: str) -> List[Dict[str, Any]]:
+    evs = []
+    with open(metrics_path) as f:
+        for line in f:
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if rec.get("kind") == "event" and rec.get("name") == "supervisor":
+                evs.append(rec.get("data") or {})
+    return evs
+
+
+def run_baseline(workdir: str) -> Dict[str, Any]:
+    """The uninterrupted reference run every case compares against."""
+    d = os.path.join(workdir, "baseline")
+    shutil.rmtree(d, ignore_errors=True)
+    os.makedirs(d)
+    metrics = os.path.join(d, "metrics.jsonl")
+    proc = _run("hetu_galvatron_tpu.cli.train_dist", [
+        f"ckpt.save={d}/ck", "ckpt.save_interval=2",
+        f"observability.metrics_path={metrics}",
+    ])
+    assert proc.returncode == 0, \
+        f"baseline failed ({proc.returncode}):\n{proc.stdout}\n{proc.stderr}"
+    traj = _trajectory(metrics)
+    assert len(traj) >= 5, f"baseline logged too few steps: {sorted(traj)}"
+    return {"traj": traj, "ckpt": f"{d}/ck"}
+
+
+# ---------------------------------------------------------------------------
+# matrix cases — each returns a short human-readable result line
+# ---------------------------------------------------------------------------
+
+
+def _supervised(workdir: str, name: str, extra: List[str],
+                *, max_restarts: int = 3) -> Tuple[int, str, str, subprocess.CompletedProcess]:
+    d = os.path.join(workdir, name)
+    shutil.rmtree(d, ignore_errors=True)  # a stale dir would replay old
+    os.makedirs(d)                        # receipts into the assertions
+    metrics = os.path.join(d, "metrics.jsonl")
+    proc = _run("hetu_galvatron_tpu.cli.supervise", [
+        f"ckpt.save={d}/ck", "ckpt.save_interval=2",
+        f"observability.metrics_path={metrics}",
+        f"observability.flight_dir={d}/flight",
+        "chaos.enable=true",
+        "supervisor.auto_restart=true", "supervisor.mode=process",
+        f"supervisor.max_restarts={max_restarts}",
+        "supervisor.backoff_base_s=0.1", "supervisor.backoff_max_s=0.2",
+        "supervisor.poll_interval_s=0.1",
+    ] + extra)
+    return proc.returncode, f"{d}/ck", metrics, proc
+
+
+def case_crash(workdir: str, baseline: Dict[str, Any]) -> str:
+    """Unhandled host exception at step 3: child exits 1, supervisor
+    relaunches, resume from step_2 replays bit-exactly."""
+    rc, ck, metrics, proc = _supervised(workdir, "crash",
+                                        ["chaos.kind=crash",
+                                         "chaos.at_iter=3"])
+    assert rc == 0, f"supervised run failed ({rc}):\n{proc.stdout[-2000:]}" \
+                    f"\n{proc.stderr[-2000:]}"
+    evs = _supervisor_events(metrics)
+    exits = [e for e in evs if e.get("event") == "child_exit"]
+    assert [e["code"] for e in exits] == [1, 0], \
+        f"expected exits [1, 0], got {[e['code'] for e in exits]}"
+    _assert_traj_matches(baseline["traj"], _trajectory(metrics))
+    step = _assert_bit_equal_params(baseline["ckpt"], ck)
+    dumps = _flight_dumps(os.path.join(workdir, "crash", "flight"))
+    assert any(d.get("reason") == "crash" for d in dumps), \
+        "no child crash flight dump"
+    assert any(d.get("reason", "").startswith("child_exit")
+               for d in dumps), "no supervisor flight dump"
+    return f"crash: exit 1 -> restart -> bit-equal at step {step}"
+
+
+def case_preempt(workdir: str, baseline: Dict[str, Any]) -> str:
+    """SIGTERM mid-run: the guard checkpoints at the boundary, exits 18,
+    the relaunch finishes the run step-for-step."""
+    rc, ck, metrics, proc = _supervised(workdir, "preempt",
+                                        ["chaos.kind=sigterm",
+                                         "chaos.at_iter=3"])
+    assert rc == 0, f"supervised run failed ({rc}):\n{proc.stdout[-2000:]}" \
+                    f"\n{proc.stderr[-2000:]}"
+    evs = _supervisor_events(metrics)
+    exits = [e["code"] for e in evs if e.get("event") == "child_exit"]
+    assert exits == [18, 0], f"expected exits [18, 0], got {exits}"
+    _assert_traj_matches(baseline["traj"], _trajectory(metrics))
+    step = _assert_bit_equal_params(baseline["ckpt"], ck)
+    return f"preempt: exit 18 -> restart -> bit-equal at step {step}"
+
+
+def case_kill_mid_save(workdir: str, baseline: Dict[str, Any]) -> str:
+    """SIGKILL inside the commit window of the step_4 save: the payload
+    is staged but no COMMITTED marker lands. The supervisor sees a
+    signal death, the relaunch resumes from step_2 (the torn step_4.tmp
+    is invisible to selection), replays bit-exactly, and the re-save
+    sweeps the torn staging dir."""
+    rc, ck, metrics, proc = _supervised(
+        workdir, "kill_mid_save",
+        ["chaos.kind=kill_mid_save", "chaos.at_iter=4"])
+    assert rc == 0, f"supervised run failed ({rc}):\n{proc.stdout[-2000:]}" \
+                    f"\n{proc.stderr[-2000:]}"
+    evs = _supervisor_events(metrics)
+    exits = [e["code"] for e in evs if e.get("event") == "child_exit"]
+    assert exits == [-9, 0], f"expected exits [-9, 0], got {exits}"
+    # RPO: the dead attempt lost at most the steps since its last commit
+    # (save_interval=2 -> bounded at 2 steps); the receipt the
+    # supervisor observed at death proves a commit existed
+    death = [e for e in evs if e.get("event") == "child_exit"][0]
+    assert death.get("commit_step") is not None and \
+        death["commit_step"] >= 2, f"no commit receipt at death: {death}"
+    _assert_traj_matches(baseline["traj"], _trajectory(metrics))
+    step = _assert_bit_equal_params(baseline["ckpt"], ck)
+    torn = glob.glob(os.path.join(ck, "*.tmp"))
+    assert not torn, f"torn staging dirs survived: {torn}"
+    dumps = _flight_dumps(os.path.join(workdir, "kill_mid_save", "flight"),
+                          prefix="flight_supervisor")
+    assert dumps, "supervisor wrote no flight dump for the signal death"
+    return (f"kill_mid_save: exit -9 (SIGKILL in commit window) -> "
+            f"torn dir swept, bit-equal at step {step}")
+
+
+def case_corrupt_meta(workdir: str, baseline: Dict[str, Any]) -> str:
+    """A corrupted newest checkpoint + a crash: resume must FALL BACK to
+    the previous committed step with a warning (never traceback) and
+    still replay bit-exactly."""
+    rc, ck, metrics, proc = _supervised(
+        workdir, "corrupt_meta",
+        ["chaos.plan=corrupt_meta@4,crash@5"])
+    assert rc == 0, f"supervised run failed ({rc}):\n{proc.stdout[-2000:]}" \
+                    f"\n{proc.stderr[-2000:]}"
+    out = proc.stdout + proc.stderr
+    assert "falling back" in out, \
+        "resume never logged the corrupt-checkpoint fallback"
+    # the injected ChaosCrash legitimately tracebacks; the RESUME must not
+    blocks = re.findall(r"Traceback \(most recent call last\):(?:\n.+)+",
+                        out)
+    stray = [b for b in blocks if "ChaosCrash" not in b]
+    assert not stray, \
+        f"resume tracebacked on corruption:\n{stray[0][:2000]}"
+    _assert_traj_matches(baseline["traj"], _trajectory(metrics))
+    step = _assert_bit_equal_params(baseline["ckpt"], ck)
+    return f"corrupt_meta: fallback resume -> bit-equal at step {step}"
+
+
+def case_transient_io(workdir: str, baseline: Dict[str, Any]) -> str:
+    """Crash, then transient I/O errors on the resume's checkpoint
+    reads: the retry seam absorbs them (one attempt, no extra restart),
+    and the trajectory still replays bit-exactly."""
+    rc, ck, metrics, proc = _supervised(
+        workdir, "transient_io",
+        ["chaos.plan=crash@3,io_error", "chaos.io_error_count=2",
+         "chaos.io_error_op=checkpoint"])
+    assert rc == 0, f"supervised run failed ({rc}):\n{proc.stdout[-2000:]}" \
+                    f"\n{proc.stderr[-2000:]}"
+    evs = _supervisor_events(metrics)
+    exits = [e["code"] for e in evs if e.get("event") == "child_exit"]
+    assert exits == [1, 0], \
+        f"transient I/O must not cost an attempt: exits {exits}"
+    retried = any(
+        "injecting transient I/O error" in (proc.stdout + proc.stderr)
+        for _ in (0,))
+    assert retried, "the injector never fired through the retry seam"
+    _assert_traj_matches(baseline["traj"], _trajectory(metrics))
+    step = _assert_bit_equal_params(baseline["ckpt"], ck)
+    return f"transient_io: retries absorbed -> bit-equal at step {step}"
+
+
+def case_hung_save(workdir: str, baseline: Dict[str, Any]) -> str:
+    """A background checkpoint write hangs past ckpt.save_timeout_s:
+    the watchdog counts it, the exit drain abandons it instead of
+    wedging shutdown, and training itself completes."""
+    rc, ck, metrics, proc = _supervised(
+        workdir, "hung_save",
+        ["chaos.kind=hung_save", "chaos.at_iter=4", "chaos.hang_s=30",
+         "ckpt.snapshot_async=true", "ckpt.save_timeout_s=2"])
+    assert rc == 0, f"supervised run failed ({rc}):\n{proc.stdout[-2000:]}" \
+                    f"\n{proc.stderr[-2000:]}"
+    out = proc.stdout + proc.stderr
+    assert "abandoning a hung checkpoint write" in out or \
+        "hung" in out, "the hung-save watchdog never reported"
+    _assert_traj_matches(baseline["traj"], _trajectory(metrics))
+    # the hung write never committed: the newest commit predates it
+    from hetu_galvatron_tpu.runtime import ckpt_paths
+
+    latest = ckpt_paths.latest_committed_step(ck)
+    assert latest is not None and latest[0] <= 4, \
+        f"hung save should not have committed: {latest}"
+    return (f"hung_save: watchdog fired, drain abandoned the write, "
+            f"run completed (last commit step_{latest[0]})")
+
+
+def case_budget(workdir: str, baseline: Dict[str, Any]) -> str:
+    """A crash loop with NO progress (no checkpointing): the restart
+    budget exhausts and the supervisor surfaces the child's exit code
+    terminally."""
+    d = os.path.join(workdir, "budget")
+    shutil.rmtree(d, ignore_errors=True)
+    os.makedirs(d)
+    metrics = os.path.join(d, "metrics.jsonl")
+    # no ckpt.save: no commits (no progress), AND no chaos marker dir
+    # (chaos.state_dir unset) so the crash re-fires every attempt
+    proc = _run("hetu_galvatron_tpu.cli.supervise", [
+        "chaos.enable=true", "chaos.kind=crash", "chaos.at_iter=1",
+        f"observability.metrics_path={metrics}",
+        "supervisor.max_restarts=2",
+        f"supervisor.state_file={d}/state.json",
+        "supervisor.backoff_base_s=0.05", "supervisor.backoff_max_s=0.1",
+        "supervisor.poll_interval_s=0.1",
+    ])
+    assert proc.returncode == 1, \
+        f"budget exhaustion must surface exit 1, got {proc.returncode}"
+    st = json.load(open(os.path.join(d, "state.json")))
+    assert st["attempt"] == 3 and st["restarts"] == 2, st
+    evs = _supervisor_events(metrics)
+    assert any(e.get("event") == "giveup" for e in evs), \
+        "no giveup event in the supervisor timeline"
+    return "budget: 3 attempts, budget exhausted, surfaced exit 1"
+
+
+CASE_FNS = {
+    "crash": case_crash,
+    "preempt": case_preempt,
+    "kill_mid_save": case_kill_mid_save,
+    "corrupt_meta": case_corrupt_meta,
+    "transient_io": case_transient_io,
+    "hung_save": case_hung_save,
+    "budget": case_budget,
+}
+
+
+def run_case(name: str, workdir: str,
+             baseline: Optional[Dict[str, Any]] = None) -> str:
+    """One matrix case end to end (pytest entry point). ``baseline``
+    (from :func:`run_baseline`) may be shared across cases — same
+    config, same seed."""
+    if baseline is None:
+        baseline = run_baseline(workdir)
+    return CASE_FNS[name](workdir, baseline)
+
+
+# ---------------------------------------------------------------------------
+# --smoke: harness self-check with synthetic children (no jax)
+# ---------------------------------------------------------------------------
+
+
+def smoke(workdir: str) -> None:
+    """Validates the drill harness itself — supervisor loop, exit-code
+    handling, commit receipts, pin lifecycle, flight dump parsing —
+    with ``python -c`` children in a few seconds. Run by
+    ``__graft_entry__.dryrun_multichip`` on every dryrun."""
+    sys.path.insert(0, REPO)
+    from hetu_galvatron_tpu.observability.recorder import FlightRecorder
+    from hetu_galvatron_tpu.runtime import ckpt_paths
+    from hetu_galvatron_tpu.runtime.supervisor import ProcessSupervisor
+
+    root = os.path.join(workdir, "ck")
+    os.makedirs(root, exist_ok=True)
+    # attempt 1: commit step_2, exit 18 (preempted) — progress resets the
+    # budget. attempt 2: SIGKILL itself once (marker-one-shot, like a
+    # real transient). attempt 3: clean exit.
+    child = r"""
+import json, os, sys
+root, marker = sys.argv[1], sys.argv[2]
+steps = sorted(int(d[5:]) for d in os.listdir(root)
+               if d.startswith("step_") and d[5:].isdigit())
+if not steps:
+    d = os.path.join(root, "step_2")
+    os.makedirs(d)
+    json.dump({"iteration": 2,
+               "hybrid_parallel_config": {"world_size": 1}},
+              open(os.path.join(d, "meta.json"), "w"))
+    open(os.path.join(d, "COMMITTED"), "w").write("ok")
+    sys.exit(18)   # preempted after committing step 2
+if not os.path.exists(marker):
+    open(marker, "w").write("x")
+    os.kill(os.getpid(), 9)  # die abruptly before any new commit
+sys.exit(0)
+"""
+    flight = os.path.join(workdir, "flight")
+    rec = FlightRecorder(out_dir=flight, prefix="flight_supervisor")
+    marker = os.path.join(workdir, "killed_once")
+    sup = ProcessSupervisor(
+        lambda st: [sys.executable, "-c", child, root, marker],
+        save_dir=root, max_restarts=2, base_delay=0.0,
+        poll_interval=0.05, sleep=lambda s: None, recorder=rec,
+        log=lambda m: None)
+    rc = sup.run()
+    assert rc == 0, f"smoke supervision failed: rc {rc}"
+    assert sup.state.attempt == 3, sup.state
+    assert sup.state.last_commit_step == 2
+    assert ckpt_paths.read_resume_pin(root) is None, \
+        "pin must be cleared on success"
+    st = json.load(open(os.path.join(root, "SUPERVISOR_STATE.json")))
+    assert st["attempt"] == 3, st
+    dumps = _flight_dumps(flight, prefix="flight_supervisor")
+    assert dumps and all("reason" in d and "events" in d for d in dumps), \
+        "supervisor flight dumps missing or unparseable"
+    # health payload is json-serializable (what /healthz would serve)
+    health = json.loads(json.dumps(sup.health()))
+    assert health["supervisor_attempt"] == 3
+    assert health["last_commit_step"] == 2
+    print("chaos_drill --smoke: supervisor loop, receipts, pin, "
+          "flight dumps, health OK")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help="fast harness self-check (synthetic children)")
+    ap.add_argument("--case", choices=CASES, default=None,
+                    help="run one matrix case instead of all")
+    ap.add_argument("--workdir", default=None,
+                    help="working directory (default: a fresh tempdir)")
+    ns = ap.parse_args(argv)
+    workdir = ns.workdir or tempfile.mkdtemp(prefix="chaos_drill_")
+    if ns.smoke:
+        smoke(workdir)
+        return 0
+    sys.path.insert(0, REPO)
+    names = [ns.case] if ns.case else list(CASES)
+    print(f"chaos drill: baseline run (workdir {workdir})", flush=True)
+    baseline = run_baseline(workdir)
+    failures = []
+    for name in names:
+        print(f"chaos drill: case {name} ...", flush=True)
+        try:
+            print(f"  {run_case(name, workdir, baseline)}", flush=True)
+        except (AssertionError, subprocess.TimeoutExpired) as e:
+            failures.append((name, e))
+            print(f"  FAILED: {e}", flush=True)
+    if failures:
+        print(f"chaos drill: {len(failures)}/{len(names)} case(s) FAILED")
+        return 1
+    print(f"chaos drill: all {len(names)} case(s) green")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
