@@ -28,6 +28,8 @@ BENCH_BSZ / BENCH_SEQ / BENCH_ITERS override shapes; BENCH_SWEEP=0 disables
 the batch-size sweep; BENCH_AB=0 skips the flash-vs-XLA A/B leg; BENCH_CE=0
 skips the fused-CE leg; BENCH_SERVE_PREFIX=0 / BENCH_SPEC_DECODE=0 skip the
 serving A/B legs (prefix-cache TTFT ratio, speculative-decode tokens/sec);
+BENCH_HIER_DP=0 / BENCH_SYNTH_COLLECTIVES=0 skip the hierarchical-dp and
+synthesized-collective A/B legs;
 BENCH_TIMEOUT caps total wall clock (default 900s); BENCH_JOURNAL pins the
 journal path (default: a fresh temp file).
 """
@@ -153,6 +155,36 @@ def run_leg(spec: dict, journal: str) -> int:
                      hier_dp_bucketed_vs_mono=out.get(
                          "hier_dp_bucketed_vs_mono"),
                      hier_dp_legs=out["legs"], platform=out["platform"])
+            return 0
+        if spec.get("kind") == "synth_collectives":
+            # synthesized-vs-hand-built collective A/B
+            # (tools/synth_collectives_bench.py): the emitted ring /
+            # halving-doubling schedule programs vs the canonical
+            # reference bodies, bit-parity asserted before timing. Needs
+            # the 8-device virtual mesh on CPU, like the tp_overlap leg.
+            if spec["platform"] == "cpu":
+                os.environ["JAX_PLATFORMS"] = "cpu"
+                flag = "--xla_force_host_platform_device_count=8"
+                if "xla_force_host_platform_device_count" not in \
+                        os.environ.get("XLA_FLAGS", ""):
+                    os.environ["XLA_FLAGS"] = (
+                        os.environ.get("XLA_FLAGS", "") + " " + flag).strip()
+            sys.path.insert(0, os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), "tools"))
+            import synth_collectives_bench
+
+            out = synth_collectives_bench.run(
+                on_tpu=spec["platform"] == "tpu")
+            if "skipped" in out:
+                emit("error", error=out["skipped"])
+            else:
+                emit("ok",
+                     synth_collectives_vs_handbuilt=out[
+                         "synth_collectives_vs_handbuilt"],
+                     synth_collectives_recompiles=out[
+                         "synth_collectives_recompiles"],
+                     synth_collectives_legs=out["legs"],
+                     platform=out["platform"])
             return 0
         if spec.get("kind") in ("serve_prefix", "spec_decode"):
             # serving A/B legs (tools/serve_bench.py): single-device tiny
@@ -733,6 +765,32 @@ def main() -> int:
             print(f"warning: hier-dp A/B leg failed: {res.get('error')}",
                   file=sys.stderr)
 
+    # synthesized-vs-hand-built collective A/B
+    # (tools/synth_collectives_bench.py): on by default on both platforms
+    # — the CPU ratio (emitted schedule program overhead over the
+    # reference body, bit-parity asserted) is the committed
+    # bench_baseline.json entry. BENCH_SYNTH_COLLECTIVES=0 opts out.
+    synth_ab = None
+    if (not orch.wedged
+            and os.environ.get("BENCH_SYNTH_COLLECTIVES", "1") != "0"):
+        state["stage"] = "synth-collectives"
+        res = orch.run({"kind": "synth_collectives", "platform": platform,
+                        "seq": seq, "bsz": best["bsz"], "iters": iters,
+                        "flash": False, "fused_ce": False}, leg_budget)
+        if res["status"] == "ok":
+            synth_ab = {"synth_collectives_vs_handbuilt":
+                        res["synth_collectives_vs_handbuilt"],
+                        "synth_collectives_recompiles":
+                        res["synth_collectives_recompiles"]}
+            print(f"bench synth-collectives A/B: "
+                  f"synth_collectives_vs_handbuilt "
+                  f"{res['synth_collectives_vs_handbuilt']} (recompiles "
+                  f"{res['synth_collectives_recompiles']})",
+                  file=sys.stderr)
+        else:
+            print(f"warning: synth-collectives A/B leg failed: "
+                  f"{res.get('error')}", file=sys.stderr)
+
     # serving A/B legs (tools/serve_bench.py run_prefix / run_spec): on by
     # default on both platforms — the CPU ratios are real (TTFT measures
     # actual prefill compute skipped; tokens/sec the actual verify cost)
@@ -775,6 +833,8 @@ def main() -> int:
         out.update(co_ab)
     if hier_ab:
         out.update(hier_ab)
+    if synth_ab:
+        out.update(synth_ab)
     if serve_ab:
         out.update(serve_ab)
     if orch.abandoned:

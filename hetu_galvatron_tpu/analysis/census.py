@@ -46,7 +46,8 @@ CALLBACK_PRIMS = ("pure_callback", "io_callback", "debug_callback",
 # attribution (observability/trace_analysis.py _PERMUTE_MARKERS) can bill
 # them to the right plan component; the census fails unmarked permutes so
 # the attribution can never silently regress
-PERMUTE_MARKERS: Tuple[str, ...] = ("tp_ring", "cp_ring", "pp_rotate")
+PERMUTE_MARKERS: Tuple[str, ...] = ("tp_ring", "cp_ring", "pp_rotate",
+                                    "dp_sched")
 
 
 @dataclass
@@ -251,12 +252,15 @@ def census_compiled_step(cfg: Any, hpc: Any, train: Any, *,
 
 def trace_spmd_step(cfg: Any, hpc: Any, train: Any, mesh: Any,
                     *, tp_overlap: bool = True, hier_dp: bool = False,
-                    dcn_slices: int = 1, hier_bucket_mb: float = 0.0):
+                    dcn_slices: int = 1, hier_bucket_mb: float = 0.0,
+                    dp_schedule: Optional[str] = None):
     """ClosedJaxpr of the pp=1 SPMD train step (``parallel.spmd``) —
     tracing only, nothing executes. Shared by the count census and the
     sharding-flow byte census; ``hier_dp`` traces the hierarchical dp
     gradient-reduction variant (``ops/hier_reduce.py``),
-    ``hier_bucket_mb`` its bucketed software-pipelined flavour."""
+    ``hier_bucket_mb`` its bucketed software-pipelined flavour, and
+    ``dp_schedule`` the synthesized-collective backend
+    (``collectives/``) whose ppermutes carry the ``dp_sched`` marker."""
     import jax
     import jax.numpy as jnp
 
@@ -269,7 +273,8 @@ def trace_spmd_step(cfg: Any, hpc: Any, train: Any, mesh: Any,
     step, pspecs, ospecs, _ = make_spmd_train_step(
         cfg, hpc, mesh, axes, tx, params, compute_dtype=jnp.float32,
         donate=True, tp_overlap=tp_overlap, hier_dp=hier_dp,
-        dcn_slices=dcn_slices, hier_bucket_mb=hier_bucket_mb)
+        dcn_slices=dcn_slices, hier_bucket_mb=hier_bucket_mb,
+        dp_schedule=dp_schedule)
     sp_shape = jax.tree.map(
         lambda p: jax.ShapeDtypeStruct(p.shape, p.dtype), params)
     so_shape = jax.eval_shape(tx.init, sp_shape)
@@ -279,12 +284,13 @@ def trace_spmd_step(cfg: Any, hpc: Any, train: Any, mesh: Any,
 
 def census_spmd_step(cfg: Any, hpc: Any, train: Any, mesh: Any,
                      *, tp_overlap: bool = True, hier_dp: bool = False,
-                     dcn_slices: int = 1,
-                     hier_bucket_mb: float = 0.0) -> CensusResult:
+                     dcn_slices: int = 1, hier_bucket_mb: float = 0.0,
+                     dp_schedule: Optional[str] = None) -> CensusResult:
     """Trace the pp=1 SPMD train step (``parallel.spmd``) and census it."""
     return census_jaxpr(trace_spmd_step(
         cfg, hpc, train, mesh, tp_overlap=tp_overlap, hier_dp=hier_dp,
-        dcn_slices=dcn_slices, hier_bucket_mb=hier_bucket_mb))
+        dcn_slices=dcn_slices, hier_bucket_mb=hier_bucket_mb,
+        dp_schedule=dp_schedule))
 
 
 def trace_serving_programs(cfg: Any, *, mesh: Any = None, hpc: Any = None,
@@ -349,15 +355,16 @@ def check_census(
         where = "; ".join(sorted(set(census.unmarked_permutes))[:4])
         problems.append(
             f"{program}: {n_unmarked} collective-permute(s) carry no "
-            f"tp_ring/cp_ring/pp_rotate named_scope marker (trace "
-            f"attribution would mis-bill them) — name stacks: {where}")
+            f"tp_ring/cp_ring/pp_rotate/dp_sched named_scope marker "
+            f"(trace attribution would mis-bill them) — name stacks: "
+            f"{where}")
     if census.callbacks and not allow_callbacks:
         problems.append(
             f"{program}: host callback(s) in the hot path: "
             + "; ".join(sorted(set(census.callbacks))[:4]))
     if predicted is not None:
         marker_of = {"ppermute_tp": "tp_ring", "ppermute_cp": "cp_ring",
-                     "ppermute_pp": "pp_rotate"}
+                     "ppermute_pp": "pp_rotate", "ppermute_dp": "dp_sched"}
         for key, want in sorted(predicted.items()):
             if key in marker_of:
                 got = census.permutes_by_marker.get(marker_of[key], 0)

@@ -316,6 +316,45 @@ def search_hier_dp_expressible(s: Any, enabled: bool) -> bool:
     return s.pp == 1
 
 
+DP_SCHEDULE_FAMILIES = ("ring", "tree_hd", "tree_bcast", "torus2d",
+                        "hier_rings")
+# the hand-built reference backends (collectives/reference.py) ride the
+# same reducer seam for the bit-parity drills; they are not searched
+DP_SCHEDULE_HANDBUILT = ("ring_handbuilt", "tree_handbuilt")
+
+
+def dp_schedule_unsupported_reason(name: str, lanes: int, cross: int = 1,
+                                   bucket_mb: float = 0.0
+                                   ) -> Optional[str]:
+    """Can an emitted collective schedule ``name``
+    (``collectives/synthesize.py``) replace the hand-implemented
+    hierarchical rs/ar/ag program for a ``lanes``-wide dp group split
+    over ``cross`` slices? Pure shape arithmetic — the synthesis itself
+    re-validates via the static verifier before emission."""
+    if name not in DP_SCHEDULE_FAMILIES + DP_SCHEDULE_HANDBUILT:
+        return (f"unknown dp schedule family {name!r} (expected one of "
+                f"{DP_SCHEDULE_FAMILIES + DP_SCHEDULE_HANDBUILT})")
+    if lanes < 2:
+        return f"dp schedule needs dp > 1, got dp degree {lanes}"
+    if bucket_mb > 0:
+        return ("emitted dp schedules are monolithic; hier_bucket_mb > 0 "
+                "only composes with the hand-implemented wavefront "
+                "schedule")
+    pow2 = lanes >= 2 and (lanes & (lanes - 1)) == 0
+    if name in ("tree_hd", "tree_bcast", "ring_handbuilt",
+                "tree_handbuilt") and not pow2:
+        return (f"{name} needs a power-of-two dp group, got {lanes}")
+    if name == "torus2d" and not (
+            (cross >= 2 and lanes // cross >= 2)
+            or (lanes >= 4 and lanes % 2 == 0)):
+        return (f"torus2d needs a 2D-factorable dp group, got {lanes} "
+                f"(cross {cross})")
+    if name == "hier_rings" and not (cross >= 2 and lanes // cross >= 2):
+        return (f"hier_rings needs cross >= 2 and intra >= 2, got dp "
+                f"{lanes} over cross {cross}")
+    return None
+
+
 # ---------------------------------------------------------------------------
 # plan structure (divisibility / stage sums / axis products)
 # ---------------------------------------------------------------------------

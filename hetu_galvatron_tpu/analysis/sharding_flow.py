@@ -291,7 +291,7 @@ def reshard_findings(jaxpr: Any, *, program: str,
 # ---------------------------------------------------------------------------
 
 _MARKER_OF = {"ppermute_tp": "tp_ring", "ppermute_cp": "cp_ring",
-              "ppermute_pp": "pp_rotate"}
+              "ppermute_pp": "pp_rotate", "ppermute_dp": "dp_sched"}
 
 
 def check_flow(
@@ -376,6 +376,7 @@ def flow_compiled_step(cfg: Any, hpc: Any, train: Any, *,
 def flow_spmd_step(cfg: Any, hpc: Any, train: Any, mesh: Any, *,
                    tp_overlap: bool = True, hier_dp: bool = False,
                    dcn_slices: int = 1, hier_bucket_mb: float = 0.0,
+                   dp_schedule: Optional[str] = None,
                    gather_mb: float = 1.0) -> ProgramFlow:
     """Trace the pp=1 SPMD train step (``census.trace_spmd_step``) and run
     the full byte-side analysis — the hook the hierarchical-dp drill uses
@@ -386,7 +387,8 @@ def flow_spmd_step(cfg: Any, hpc: Any, train: Any, mesh: Any, *,
 
     jaxpr = trace_spmd_step(cfg, hpc, train, mesh, tp_overlap=tp_overlap,
                             hier_dp=hier_dp, dcn_slices=dcn_slices,
-                            hier_bucket_mb=hier_bucket_mb)
+                            hier_bucket_mb=hier_bucket_mb,
+                            dp_schedule=dp_schedule)
     return ProgramFlow(
         name="spmd_step", flow=flow_jaxpr(jaxpr),
         donation=donation_report(jaxpr),

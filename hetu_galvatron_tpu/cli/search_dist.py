@@ -17,6 +17,55 @@ import sys
 from typing import Any, Callable, Dict, Optional
 
 
+def feed_calibrated_profile(args, world: int,
+                            *, log: Callable[[str], None] = print) -> bool:
+    """Close the calibration loop: when the runtime calibration pass has
+    written a posterior profile
+    (``observability.calibration_dir``/calibrated_profile.json, PR 16)
+    whose hardware fingerprint matches this search's device kind and
+    world size, point ``search.allreduce_bandwidth_config_path`` at it —
+    production-trace-fitted curves then price the next plan instead of
+    the one-shot profiled priors. The swap is logged with full
+    provenance; ``search.use_calibrated=0`` opts out. The mesh leg of
+    the fingerprint is plan-shaped and a search has no plan yet, so only
+    device + world gate here (the per-curve keys are mesh-agnostic).
+    Returns True when the calibrated profile was installed."""
+    sa = args.search
+    obs = getattr(args, "observability", None)
+    cal_dir = getattr(obs, "calibration_dir", None) if obs else None
+    if not getattr(sa, "use_calibrated", 1) or not cal_dir:
+        return False
+    from hetu_galvatron_tpu.core.search_engine.profiles import (
+        read_profile_provenance,
+    )
+    from hetu_galvatron_tpu.observability.calibration import (
+        PROFILE_NAME,
+        fingerprint_key,
+        hardware_fingerprint,
+    )
+
+    path = os.path.join(cal_dir, PROFILE_NAME)
+    if not os.path.exists(path):
+        return False
+    meta = read_profile_provenance(path)
+    fp = meta.get("fingerprint") or {}
+    want = hardware_fingerprint(None, world=world)
+    if (str(fp.get("device")) != want["device"]
+            or int(fp.get("world", 0) or 0) != int(world)):
+        log(f"calibration: ignoring {path} — its fingerprint "
+            f"{fingerprint_key(fp)} does not match this search "
+            f"({fingerprint_key(want)})")
+        return False
+    prev = sa.allreduce_bandwidth_config_path
+    sa.allreduce_bandwidth_config_path = path
+    counts = meta.get("curves") or meta.get("points_per_curve") or {}
+    log("calibration: pricing with the runtime-calibrated profile "
+        f"{path} (source {meta.get('source', '?')}, fingerprint "
+        f"{fingerprint_key(fp)}, {len(counts) or '?'} re-fit curve(s))"
+        + (f"; replaces {prev}" if prev else ""))
+    return True
+
+
 def search_plan_for_world(args, world: int, out_dir: str,
                           *, log: Callable[[str], None] = print
                           ) -> Optional[str]:
@@ -36,6 +85,7 @@ def search_plan_for_world(args, world: int, out_dir: str,
 
     sa = args.search
     os.makedirs(out_dir, exist_ok=True)
+    feed_calibrated_profile(args, world, log=log)
     settled = args.parallel.global_train_batch_size
     if sa.settle_bsz > 0 and sa.settle_bsz != settled:
         log(f"elastic re-search: ignoring search.settle_bsz="
@@ -204,6 +254,8 @@ def main(argv=None) -> int:
     args = args_from_cli(argv if argv is not None else sys.argv[1:],
                          mode="search")
     args = resolve_model_config(args)
+    feed_calibrated_profile(
+        args, args.search.num_nodes * args.search.num_devices_per_node)
     engine = SearchEngine(
         args.search,
         mixed_precision=args.search.mixed_precision,

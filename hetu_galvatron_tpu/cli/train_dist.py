@@ -283,6 +283,39 @@ def train(args) -> Dict[str, Any]:
                       f"(dp {_dp} = {_cross} slice x {_dp // _cross} host;"
                       f" rs-intra / ar-cross / ag-intra, once per step{_bkt})")
 
+    # synthesized collective schedule (collectives/): an explicit
+    # parallel.dp_schedule wins, else the searched plan's recorded family
+    # (engine.save_results "dp_schedule"). Only the pp=1 SPMD hier path
+    # executes emitted programs; anything inexpressible falls back to the
+    # hand-implemented three-stage reduction with a logged reason.
+    dp_schedule_on = None
+    _want_sched = str(getattr(args.parallel, "dp_schedule", "") or
+                      hpc.dp_schedule or "")
+    if _want_sched and hier_dp_on:
+        if hpc.pp_deg > 1:
+            state.log(f"dp_schedule: {_want_sched!r} needs the pp=1 SPMD "
+                      "path (pp engines keep the hand-built reduction)")
+        else:
+            from hetu_galvatron_tpu.analysis.eligibility import (
+                dp_schedule_unsupported_reason,
+            )
+            from hetu_galvatron_tpu.runtime.mesh import hier_cross_degree
+
+            _dp = hpc.layers[0].dp_size
+            _cross = hier_cross_degree(hpc.pp_deg, _dp,
+                                       args.parallel.dcn_slices)
+            _sr = dp_schedule_unsupported_reason(
+                _want_sched, _dp, _cross, hier_bucket_mb)
+            if _sr is not None:
+                state.log(f"dp_schedule: falling back to the hand-built "
+                          f"reduction ({_sr})")
+            else:
+                dp_schedule_on = _want_sched
+                state.log(f"dp_schedule: executing the synthesized "
+                          f"{_want_sched!r} program (collectives/emit.py)")
+    elif _want_sched:
+        state.log(f"dp_schedule: {_want_sched!r} ignored without hier_dp")
+
     def finish_tp_overlap_setup(step_fn):
         """Once the engine choice has settled: emit the coverage gauge and
         wrap the step in the ``tp/overlap_step`` span."""
@@ -801,6 +834,12 @@ def train(args) -> Dict[str, Any]:
                             world=world,
                             min_points=(
                                 args.observability.calibration_min_points),
+                            window_days=(
+                                args.observability
+                                .calibration_window_days),
+                            max_points_per_curve=(
+                                args.observability
+                                .calibration_max_points),
                             regret_threshold=(
                                 args.observability.regret_threshold),
                             plan_path=(
@@ -905,7 +944,7 @@ def train(args) -> Dict[str, Any]:
             cfg, hpc, mesh, axes, tx, params, compute_dtype=compute_dtype,
             donate=not rerun.enabled, tp_overlap=tp_overlap_on,
             hier_dp=hier_dp_on, dcn_slices=args.parallel.dcn_slices,
-            hier_bucket_mb=hier_bucket_mb)
+            hier_bucket_mb=hier_bucket_mb, dp_schedule=dp_schedule_on)
         nshd = jax.tree.map(
             lambda s: NamedSharding(mesh, s), ospecs,
             is_leaf=lambda x: isinstance(x, PartitionSpec))
@@ -924,7 +963,8 @@ def train(args) -> Dict[str, Any]:
                     donate=not rerun.enabled, chunks=ch,
                     tp_overlap=tp_overlap_on, hier_dp=hier_dp_on,
                     dcn_slices=args.parallel.dcn_slices,
-                    hier_bucket_mb=hier_bucket_mb)[0]
+                    hier_bucket_mb=hier_bucket_mb,
+                    dp_schedule=dp_schedule_on)[0]
             return step_cache[ch]
 
         def spmd_step(sp, so, raw):

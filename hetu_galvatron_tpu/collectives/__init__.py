@@ -1,0 +1,36 @@
+"""Collective-schedule compiler: a chunk/step IR that synthesizes,
+verifies, prices, and emits mesh-shaped collectives.
+
+The repo hand-built three collective schedules (ring rs/ag, recursive
+halving-doubling, bucketed wavefront rs/ar/ag) and priced them
+min-over-curves; this package is the generalization the ROADMAP asked
+for (GC3's chunk-step collective IR, arxiv 2201.11840; "The Big
+Send-off"'s topology-shaped synthesis, arxiv 2504.18658):
+
+* :mod:`.ir` — ``Schedule`` / ``Step`` / ``Xfer``: chunks of a logical
+  buffer moved by ppermute exchanges, each step tagged with a link
+  class (``ici`` / ``dcn``) and a wavefront slot.
+* :mod:`.synthesize` — generators shaped to the actual mesh graph:
+  ring rs/ag, recursive halving-doubling, 2D-torus multi-ring,
+  latency-optimal binary trees, and the hierarchical
+  rs-intra/ar-cross/ag-intra schedule *derived* by embedding ring
+  sub-schedules instead of bespoke code.
+* :mod:`.verify` — a static verifier (reduction completeness, per-rank
+  count/byte-exactness, link-class legality, step-order deadlock
+  freedom) that rejects broken schedules diagnostically.
+* :mod:`.emit` — lowers a verified ``Schedule`` to one full-manual
+  shard_map body with per-step ``named_scope`` markers, so the census /
+  flow passes and trace attribution consume emitted programs unchanged.
+* :mod:`.pricing` — α-β pricing of any ``Schedule`` (per-link-class
+  fill/drain over wavefront slots) on the calibrated curve plumbing.
+* :mod:`.reference` — the canonical HAND-BUILT ring / halving-doubling
+  bodies (lifted from the hardware profiler); the emitted programs are
+  pinned bit-identical to them.
+"""
+
+from hetu_galvatron_tpu.collectives.ir import (  # noqa: F401
+    Schedule,
+    ScheduleError,
+    Step,
+    Xfer,
+)

@@ -1,0 +1,135 @@
+"""Lower a verified :class:`~.ir.Schedule` to a shard_map body.
+
+The lowering is table-driven: for every exchange step the emitter
+precomputes constant per-rank int32 tables — which chunk slots each
+rank sends, which slots the received payload lands in, and whether the
+rank participates — and the body gathers its own row with
+``jax.lax.axis_index``. Each step is exactly ONE ``lax.ppermute`` under
+the step's ``jax.named_scope`` marker, so the census counts it, trace
+attribution bills it, and the flow pass weighs its bytes, all through
+the machinery the hand-built kernels already use.
+
+Combine semantics are ``new = recv + cur`` for ``add`` (the same
+operand order as the hand-built bodies; IEEE addition is commutative,
+so the pairing — which the synthesis mirrors hop-for-hop — is the only
+thing that matters for bit-parity) and ``new = recv`` for ``replace``.
+Non-participating ranks mask the update and scatter their own values
+back to DISTINCT pad slots (a duplicate index in one scatter would be
+order-nondeterministic), so every rank runs the identical program.
+
+``emit_allreduce_body`` verifies the schedule first — an unverifiable
+schedule never lowers.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Tuple, Union
+
+import numpy as np
+
+from hetu_galvatron_tpu.collectives.ir import Schedule, ScheduleError
+from hetu_galvatron_tpu.collectives.verify import verify
+
+Axis = Union[str, Tuple[str, ...]]
+
+
+def _exchange_tables(sched: Schedule, step) -> Tuple:
+    """(perm, send_tbl [n,K], recv_tbl [n,K], valid [n,K]) for one
+    exchange step. Pad recv slots use chunk ids the rank does not
+    otherwise touch this step, so the masked write-back never collides
+    with a real write."""
+    n, C = sched.n_ranks, sched.n_chunks
+    K = max((len(x.chunks) for x in step.xfers), default=1)
+    K = max(K, 1)
+    if K > C:
+        raise ScheduleError(
+            f"step ({step.scope!r}): sends {K} chunks but the schedule "
+            f"only has {C}")
+    perm = [(x.src, x.dst) for x in step.xfers]
+    send = np.zeros((n, K), np.int32)
+    recv = np.zeros((n, K), np.int32)
+    valid = np.zeros((n, K), bool)
+    recv_set: List[set] = [set() for _ in range(n)]
+    for x in step.xfers:
+        send[x.src, :len(x.chunks)] = x.chunks
+        recv[x.dst, :len(x.chunks)] = x.chunks
+        valid[x.dst, :len(x.chunks)] = True
+        recv_set[x.dst].update(x.chunks)
+    for r in range(n):
+        used = recv_set[r]
+        free = iter(k for k in range(C) if k not in used)
+        for j in range(K):
+            if not valid[r, j]:
+                recv[r, j] = next(free)
+    return perm, send, recv, valid
+
+
+def emit_allreduce_body(sched: Schedule, axis: Axis,
+                        verify_first: bool = True) -> Callable:
+    """A function of one flat per-device vector (length divisible by
+    ``sched.n_chunks``) returning the schedule's result, to be called
+    inside a full-manual shard_map whose ``axis`` group flattens to
+    ``sched.n_ranks`` ranks. Works for any verified kind whose final
+    state fills every rank (``all_reduce`` in the runtime path); other
+    kinds lower too — the caller decides which slots are meaningful."""
+    if verify_first:
+        verify(sched)
+    import jax
+    import jax.numpy as jnp
+
+    tables = []
+    for step in sched.steps:
+        if step.op == "exchange":
+            perm, send, recv, valid = _exchange_tables(sched, step)
+            tables.append((step.scope, step.combine, perm,
+                           jnp.asarray(send), jnp.asarray(recv),
+                           jnp.asarray(valid)))
+        else:  # copy: per-rank (src, dst) slot moves
+            n, C = sched.n_ranks, sched.n_chunks
+            K = 1
+            by_rank: List[List[Tuple[int, int]]] = [[] for _ in range(n)]
+            for (r, a, b) in step.copies:
+                by_rank[r].append((a, b))
+                K = max(K, len(by_rank[r]))
+            src = np.zeros((n, K), np.int32)
+            dst = np.zeros((n, K), np.int32)
+            valid = np.zeros((n, K), bool)
+            for r, moves in enumerate(by_rank):
+                used = {b for _, b in moves}
+                free = iter(k for k in range(C) if k not in used)
+                for j in range(K):
+                    if j < len(moves):
+                        src[r, j], dst[r, j] = moves[j]
+                        valid[r, j] = True
+                    else:
+                        dst[r, j] = next(free)
+                        src[r, j] = dst[r, j]
+            tables.append((step.scope, "copy", None, jnp.asarray(src),
+                           jnp.asarray(dst), jnp.asarray(valid)))
+
+    C = sched.n_chunks
+
+    def body(v):
+        if v.shape[0] % C:
+            raise ValueError(
+                f"schedule {sched.name!r}: payload of {v.shape[0]} elems "
+                f"does not split into {C} chunks (pad with "
+                f"Schedule.padded_elems first)")
+        r = jax.lax.axis_index(axis)
+        buf = v.reshape(C, v.shape[0] // C)
+        for (scope, combine, perm, send_t, recv_t, valid_t) in tables:
+            with jax.named_scope(scope):
+                sidx = jnp.take(send_t, r, axis=0)
+                didx = jnp.take(recv_t, r, axis=0)
+                ok = jnp.take(valid_t, r, axis=0)[:, None]
+                cur = jnp.take(buf, didx, axis=0)
+                if combine == "copy":
+                    moved = jnp.take(buf, sidx, axis=0)
+                else:
+                    payload = jnp.take(buf, sidx, axis=0)
+                    recv = jax.lax.ppermute(payload, axis, perm)
+                    moved = (recv + cur) if combine == "add" else recv
+                buf = buf.at[didx].set(jnp.where(ok, moved, cur))
+        return buf.reshape(-1)
+
+    return body

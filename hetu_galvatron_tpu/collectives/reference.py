@@ -1,0 +1,93 @@
+"""The canonical HAND-BUILT collective bodies.
+
+These are the two explicit algorithm-shaped all-reduce programs the
+repo has carried since PR 13 in
+``core/profiler/hardware_profiler._algo_allreduce_ms`` — the ring
+(reduce-scatter ring then all-gather ring) and recursive
+halving-doubling. They live here now so the profiler and the
+bit-parity contract share ONE implementation: the emitter's lowering of
+the *synthesized* ring / halving-doubling schedules is pinned
+bit-identical to these bodies (same hop order, same add association —
+IEEE addition is commutative, so only the association tree matters).
+
+``axis`` may be one axis name or a tuple of names (ppermute and
+axis_index both flatten a tuple row-major, which is how the emitted
+programs run over the regrouped ``(HIER_SLICE_AXIS, HIER_HOST_AXIS)``
+dp group).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+Axis = Union[str, Tuple[str, ...]]
+
+
+def handbuilt_allreduce_body(alg: str, n: int,
+                             axis: Axis) -> Callable:
+    """The hand-built all-reduce body for ``alg`` over an ``n``-rank
+    group on ``axis``: a function of one flat per-device vector (length
+    divisible by ``n`` for ring, by 2 per halving round for tree),
+    returning the group sum — to be called inside a full-manual
+    shard_map over ``axis``."""
+    if n < 2 or (n & (n - 1)):
+        raise ValueError(f"algorithm schedules need a power-of-two "
+                         f"group, got {n}")
+
+    if alg == "ring":
+        def body(v):
+            r = jax.lax.axis_index(axis)
+            c = v.shape[0] // n
+            chunks = v.reshape(n, c)
+            perm = [(i, (i + 1) % n) for i in range(n)]
+            # reduce-scatter ring: the accumulator for chunk k starts
+            # at rank (k+1)%n and collects each rank's share en route
+            acc = None
+            for t in range(n):
+                k = (r - 1 - t) % n
+                part = jnp.take(chunks, k, axis=0)
+                acc = part if acc is None else (
+                    jax.lax.ppermute(acc, axis, perm) + part)
+            # all-gather ring: rotate the owned chunk n-1 hops
+            out = jnp.zeros((n, c), v.dtype)
+            cur = acc
+            for t in range(n):
+                k = (r - t) % n
+                out = jax.lax.dynamic_update_index_in_dim(out, cur, k, 0)
+                if t < n - 1:
+                    cur = jax.lax.ppermute(cur, axis, perm)
+            return out.reshape(-1)
+        return body
+
+    if alg == "tree":
+        rounds = n.bit_length() - 1
+
+        def body(v):
+            r = jax.lax.axis_index(axis)
+            cur = v
+            # recursive halving reduce-scatter: round k exchanges half
+            # the live payload with the rank at distance 2^k
+            for k in range(rounds):
+                perm = [(i, i ^ (1 << k)) for i in range(n)]
+                half = cur.shape[0] // 2
+                bit = (r >> k) & 1
+                lo, hi = cur[:half], cur[half:]
+                send = jnp.where(bit == 0, hi, lo)
+                recv = jax.lax.ppermute(send, axis, perm)
+                cur = jnp.where(bit == 0, lo, hi) + recv
+            # recursive doubling all-gather: reverse rounds, payload
+            # doubling back to full size
+            for k in range(rounds - 1, -1, -1):
+                perm = [(i, i ^ (1 << k)) for i in range(n)]
+                bit = (r >> k) & 1
+                recv = jax.lax.ppermute(cur, axis, perm)
+                cur = jnp.where(bit == 0,
+                                jnp.concatenate([cur, recv]),
+                                jnp.concatenate([recv, cur]))
+            return cur
+        return body
+
+    raise ValueError(f"unknown collective algorithm {alg!r} (ring | tree)")

@@ -207,6 +207,16 @@ class ParallelArgs(BaseModel):
     # nonzero); results are bit-consistent across bucket sizes (each
     # element rides the same three-collective association)
     hier_bucket_mb: float = 0.0
+    # synthesized collective schedule for the hierarchical dp reduction
+    # (collectives/: "ring", "tree_hd", "tree_bcast", "torus2d",
+    # "hier_rings", or the "*_handbuilt" reference bodies): the reduction
+    # executes through the verified emitted program instead of the
+    # hand-implemented three-stage path. "" (default) = hand-implemented;
+    # a searched plan may carry "dp_schedule" (parallel setting wins when
+    # nonempty). Inexpressible combinations (pp > 1, bucketed pipelining,
+    # non-power-of-two lanes for the tree families) fall back with a
+    # logged reason — eligibility.dp_schedule_unsupported_reason
+    dp_schedule: str = ""
 
     @model_validator(mode="after")
     def _check(self):
@@ -406,6 +416,14 @@ class ObservabilityArgs(BaseModel):
     # full regression; below it a prior-anchored scale calibration (or
     # nothing, with no prior) is used instead
     calibration_min_points: int = 4
+    # residual-store decay: drop accumulated points older than this many
+    # days at load time (hardware changes age out of the posterior
+    # instead of anchoring it forever). 0 = keep everything
+    calibration_window_days: float = 0.0
+    # residual-store windowing: keep at most this many NEWEST points per
+    # curve key (bounds residuals.jsonl growth across long fleets).
+    # 0 = unlimited
+    calibration_max_points: int = 0
     # plan-regret sentinel alarm threshold, as a fraction of the
     # incumbent's adjusted step time: a plan_regret event fires when a
     # stored runner-up, re-priced under the calibrated curves, beats the
@@ -647,6 +665,13 @@ class SearchArgs(BaseModel):
     time_profiling_path: Optional[str] = None
     memory_profiling_path: Optional[str] = None
     allreduce_bandwidth_config_path: Optional[str] = None
+    # auto-feed the calibration loop's posterior
+    # (observability.calibration_dir/calibrated_profile.json) into the
+    # search: when the calibrated profile exists and its fingerprint
+    # matches this search's hardware/model key, it is preferred over
+    # allreduce_bandwidth_config_path with a logged provenance line.
+    # 0 opts out (profiled-priors-only, the pre-PR-16 behaviour)
+    use_calibrated: int = 1
     p2p_bandwidth_config_path: Optional[str] = None
     overlap_coe_path: Optional[str] = None
     sp_time_path: Optional[str] = None

@@ -343,6 +343,54 @@ def hier_dp_reduce_ms(s: "SearchStrategy", ctx: CostContext,
     return total
 
 
+def dp_schedule_rankings(s: "SearchStrategy", ctx: CostContext,
+                         grad_mb: float) -> Dict[str, float]:
+    """α-β prices (ms) of every synthesizable dp-schedule family for this
+    layer's dp group at ``grad_mb`` payload — the collective compiler's
+    search hook. The families come from
+    ``collectives.synthesize.synthesize_space`` (ring, halving-doubling,
+    latency-optimal tree broadcast, 2D torus, hierarchical rings — what
+    the shape admits), priced by ``collectives.pricing`` over per-LINK
+    curves inverted out of the profiled per-algorithm ring fits
+    (``ctx.alpha_beta_algos``); min-over-curves, so a family a missing
+    curve cannot price is simply absent. Empty when the plan is not
+    hierarchically expressible or the profile carries no algorithm
+    curves — the caller then records no schedule and the legacy pricing
+    is untouched (the golden-search pins rely on that)."""
+    if not search_hier_dp_expressible(s, ctx.hier_dp):
+        return {}
+    split = _hier_dp_split(s, ctx)
+    if split is None or s.dp < 2:
+        return {}
+    cross, intra = split
+    from hetu_galvatron_tpu.collectives.pricing import (
+        link_curves_from_algos,
+        price_space,
+    )
+    from hetu_galvatron_tpu.collectives.synthesize import synthesize_space
+
+    curves = link_curves_from_algos(
+        ctx.alpha_beta_algos, intra if cross > 1 else s.dp, cross)
+    if not curves:
+        return {}
+    return price_space(synthesize_space(s.dp, cross=cross), grad_mb,
+                       curves)
+
+
+def dp_schedule_choice(s: "SearchStrategy", ctx: CostContext,
+                       grad_mb: float
+                       ) -> Optional[Tuple[str, Dict[str, float]]]:
+    """(winning family name, full rankings) for the plan record, or None
+    when nothing priced. The winner is informational — it names the
+    emitted program the runtime should execute (plan JSON
+    ``dp_schedule``) — and deliberately does NOT perturb the plan's
+    predicted time, so legacy profiles price byte-identically."""
+    ranks = dp_schedule_rankings(s, ctx, grad_mb)
+    if not ranks:
+        return None
+    return min(ranks, key=ranks.get), ranks
+
+
 def _tp_terms(s: "SearchStrategy", ctx: CostContext, gbsz: int, chunks: int
               ) -> Tuple[float, float, float]:
     """Shared per-layer (fct, bct, tp_time) arithmetic — consumed by both

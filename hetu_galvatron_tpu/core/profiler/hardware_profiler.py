@@ -330,7 +330,13 @@ class HardwareProfiler:
 
         The two schedules have materially different (α, β) regimes; the
         fitted pairs let the cost model price each collective as the MIN
-        over algorithms at its message size and level."""
+        over algorithms at its message size and level. The bodies are the
+        canonical hand-built programs in ``collectives.reference`` — the
+        collective compiler's emitted ring / halving-doubling schedules
+        are pinned bit-identical to them."""
+        from hetu_galvatron_tpu.collectives.reference import (
+            handbuilt_allreduce_body,
+        )
         n = len(group)
         if n < 2 or (n & (n - 1)):
             raise ValueError(f"algorithm schedules need a power-of-two "
@@ -342,59 +348,7 @@ class HardwareProfiler:
                            NamedSharding(mesh, P(None)))
         from jax.experimental.shard_map import shard_map
 
-        if alg == "ring":
-            def body(v):
-                r = jax.lax.axis_index(_G_AXIS)
-                c = elems // n
-                chunks = v.reshape(n, c)
-                perm = [(i, (i + 1) % n) for i in range(n)]
-                # reduce-scatter ring: the accumulator for chunk k starts
-                # at rank (k+1)%n and collects each rank's share en route
-                acc = None
-                for t in range(n):
-                    k = (r - 1 - t) % n
-                    part = jnp.take(chunks, k, axis=0)
-                    acc = part if acc is None else (
-                        jax.lax.ppermute(acc, _G_AXIS, perm) + part)
-                # all-gather ring: rotate the owned chunk n-1 hops
-                out = jnp.zeros((n, c), jnp.float32)
-                cur = acc
-                for t in range(n):
-                    k = (r - t) % n
-                    out = jax.lax.dynamic_update_index_in_dim(
-                        out, cur, k, 0)
-                    if t < n - 1:
-                        cur = jax.lax.ppermute(cur, _G_AXIS, perm)
-                return out.reshape(-1)
-        elif alg == "tree":
-            rounds = n.bit_length() - 1
-
-            def body(v):
-                r = jax.lax.axis_index(_G_AXIS)
-                cur = v
-                # recursive halving reduce-scatter: round k exchanges
-                # half the live payload with the rank at distance 2^k
-                for k in range(rounds):
-                    perm = [(i, i ^ (1 << k)) for i in range(n)]
-                    half = cur.shape[0] // 2
-                    bit = (r >> k) & 1
-                    lo, hi = cur[:half], cur[half:]
-                    send = jnp.where(bit == 0, hi, lo)
-                    recv = jax.lax.ppermute(send, _G_AXIS, perm)
-                    cur = jnp.where(bit == 0, lo, hi) + recv
-                # recursive doubling all-gather: reverse rounds, payload
-                # doubling back to full size
-                for k in range(rounds - 1, -1, -1):
-                    perm = [(i, i ^ (1 << k)) for i in range(n)]
-                    bit = (r >> k) & 1
-                    recv = jax.lax.ppermute(cur, _G_AXIS, perm)
-                    cur = jnp.where(bit == 0,
-                                    jnp.concatenate([cur, recv]),
-                                    jnp.concatenate([recv, cur]))
-                return cur
-        else:
-            raise ValueError(f"unknown collective algorithm {alg!r} "
-                             "(ring | tree)")
+        body = handbuilt_allreduce_body(alg, n, _G_AXIS)
         fn = jax.jit(shard_map(body, mesh=mesh, in_specs=P(None),
                                out_specs=P(None), check_rep=False))
         return _time_fn(fn, x, warmup=self.args.warmup_iters,

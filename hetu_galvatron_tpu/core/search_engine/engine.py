@@ -763,8 +763,11 @@ class SearchEngine:
         # runtime then enables the matching ops/hier_reduce.py path
         hier_chosen = False
         hier_bucket = 0.0
+        dp_sched_name = None
+        dp_sched_ranks = None
         if self.args.hier_dp:
             from hetu_galvatron_tpu.core.cost_model.cost import (
+                dp_schedule_choice,
                 hier_dp_best_bucket,
                 hier_dp_wins,
                 hier_grad_payload_mb,
@@ -792,6 +795,19 @@ class SearchEngine:
                         s0, ctx0, hier_grad_payload_mb(s0, ctx0))
                 else:
                     hier_bucket = max(ctx0.hier_bucket_mb, 0.0)
+                # collective-compiler record: price the synthesized
+                # schedule space for the winning plan's dp group and name
+                # the cheapest family (cost.dp_schedule_choice). The
+                # emitted programs are monolithic, so a bucketed plan
+                # keeps the hand-implemented pipelined path instead.
+                if hier_bucket == 0.0:
+                    choice = dp_schedule_choice(
+                        s0, ctx0, hier_grad_payload_mb(s0, ctx0))
+                    if choice is not None:
+                        dp_sched_name, ranks = choice
+                        dp_sched_ranks = {
+                            k: round(v, 6) for k, v in sorted(
+                                ranks.items(), key=lambda kv: kv[1])}
         cfg = strategy_list2config(
             runtime, global_bsz=best.bsz, chunks=best.chunks,
             pipeline_type=self.pipeline_type,
@@ -802,7 +818,12 @@ class SearchEngine:
             pp_division=best.pp_stage_list,
             num_encoder_layers=getattr(self, "num_encoder_layers", None),
             predicted_layer_compute_ms=pred_ms,
-            hier_dp=hier_chosen, hier_bucket_mb=hier_bucket)
+            hier_dp=hier_chosen, hier_bucket_mb=hier_bucket,
+            dp_schedule=dp_sched_name)
+        if dp_sched_ranks:
+            # the full priced space rides along (cheapest first) so plan
+            # readers can see HOW the family won, not just that it did
+            cfg["dp_schedule_rankings"] = dp_sched_ranks
         if best.time_cost != float("inf"):
             cfg["predicted_time_cost_ms"] = round(best.time_cost * 1e3, 6)
         if runner_ups:

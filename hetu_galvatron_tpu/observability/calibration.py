@@ -373,6 +373,47 @@ def _robust_fit(pts: List[Tuple[float, float, float]], *, outlier_k: float,
     return fit, len(pts)
 
 
+def window_points(points: Sequence[Dict[str, Any]], *,
+                  window_days: float = 0.0,
+                  max_points_per_curve: int = 0,
+                  now: Optional[float] = None) -> List[Dict[str, Any]]:
+    """Decay + window the residual store before a re-fit.
+
+    ``window_days > 0`` drops points whose wall timestamp (``t``, stamped
+    by :meth:`ResidualStore.append`) is older than that many days —
+    hardware or software changes age out of the posterior instead of
+    anchoring it forever. Points carrying no timestamp are of unknown
+    age and are dropped too under an active window (legacy pre-timestamp
+    lines; keeping them would defeat the decay).
+
+    ``max_points_per_curve > 0`` then keeps only that many NEWEST points
+    per ``(group, alg)`` curve key, bounding both the fit cost and the
+    influence of any one flood of appends. 0 disables either limit;
+    the default is the historical keep-everything behaviour."""
+    pts = [p for p in points if isinstance(p, dict)]
+    if window_days > 0:
+        cutoff = (now if now is not None else time.time()) \
+            - window_days * 86400.0
+        pts = [p for p in pts
+               if isinstance(p.get("t"), (int, float))
+               and float(p["t"]) >= cutoff]
+    if max_points_per_curve > 0:
+        by_curve: Dict[Tuple[str, str], List[Dict[str, Any]]] = {}
+        for p in pts:
+            key = (str(p.get("group", "")), str(p.get("alg") or "flat"))
+            by_curve.setdefault(key, []).append(p)
+        keep = set()
+        for recs in by_curve.values():
+            newest = sorted(
+                recs,
+                key=lambda p: float(p["t"]) if isinstance(
+                    p.get("t"), (int, float)) else float("-inf"),
+            )[-max_points_per_curve:]
+            keep.update(id(p) for p in newest)
+        pts = [p for p in pts if id(p) in keep]
+    return pts
+
+
 def refit_profile(points: Sequence[Dict[str, Any]], *,
                   prior: Optional[Dict[str, Any]] = None,
                   min_points: int = 4, min_rel_spread: float = 0.05,
@@ -608,6 +649,8 @@ def run_calibration(
     world: Optional[int] = None,
     device_kind: Optional[str] = None,
     min_points: int = 4,
+    window_days: float = 0.0,
+    max_points_per_curve: int = 0,
     regret_threshold: float = 0.05,
     plan_path: Optional[str] = None,
     mixed_precision: bool = True,
@@ -645,7 +688,11 @@ def run_calibration(
         out["points_appended"] = store.append(pts, fingerprint=fp,
                                               run_id=run_id)
         all_pts = store.load(fingerprint=fp)
+        loaded = len(all_pts)
+        all_pts = window_points(all_pts, window_days=window_days,
+                                max_points_per_curve=max_points_per_curve)
         out["points_total"] = len(all_pts)
+        out["points_windowed_out"] = loaded - len(all_pts)
 
         prior_cfg: Optional[Dict[str, Any]] = None
         if prior_config:

@@ -377,6 +377,31 @@ def _hier_payload_elems_from_plan(hpc, model, *, cross: int
     return local, padded, intra
 
 
+def _dp_schedule_from_plan(name: str, lanes: int, cross: int,
+                           bucket_mb: float):
+    """Verified :class:`~hetu_galvatron_tpu.collectives.ir.Schedule` the
+    runtime reducer would execute for ``dp_schedule=name`` — the shared
+    count/byte prediction source. Hand-built reference backends
+    (``*_handbuilt``) predict through their emitted twin: the reference
+    bodies are pinned bit- and byte-identical to the emitted programs
+    (same hop count, same per-hop payload), so one schedule prices
+    both."""
+    from hetu_galvatron_tpu.analysis.eligibility import (
+        dp_schedule_unsupported_reason,
+    )
+    from hetu_galvatron_tpu.collectives.synthesize import (
+        synthesize_dp_schedule,
+    )
+    from hetu_galvatron_tpu.collectives.verify import verify
+
+    reason = dp_schedule_unsupported_reason(name, lanes, cross, bucket_mb)
+    if reason is not None:
+        raise ValueError(f"dp schedule unsupported: {reason}")
+    fam = {"ring_handbuilt": "ring",
+           "tree_handbuilt": "tree_hd"}.get(name, name)
+    return verify(synthesize_dp_schedule(fam, lanes, cross))
+
+
 def plan_collective_counts(
     hpc,
     model,
@@ -386,6 +411,7 @@ def plan_collective_counts(
     hier_dp: bool = False,
     hier_bucket_mb: float = 0.0,
     hier_cross: int = 1,
+    dp_schedule: Optional[str] = None,
 ) -> Dict[str, int]:
     """Predicted EXECUTED explicit-collective counts for the compiled
     single-program 1F1B step — the count-side companion of
@@ -421,6 +447,12 @@ def plan_collective_counts(
     (``hier_cross`` fixes the slice/host split, as in
     :func:`plan_collective_bytes`); pp > 1 engines predict from their
     own reducer's ``bucket_layout``.
+
+    ``dp_schedule`` (with ``hier_dp=True``) predicts the synthesized
+    collective-compiler backend instead: the rs/ar/ag triple is replaced
+    by ``ppermute_dp`` — one count per exchange step of the verified
+    schedule (``collectives.synthesize`` + ``Schedule.n_exchanges``),
+    which the census matches under the ``dp_sched`` scope marker.
 
     Raises ValueError for plan shapes the prediction does not model
     (non-uniform strategies, Ulysses/cp layers — the census still counts
@@ -458,6 +490,11 @@ def plan_collective_counts(
         if s.dp_size < 2:
             raise ValueError("hier_dp prediction needs dp > 1 "
                              "(eligibility.hier_dp_unsupported_reason)")
+        if dp_schedule:
+            sched = _dp_schedule_from_plan(
+                dp_schedule, s.dp_size, hier_cross, hier_bucket_mb)
+            out["ppermute_dp"] = sched.n_exchanges
+            return out
         n_buckets = 1
         if hier_bucket_mb > 0:
             from hetu_galvatron_tpu.ops.hier_reduce import (
@@ -484,6 +521,7 @@ def plan_collective_bytes(
     hier_dp: bool = False,
     hier_cross: int = 1,
     hier_bucket_mb: float = 0.0,
+    dp_schedule: Optional[str] = None,
 ) -> Dict[str, float]:
     """Predicted per-device EXECUTED explicit-collective megabytes for the
     compiled single-program 1F1B step — the byte-side companion of
@@ -558,6 +596,21 @@ def plan_collective_bytes(
 
         local, _, intra = _hier_payload_elems_from_plan(
             hpc, model, cross=hier_cross)
+        if dp_schedule:
+            # synthesized-schedule path: every exchange step is one
+            # ppermute whose traced input aval is [K, c] on EVERY rank
+            # (uniform SPMD tables; K = the step's widest transfer, c =
+            # the chunk size after the emitter's pad to n_chunks) — so
+            # the flow pass's summed input megabytes are Σ_steps K·c·4.
+            # The hand-built reference bodies move the identical per-hop
+            # payloads (that is the byte half of the parity contract).
+            sched = _dp_schedule_from_plan(
+                dp_schedule, s.dp_size, hier_cross, hier_bucket_mb)
+            c = sched.chunk_elems(local)
+            sent = sum(sched.step_max_chunks_sent(st)
+                       for st in sched.steps if st.op == "exchange")
+            out["ppermute_dp"] = sent * c * 4 / MB
+            return out
         layout = hier_bucket_layout(local, intra, hier_bucket_mb)
         out["reduce_scatter"] = sum(p for _, p in layout) * 4 / MB
         out["all_reduce"] = sum(p // intra for _, p in layout) * 4 / MB

@@ -159,6 +159,9 @@ _PERMUTE_MARKERS: Tuple[Tuple[str, str], ...] = (
     ("tp_ring", "permute_tp"),
     ("cp_ring", "permute_cp"),
     ("pp_rotate", "permute_pp"),
+    # synthesized dp gradient schedules (collectives/emit.py scopes all
+    # start with dp_sched_): every hop is dp traffic
+    ("dp_sched", "permute_dp"),
 )
 # hierarchical dp reduction markers (ops/hier_reduce.py scopes): the three
 # collectives bill to the dp component — without the markers, the
@@ -724,7 +727,7 @@ def measured_components(attr: Attribution, hpc: Any) -> Dict[str, float]:
     # hierarchical dp reduction (marker-billed in attribute()): all three
     # collectives are dp traffic regardless of the ag/rs heuristics above
     add("dp", cat.get("hier_rs", 0.0) + cat.get("hier_ar", 0.0)
-        + cat.get("hier_ag", 0.0))
+        + cat.get("hier_ag", 0.0) + cat.get("permute_dp", 0.0))
     add(permute_to, cat.get("permute", 0.0) + cat.get("p2p", 0.0)
         + cat.get("broadcast", 0.0))
     return out

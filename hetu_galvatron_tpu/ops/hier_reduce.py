@@ -242,6 +242,14 @@ class HierDpReducer:
     # DCN stage of bucket i overlaps the ICI stages of its neighbours.
     # 0 = one monolithic bucket (byte-identical to the pre-bucket program)
     bucket_mb: float = 0.0
+    # collective-compiler backend (collectives/): a schedule family name
+    # ("ring" | "tree_hd" | "tree_bcast" | "torus2d" | "hier_rings")
+    # synthesized for the dp group, statically verified, and emitted as
+    # the reduction program in place of the hand-implemented
+    # psum_scatter/psum/all_gather — or a hand-built reference body
+    # ("ring_handbuilt" | "tree_handbuilt", collectives/reference.py)
+    # for the bit-parity drills. None = the hand-implemented schedule.
+    schedule: Optional[str] = None
 
     def __post_init__(self):
         self.lanes = axes_size(self.mesh, self.dp_axes)
@@ -250,6 +258,47 @@ class HierDpReducer:
                 f"cross {self.cross} x intra {self.intra} != dp degree "
                 f"{self.lanes}")
         self.hmesh = hier_submesh(self.mesh, self.dp_axes, self.cross)
+        self._sched_body = None
+        self._sched = None
+        if self.schedule:
+            from hetu_galvatron_tpu.analysis.eligibility import (
+                dp_schedule_unsupported_reason,
+            )
+
+            reason = dp_schedule_unsupported_reason(
+                self.schedule, self.lanes, self.cross, self.bucket_mb)
+            if reason:
+                raise ValueError(f"dp schedule unsupported: {reason}")
+            axis = (HIER_SLICE_AXIS, HIER_HOST_AXIS)
+            if self.schedule.endswith("_handbuilt"):
+                from hetu_galvatron_tpu.collectives.reference import (
+                    handbuilt_allreduce_body,
+                )
+
+                alg = self.schedule.split("_")[0]
+                inner = handbuilt_allreduce_body(alg, self.lanes, axis)
+                scope = f"dp_sched_handbuilt_{alg}"
+
+                def body(v, _inner=inner, _scope=scope):
+                    with jax.named_scope(_scope):
+                        return _inner(v)
+
+                self._sched_body = body
+                self._sched_chunks = self.lanes
+            else:
+                from hetu_galvatron_tpu.collectives.emit import (
+                    emit_allreduce_body,
+                )
+                from hetu_galvatron_tpu.collectives.synthesize import (
+                    synthesize_dp_schedule,
+                )
+                from hetu_galvatron_tpu.collectives.verify import verify
+
+                self._sched = verify(synthesize_dp_schedule(
+                    self.schedule, self.lanes, self.cross))
+                self._sched_body = emit_allreduce_body(
+                    self._sched, axis, verify_first=False)
+                self._sched_chunks = self._sched.n_chunks
         leaves, self._treedef = jax.tree_util.tree_flatten(
             self.specs, is_leaf=lambda x: isinstance(x, P))
         _check_specs_off_lane_axes(leaves, self.dp_axes)
@@ -336,6 +385,24 @@ class HierDpReducer:
         intra = self.intra
         flats = [b[0].reshape(-1).astype(jnp.float32) for b in blocks]
         sizes = [f.size for f in flats]
+        if self._sched_body is not None:
+            # collective-compiler path: ONE payload padded to a whole
+            # number of schedule chunks, reduced by the emitted (or
+            # hand-built reference) all-reduce program
+            v = jnp.concatenate(flats) if len(flats) > 1 else flats[0]
+            local = v.shape[0]
+            C = self._sched_chunks
+            padded = -(-local // C) * C
+            if padded != local:
+                v = jnp.pad(v, (0, padded - local))
+            g = self._sched_body(v)[:local]
+            outs = []
+            off = 0
+            for b, n in zip(blocks, sizes):
+                outs.append(g[off:off + n].reshape(b.shape[1:])
+                            .astype(b.dtype))
+                off += n
+            return tuple(outs)
         layout = hier_bucket_layout(sum(sizes), intra, self.bucket_mb)
         segs = self._bucket_segments(sizes, layout)
         B = len(layout)
@@ -431,6 +498,7 @@ def make_hier_reducer(
     cross: Optional[int] = None,
     specs: Any = None,
     bucket_mb: float = 0.0,
+    schedule: Optional[str] = None,
 ) -> HierDpReducer:
     """Build the reducer for a lowered plan: dp lane axes from the (uniform)
     first decoder layer, the slice/host split from ``dcn_slices`` (pp-first
@@ -450,7 +518,8 @@ def make_hier_reducer(
         specs = grad_reduce_specs(axes_tree, per_layer, vocab)
     return HierDpReducer(mesh=mesh, dp_axes=dp_axes, cross=cross,
                          intra=dp_deg // cross, specs=specs,
-                         batch_spec=sh.batch_spec(), bucket_mb=bucket_mb)
+                         batch_spec=sh.batch_spec(), bucket_mb=bucket_mb,
+                         schedule=schedule)
 
 
 # NOTE: per-lane grad computation is NOT wrapped here on purpose — every

@@ -458,6 +458,7 @@ def make_spmd_train_step(
     hier_dp: bool = False,
     dcn_slices: int = 1,
     hier_bucket_mb: float = 0.0,
+    dp_schedule: Optional[str] = None,
 ):
     """Build the jitted hybrid-parallel train step (no pipeline; pp=1).
 
@@ -474,7 +475,10 @@ def make_spmd_train_step(
     software-pipelining granularity from ``hier_bucket_mb``
     (``parallel.hier_bucket_mb``; 0 = one monolithic bucket); ineligible
     plans raise with the shared eligibility reason (the launcher logs and
-    falls back).
+    falls back). ``dp_schedule`` (``parallel.dp_schedule``, hier_dp only)
+    swaps the hand-implemented rs/ar/ag program for a synthesized,
+    verified, emitted collective schedule (``collectives/``) — the plan
+    JSON records the family the search priced cheapest.
     """
     if hpc.pp_deg != 1:
         raise ValueError("make_spmd_train_step is the pp=1 path; use the "
@@ -511,7 +515,8 @@ def make_spmd_train_step(
 
         hier = make_hier_reducer(mesh, per_layer, vocab, axes_tree,
                                  dcn_slices=dcn_slices,
-                                 bucket_mb=hier_bucket_mb)
+                                 bucket_mb=hier_bucket_mb,
+                                 schedule=dp_schedule or None)
     constrain_mbs = None
     if hier is None and chunks > 1:
         # flat-path microbatch pin (ROADMAP embed-ZeRO-3 BUG, fixed): the

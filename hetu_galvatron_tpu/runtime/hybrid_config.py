@@ -68,6 +68,10 @@ class HybridParallelConfig:
     # ("hier_bucket_mb" in the plan JSON; 0 = monolithic). The runtime
     # buckets at the same size; a nonzero parallel.hier_bucket_mb wins.
     hier_bucket_mb: float = 0.0
+    # Synthesized collective schedule family the search priced the dp
+    # reduction with ("dp_schedule" in the plan JSON; collectives/); None
+    # runs the hand-implemented three-stage hierarchical path.
+    dp_schedule: Optional[str] = None
 
     @property
     def enc_strategies(self) -> List[LayerStrategy]:
@@ -151,6 +155,7 @@ def get_hybrid_parallel_config(
         pred_layer_ms = extras.get("predicted_layer_compute_ms")
         hier_dp = bool(extras.get("hier_dp", False))
         hier_bucket_mb = float(extras.get("hier_bucket_mb", 0.0) or 0.0)
+        dp_schedule = extras.get("dp_schedule") or None
     else:
         pp_deg = par.pp_deg
         r = eligibility.pp_world_reason(world_size, pp_deg)
@@ -186,6 +191,7 @@ def get_hybrid_parallel_config(
         pred_layer_ms = None
         hier_dp = False
         hier_bucket_mb = 0.0
+        dp_schedule = None
 
     # guard both branches (a JSON plan with pp*vpp > layers would otherwise
     # slip through as zero-layer chunks from default_pp_division): the
@@ -223,4 +229,5 @@ def get_hybrid_parallel_config(
         world_size=world_size, num_encoder_layers=n_enc, vpp_deg=vpp,
         cp_zigzag=cp_zigzag, predicted_layer_compute_ms=pred_layer_ms,
         hier_dp=hier_dp, hier_bucket_mb=hier_bucket_mb,
+        dp_schedule=dp_schedule,
     )

@@ -175,6 +175,7 @@ def strategy_list2config(
     predicted_layer_compute_ms: Optional[Sequence[float]] = None,
     hier_dp: Optional[bool] = None,
     hier_bucket_mb: float = 0.0,
+    dp_schedule: Optional[str] = None,
 ) -> Dict[str, Any]:
     """Serialize per-layer strategies to the interchange dict.
 
@@ -254,6 +255,12 @@ def strategy_list2config(
             # ...and pipelined it at this bucket granularity
             # (cost.hier_dp_best_bucket); the runtime buckets identically
             cfg["hier_bucket_mb"] = float(hier_bucket_mb)
+        if dp_schedule:
+            # ...and the synthesized collective schedule family whose α-β
+            # price won the space (cost.dp_schedule_choice over
+            # collectives.synthesize_space); the runtime executes the
+            # reduction through the matching emitted program
+            cfg["dp_schedule"] = str(dp_schedule)
     return cfg
 
 
@@ -394,6 +401,10 @@ def config2strategy(
         # pipelines at the same size unless parallel.hier_bucket_mb
         # overrides
         "hier_bucket_mb": float(cfg.get("hier_bucket_mb", 0.0) or 0.0),
+        # synthesized collective schedule family the search priced the dp
+        # reduction with (collectives/); None = the hand-implemented
+        # three-stage hierarchical path
+        "dp_schedule": str(cfg.get("dp_schedule") or "") or None,
         # optional per-layer compute prediction (see strategy_list2config);
         # a hand-edited plan whose vector no longer matches the layer count
         # is dropped rather than mis-attributed to the wrong layers

@@ -1,0 +1,120 @@
+"""Emitter lowering parity (collectives/emit.py): the full-manual
+shard_map programs emitted from verified schedules must compute the
+group sum — and for the ring / halving-doubling families, must match
+the canonical hand-built bodies (collectives/reference.py)
+BIT-FOR-BIT: same hop order, same add association. This standalone-body
+half of the bit-parity contract runs every family; the end-to-end
+3-step training trajectory rides tests/collectives/
+test_dp_schedule_train.py."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from hetu_galvatron_tpu.collectives.emit import emit_allreduce_body
+from hetu_galvatron_tpu.collectives.reference import (
+    handbuilt_allreduce_body,
+)
+from hetu_galvatron_tpu.collectives.synthesize import (
+    SCOPE_PREFIX,
+    synthesize_dp_schedule,
+    synthesize_space,
+)
+from hetu_galvatron_tpu.collectives.verify import verify
+
+pytestmark = [pytest.mark.collectives, pytest.mark.distributed]
+
+
+def _run_body(body, n, cpu_devices, x):
+    mesh = Mesh(np.asarray(cpu_devices[:n]), ("dp",))
+    fn = jax.jit(shard_map(body, mesh=mesh, in_specs=P("dp"),
+                           out_specs=P("dp"), check_rep=False))
+    return np.asarray(fn(x)).reshape(n, -1)
+
+
+def _payload(n, local=64):
+    return jnp.asarray(np.random.RandomState(7)
+                       .standard_normal(n * local), jnp.float32)
+
+
+@pytest.mark.parametrize("n", [2, 4, 8])
+@pytest.mark.parametrize("fam,ref", [("ring", "ring"),
+                                     ("tree_hd", "tree")])
+def test_emitted_matches_handbuilt_bitwise(cpu_devices, n, fam, ref):
+    """The bit-parity pin: the emitted program IS the hand-built body,
+    hop for hop — byte-equal outputs, not allclose."""
+    sched = verify(synthesize_dp_schedule(fam, n, 1))
+    x = _payload(n)
+    emitted = _run_body(emit_allreduce_body(sched, "dp",
+                                            verify_first=False),
+                        n, cpu_devices, x)
+    hand = _run_body(handbuilt_allreduce_body(ref, n, "dp"),
+                     n, cpu_devices, x)
+    assert np.array_equal(emitted, hand)
+
+
+@pytest.mark.parametrize("fam", ["ring", "tree_hd", "tree_bcast",
+                                 "torus2d", "hier_rings"])
+def test_every_family_computes_the_group_sum(cpu_devices, fam):
+    """Every synthesized family is a correct all-reduce: each rank ends
+    holding the group sum (per-family association trees differ, so this
+    is allclose vs the f64 reference, not bitwise)."""
+    n, cross = 8, (2 if fam == "hier_rings" else 1)
+    sched = verify(synthesize_dp_schedule(fam, n, cross))
+    x = _payload(n)
+    out = _run_body(emit_allreduce_body(sched, "dp", verify_first=False),
+                    n, cpu_devices, x)
+    want = np.asarray(x, np.float64).reshape(n, -1).sum(axis=0)
+    for r in range(n):
+        np.testing.assert_allclose(out[r], want, rtol=1e-5, atol=1e-5)
+
+
+def test_emitted_scopes_carry_the_census_marker():
+    """Every exchange scope starts with the dp_sched marker the census
+    and flow passes substring-match on."""
+    for name, sched in synthesize_space(8, cross=2).items():
+        for st in sched.steps:
+            assert st.scope.startswith(SCOPE_PREFIX), (name, st.scope)
+
+
+def test_emit_refuses_a_broken_schedule():
+    """verify_first=True (the default) re-verifies at emit time, so a
+    schedule mutated AFTER its verify cannot reach hardware."""
+    import dataclasses
+
+    from hetu_galvatron_tpu.collectives.ir import ScheduleError
+
+    sched = synthesize_dp_schedule("ring", 4, 1)
+    broken = dataclasses.replace(
+        sched, steps=(dataclasses.replace(
+            sched.steps[0], xfers=sched.steps[0].xfers[1:]),)
+        + sched.steps[1:])
+    with pytest.raises(ScheduleError):
+        emit_allreduce_body(broken, "dp")
+
+
+def test_emitted_requires_padding_and_padded_prefix_is_exact(cpu_devices):
+    """The emitted body refuses a payload that does not split into the
+    schedule's chunks (the runtime pads via ``Schedule.padded_elems``
+    first, ops/hier_reduce.py); zero-padding caller-side keeps the
+    original prefix exact."""
+    n = 4
+    sched = verify(synthesize_dp_schedule("ring", n, 1))
+    body = emit_allreduce_body(sched, "dp", verify_first=False)
+    with pytest.raises(ValueError, match="does not split"):
+        body(jnp.zeros(13, jnp.float32))
+
+    local = 13  # not divisible by n_chunks
+    padded = sched.padded_elems(local)
+    assert padded % sched.n_chunks == 0 and padded >= local
+    raw = np.random.RandomState(3).standard_normal((n, local))
+    x = jnp.asarray(np.pad(raw, ((0, 0), (0, padded - local)))
+                    .reshape(-1), jnp.float32)
+    out = _run_body(body, n, cpu_devices, x)
+    want = raw.sum(axis=0)
+    np.testing.assert_allclose(out[0][:local], want, rtol=1e-5,
+                               atol=1e-5)

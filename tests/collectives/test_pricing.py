@@ -1,0 +1,158 @@
+"""α-β schedule pricing (collectives/pricing.py): ring-fit inversion
+exactness, hop-distance-aware ICI billing, the small/large-payload plan
+flip the search keys on, and min-over-curves never inventing a price
+for a family missing a link curve."""
+
+import pytest
+
+from hetu_galvatron_tpu.collectives.pricing import (
+    invert_ring_fit,
+    link_curves_from_algos,
+    price_schedule_ms,
+    price_space,
+)
+from hetu_galvatron_tpu.collectives.synthesize import (
+    halving_doubling_all_reduce,
+    hier_all_reduce,
+    ring_all_reduce,
+    synthesize_space,
+    torus2d_all_reduce,
+)
+
+pytestmark = [pytest.mark.collectives]
+
+A_FIT, B_FIT = 0.05, 10.0
+
+
+def _ici(m):
+    return {"ici": invert_ring_fit(A_FIT, B_FIT, m)}
+
+
+# ---------------------------------------------------------------------------
+# ring-fit inversion
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("m", [2, 4, 8, 16])
+@pytest.mark.parametrize("mb", [0.001, 1.0, 64.0])
+def test_inversion_reproduces_fit_on_ring(m, mb):
+    """Pricing the ring schedule with the link curve inverted from its
+    own fitted (α, β) must give back α + mb/β — the inversion and the
+    pricer are inverses on the schedule shape the fit measured."""
+    got = price_schedule_ms(ring_all_reduce(m), mb, _ici(m))
+    want = A_FIT + mb / B_FIT
+    assert got == pytest.approx(want, rel=1e-9)
+
+
+def test_inversion_rejects_degenerate_group():
+    with pytest.raises(ValueError):
+        invert_ring_fit(A_FIT, B_FIT, 1)
+
+
+# ---------------------------------------------------------------------------
+# hop-distance billing (Schedule.topo)
+# ---------------------------------------------------------------------------
+
+
+def test_ring_hops_are_all_distance_one():
+    s = ring_all_reduce(8)
+    for st in s.steps:
+        for x in st.xfers:
+            assert s.hop_distance(x.src, x.dst) == 1
+
+
+def test_halving_doubling_bills_stride_hops():
+    """The stride-2^k exchange travels 2^k nearest-neighbour links on
+    the 1D torus, so the tree's bandwidth term must grow with payload
+    faster than the ring's — hop-distance billing is what keeps the
+    ring bandwidth-optimal at bulk."""
+    s = halving_doubling_all_reduce(8)
+    dists = sorted({s.hop_distance(x.src, x.dst)
+                    for st in s.steps for x in st.xfers})
+    assert dists == [1, 2, 4]
+    ring_bulk = price_schedule_ms(ring_all_reduce(8), 64.0, _ici(8))
+    tree_bulk = price_schedule_ms(s, 64.0, _ici(8))
+    assert tree_bulk > ring_bulk
+
+
+def test_torus2d_topo_wraps_both_dims():
+    s = torus2d_all_reduce(2, 4)
+    assert s.topo == (2, 4)
+    # neighbours along each torus dim are one hop, wrap included
+    assert s.hop_distance(0, 1) == 1      # same row, col 0 -> 1
+    assert s.hop_distance(0, 3) == 1      # col wrap 0 -> 3
+    assert s.hop_distance(0, 4) == 1      # row 0 -> 1, same col
+    assert s.hop_distance(1, 6) == 2      # row hop + col hop
+
+
+def test_dcn_is_switch_routed_distance_free():
+    """Cross-slice steps bill chunks only — the DCN seam is a switch,
+    not a torus, so there is no hop multiplier to pay."""
+    s = hier_all_reduce(4, 2)
+    curves = {"ici": invert_ring_fit(A_FIT, B_FIT, 2),
+              "dcn": invert_ring_fit(0.5, 1.0, 4)}
+    assert price_schedule_ms(s, 8.0, curves) > 0
+
+
+# ---------------------------------------------------------------------------
+# the plan flip + space pricing
+# ---------------------------------------------------------------------------
+
+
+def test_space_prices_at_least_four_families():
+    prices = price_space(synthesize_space(8), 1.0, _ici(8))
+    assert len(prices) >= 4
+    assert all(v > 0 for v in prices.values())
+
+
+def test_plan_flip_tree_wins_only_small_payloads():
+    """The pinned regime flip: α-dominated tiny gradients go to a tree
+    family, bandwidth-dominated bulk to ring/torus — and never the
+    other way around."""
+    space = synthesize_space(8)
+    tiny = price_space(space, 0.0005, _ici(8))
+    bulk = price_space(space, 64.0, _ici(8))
+    assert min(tiny, key=tiny.get) in ("tree_hd", "tree_bcast")
+    assert min(bulk, key=bulk.get) in ("ring", "torus2d")
+    assert min(bulk, key=bulk.get) not in ("tree_hd", "tree_bcast")
+
+
+def test_missing_curve_drops_family_not_invents_price():
+    """min-over-curves never guesses: the 4x2 hierarchical space priced
+    with only a dcn curve keeps the (all-dcn-seam) flat ring and drops
+    the trees that also need ici."""
+    space = synthesize_space(8, cross=2)
+    dcn_only = price_space(space, 8.0,
+                           {"dcn": invert_ring_fit(0.5, 1.0, 2)})
+    assert "ring" in dcn_only
+    assert "tree_hd" not in dcn_only and "hier_rings" not in dcn_only
+    both = price_space(space, 8.0,
+                       {"ici": invert_ring_fit(A_FIT, B_FIT, 4),
+                        "dcn": invert_ring_fit(0.5, 1.0, 2)})
+    assert set(both) == set(space)
+
+
+# ---------------------------------------------------------------------------
+# curve extraction from the profiled per-algorithm tables
+# ---------------------------------------------------------------------------
+
+
+def test_link_curves_prefer_exact_size_else_nearest():
+    algos = {"8_1": {"ring_ici": (0.8, 8.0)},
+             "4_1": {"ring_ici": (0.4, 4.0)},
+             "2_0": {"ring_dcn": (2.0, 1.0)}}
+    curves = link_curves_from_algos(algos, 8, 2)
+    assert curves["ici"] == invert_ring_fit(0.8, 8.0, 8)
+    assert curves["dcn"] == invert_ring_fit(2.0, 1.0, 2)
+    # no size-6 fit: the nearest profiled ring size is inverted instead
+    near = link_curves_from_algos(algos, 6, 1)
+    assert near["ici"] == invert_ring_fit(0.8, 8.0, 8)
+
+
+def test_link_curves_empty_for_legacy_profiles():
+    """Legacy profiles (no per-algorithm curves) must yield NO link
+    curves — which is what keeps every golden search byte-identical:
+    no curves, no rankings, no plan-JSON key."""
+    assert link_curves_from_algos({}, 8, 1) == {}
+    assert link_curves_from_algos({"8_1": {"tree_ici": (1, 1)}}, 8, 1) \
+        == {}

@@ -1,0 +1,192 @@
+"""The broken-schedule corpus: mutate healthy synthesized schedules along
+every axis the static verifier (collectives/verify.py) promises to
+police, and pin that each mutation is rejected with a ``ScheduleError``
+whose diagnostic NAMES the offending step (or the schedule and the
+rank/chunk for the whole-program completeness checks) — never a bare
+traceback out of the simulator."""
+
+import dataclasses
+
+import pytest
+
+from hetu_galvatron_tpu.collectives.ir import ScheduleError, Step, Xfer
+from hetu_galvatron_tpu.collectives.synthesize import (
+    hier_all_reduce,
+    ring_all_gather,
+    ring_all_reduce,
+    synthesize_space,
+)
+from hetu_galvatron_tpu.collectives.verify import verify
+
+pytestmark = [pytest.mark.collectives]
+
+
+def _mutate_step(sched, i, **fields):
+    steps = list(sched.steps)
+    steps[i] = dataclasses.replace(steps[i], **fields)
+    return dataclasses.replace(sched, steps=tuple(steps))
+
+
+def _reject(sched, *needles):
+    """The mutation must raise ScheduleError (and ONLY ScheduleError —
+    a KeyError/IndexError escaping the simulator is a verifier bug)
+    carrying every expected diagnostic fragment."""
+    with pytest.raises(ScheduleError) as exc:
+        verify(sched)
+    msg = str(exc.value)
+    for needle in needles:
+        assert needle in msg, f"diagnostic {msg!r} lacks {needle!r}"
+    return msg
+
+
+# ---------------------------------------------------------------------------
+# the corpus: one mutation per verifier axis
+# ---------------------------------------------------------------------------
+
+
+def test_dropped_transfer_breaks_completeness():
+    """1. Drop one transfer: some rank never receives a contribution —
+    the final-state check names the starved rank and chunk."""
+    s = ring_all_reduce(4)
+    step0 = s.steps[0]
+    broken = _mutate_step(s, 0, xfers=step0.xfers[1:])
+    _reject(broken, "incomplete all_reduce", "missing the contribution")
+
+
+def test_duplicate_reduction_rejected():
+    """2. Replay an add exchange: the same original contribution is
+    summed twice — the sum is silently wrong, the verifier is not."""
+    s = ring_all_reduce(4)
+    dup = s.steps[0]
+    steps = (s.steps[0], dataclasses.replace(dup, slot=dup.slot),) \
+        + s.steps[1:]
+    broken = dataclasses.replace(s, steps=steps)
+    _reject(broken, "step 1", "duplicate reduction")
+
+
+def test_ici_tag_on_cross_slice_transfer_rejected():
+    """3. Link-class lie: re-tag a cross-slice exchange ici — the pricer
+    would bill the DCN seam at ICI bandwidth."""
+    s = hier_all_reduce(2, 2)
+    i = next(i for i, st in enumerate(s.steps) if st.link == "dcn")
+    broken = _mutate_step(s, i, link="ici")
+    _reject(broken, f"step {i}", "crosses slices", "link-class violation")
+
+
+def test_cyclic_wavefront_rejected():
+    """4. Slot order going backwards: a later ppermute waiting on an
+    earlier slot is a deadlock on real hardware."""
+    s = ring_all_reduce(4)
+    broken = _mutate_step(s, 2, slot=0)
+    _reject(broken, "step 2", "cyclic/non-monotone", "deadlock")
+
+
+def test_under_declared_send_budget_rejected():
+    """5. Byte undercount: declare fewer per-rank chunk sends than the
+    steps actually move — the pricer would underbill the schedule."""
+    s = ring_all_reduce(4)
+    broken = dataclasses.replace(
+        s, declared_sends_per_rank=s.declared_sends_per_rank - 1)
+    _reject(broken, "count/byte mismatch", "under-declared")
+
+
+def test_duplicate_source_rejected():
+    """6. Two transfers out of one rank in one exchange: not a partial
+    permutation — one lax.ppermute cannot carry both."""
+    s = ring_all_reduce(4)
+    step0 = s.steps[0]
+    broken = _mutate_step(s, 0, xfers=step0.xfers + (step0.xfers[0],))
+    _reject(broken, "step 0", "source of two transfers")
+
+
+def test_duplicate_destination_rejected():
+    """7. Two transfers into one rank in one exchange."""
+    s = ring_all_reduce(4)
+    step0 = s.steps[0]
+    clash = dataclasses.replace(step0.xfers[0],
+                                dst=step0.xfers[1].dst)
+    broken = _mutate_step(s, 0, xfers=(clash,) + step0.xfers[1:])
+    _reject(broken, "step 0", "destination of two")
+
+
+def test_send_of_nothing_rejected():
+    """8. A rank sends a chunk slot it holds nothing for: in an
+    all-gather only owners start with data, so rewiring the first hop's
+    source to a non-owner sends garbage."""
+    s = ring_all_gather(4)
+    step0 = s.steps[0]
+    x0 = step0.xfers[0]
+    # rewire x0 to carry a chunk its src does not own at step 0
+    wrong = tuple(k for k in range(s.n_chunks)
+                  if (s.owner or ())[k] != x0.src)[:1]
+    broken = _mutate_step(
+        s, 0, xfers=(dataclasses.replace(x0, chunks=wrong),)
+        + step0.xfers[1:])
+    _reject(broken, "step 0", "holds no contribution")
+
+
+def test_chunk_out_of_range_rejected():
+    """9. A transfer naming a chunk id outside the schedule's space."""
+    s = ring_all_reduce(4)
+    step0 = s.steps[0]
+    broken = _mutate_step(
+        s, 0, xfers=(dataclasses.replace(
+            step0.xfers[0], chunks=(s.n_chunks,)),) + step0.xfers[1:])
+    _reject(broken, "step 0", "out of range")
+
+
+def test_rank_out_of_range_rejected():
+    """10. A transfer to a rank outside the group."""
+    s = ring_all_reduce(4)
+    step0 = s.steps[0]
+    broken = _mutate_step(
+        s, 0, xfers=(dataclasses.replace(
+            step0.xfers[0], dst=s.n_ranks),) + step0.xfers[1:])
+    _reject(broken, "step 0", "out of range")
+
+
+def test_unknown_link_and_combine_rejected():
+    """11. Structural garbage: unknown link class / combine mode."""
+    s = ring_all_reduce(4)
+    _reject(_mutate_step(s, 0, link="nvlink"), "step 0", "unknown link")
+    _reject(_mutate_step(s, 0, combine="max"), "step 0",
+            "unknown combine")
+
+
+def test_truncated_schedule_rejected():
+    """12. Chop the tail off: data movement simply stops early."""
+    s = ring_all_reduce(4)
+    broken = dataclasses.replace(s, steps=s.steps[:-2],
+                                 declared_sends_per_rank=None)
+    _reject(broken, "incomplete all_reduce")
+
+
+def test_over_reduction_rejected():
+    """13. An extra full ring pass over-reduces every chunk (each
+    contribution lands twice) — caught as duplicate reduction at the
+    first replayed add."""
+    s = ring_all_reduce(2)
+    again = tuple(dataclasses.replace(st, slot=st.slot + 100)
+                  for st in s.steps if st.combine == "add")
+    broken = dataclasses.replace(s, steps=s.steps + again,
+                                 declared_sends_per_rank=None)
+    _reject(broken, "duplicate reduction")
+
+
+# ---------------------------------------------------------------------------
+# the healthy space stays healthy (the corpus's control group)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("n,cross", [(2, 1), (4, 1), (6, 1), (8, 1),
+                                     (8, 2), (16, 4)])
+def test_synthesized_space_verifies(n, cross):
+    space = synthesize_space(n, cross=cross)
+    assert space, f"empty space for n={n} cross={cross}"
+    for name, sched in space.items():
+        assert verify(sched) is sched, name
+
+
+def test_verify_returns_schedule_for_chaining():
+    s = ring_all_reduce(8)
+    assert verify(s) is s

@@ -1,0 +1,140 @@
+"""Residual decay/windowing (observability.calibration.window_points)
+and the closed calibration loop (cli.search_dist.feed_calibrated_profile):
+aged and untimestamped residuals fall out of the posterior, floods are
+bounded per curve, and the next search prices with the runtime-calibrated
+profile ONLY when its hardware fingerprint matches."""
+
+import os
+
+import pytest
+
+from hetu_galvatron_tpu.cli.search_dist import feed_calibrated_profile
+from hetu_galvatron_tpu.core.args_schema import CoreArgs
+from hetu_galvatron_tpu.observability.calibration import (
+    PROFILE_NAME,
+    hardware_fingerprint,
+    window_points,
+    write_calibrated_profile,
+)
+
+pytestmark = [pytest.mark.observability]
+
+NOW = 1_700_000_000.0
+DAY = 86400.0
+
+
+def _pt(t=None, group="allreduce_size_8_consec_1", alg="ring", mb=1.0):
+    p = {"group": group, "alg": alg, "mb": mb, "ms": 0.5}
+    if t is not None:
+        p["t"] = t
+    return p
+
+
+# ---------------------------------------------------------------------------
+# window_points
+# ---------------------------------------------------------------------------
+
+
+def test_window_drops_old_and_untimestamped():
+    """An active window ages out stale points AND legacy lines with no
+    timestamp — unknown-age residuals must not anchor the posterior."""
+    pts = [_pt(t=NOW), _pt(t=NOW - 10 * DAY), _pt(t=None)]
+    kept = window_points(pts, window_days=5.0, now=NOW)
+    assert kept == [pts[0]]
+
+
+def test_window_boundary_is_inclusive():
+    pts = [_pt(t=NOW - 5 * DAY)]
+    assert window_points(pts, window_days=5.0, now=NOW) == pts
+
+
+def test_max_points_keeps_newest_per_curve():
+    """The per-(group, alg) cap keeps the NEWEST points, so one flood of
+    appends cannot crowd out fresher measurements on another curve."""
+    ring = [_pt(t=NOW + i) for i in range(5)]
+    tree = [_pt(t=NOW + i, alg="tree") for i in range(3)]
+    kept = window_points(ring + tree, max_points_per_curve=2)
+    assert kept == [ring[3], ring[4], tree[1], tree[2]]
+
+
+def test_flat_points_bucket_separately_from_algo_points():
+    flat = [_pt(t=NOW + i, alg=None) for i in range(3)]
+    ring = [_pt(t=NOW + i) for i in range(3)]
+    kept = window_points(flat + ring, max_points_per_curve=1)
+    assert kept == [flat[2], ring[2]]
+
+
+def test_zero_limits_keep_everything():
+    """0/0 is the historical keep-everything behaviour (non-dict garbage
+    is still discarded)."""
+    pts = [_pt(t=None), _pt(t=NOW - 1000 * DAY)]
+    assert window_points(pts + ["junk"]) == pts
+
+
+# ---------------------------------------------------------------------------
+# feed_calibrated_profile
+# ---------------------------------------------------------------------------
+
+
+def _args(td, use_calibrated=1):
+    a = CoreArgs()
+    a.search.use_calibrated = use_calibrated
+    a.observability.calibration_dir = str(td)
+    return a
+
+
+def _write_profile(td, world=8, device=None):
+    fp = hardware_fingerprint(None, world=world, device_kind=device)
+    cfg = {
+        "allreduce_size_8_consec_1_ring_alpha_ms": 0.05,
+        "allreduce_size_8_consec_1_ring_beta_mb_per_ms": 10.0,
+        "calibration_meta": {
+            "source": "runtime-calibrated",
+            "fingerprint": fp,
+            "curves": {"allreduce_size_8_consec_1/ring":
+                       {"points": 6, "method": "irls"}},
+        },
+    }
+    return write_calibrated_profile(os.path.join(str(td), PROFILE_NAME),
+                                    cfg)
+
+
+def test_matching_fingerprint_installs_profile(tmp_path):
+    """Device + world match: the search's bandwidth config path is
+    swapped to the calibrated posterior, with provenance in the log."""
+    a = _args(tmp_path)
+    path = _write_profile(tmp_path, world=8)
+    lines = []
+    assert feed_calibrated_profile(a, 8, log=lines.append) is True
+    assert a.search.allreduce_bandwidth_config_path == path
+    assert any("runtime-calibrated" in ln for ln in lines)
+
+
+def test_world_mismatch_is_ignored_with_reason(tmp_path):
+    a = _args(tmp_path)
+    _write_profile(tmp_path, world=16)
+    lines = []
+    assert feed_calibrated_profile(a, 8, log=lines.append) is False
+    assert a.search.allreduce_bandwidth_config_path is None
+    assert any("does not match" in ln for ln in lines)
+
+
+def test_device_mismatch_is_ignored(tmp_path):
+    a = _args(tmp_path)
+    _write_profile(tmp_path, world=8, device="TPU v9000")
+    assert feed_calibrated_profile(a, 8, log=lambda _m: None) is False
+    assert a.search.allreduce_bandwidth_config_path is None
+
+
+def test_opt_out_and_missing_pieces_feed_nothing(tmp_path):
+    # explicit opt-out wins even with a matching profile on disk
+    a = _args(tmp_path, use_calibrated=0)
+    _write_profile(tmp_path, world=8)
+    assert feed_calibrated_profile(a, 8, log=lambda _m: None) is False
+    # no calibration dir configured
+    b = CoreArgs()
+    assert feed_calibrated_profile(b, 8, log=lambda _m: None) is False
+    # dir configured but no profile written yet
+    c = _args(tmp_path / "empty")
+    os.makedirs(str(tmp_path / "empty"), exist_ok=True)
+    assert feed_calibrated_profile(c, 8, log=lambda _m: None) is False

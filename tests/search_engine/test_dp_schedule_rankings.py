@@ -1,0 +1,110 @@
+"""The collective compiler's search hook (cost_model.cost.
+dp_schedule_rankings / dp_schedule_choice): the schedule space is priced
+from the profiled per-algorithm ring fits, the winner flips with the
+gradient payload (trees ONLY at small sizes), legacy profiles price
+nothing (the golden-search byte-identity hinges on it), and the chosen
+name round-trips through the plan JSON into the runtime config."""
+
+import pytest
+
+from hetu_galvatron_tpu.core.cost_model.cost import (
+    CostContext,
+    dp_schedule_choice,
+    dp_schedule_rankings,
+)
+from hetu_galvatron_tpu.core.search_engine.strategies import SearchStrategy
+from hetu_galvatron_tpu.utils.strategy import (
+    DPType,
+    EmbeddingLMHeadStrategy,
+    LayerStrategy,
+    config2strategy,
+    strategy_list2config,
+)
+
+pytestmark = [pytest.mark.search_engine, pytest.mark.collectives]
+
+ALGOS = {"8_1": {"ring_ici": (0.05, 10.0)},
+         "4_1": {"ring_ici": (0.04, 8.0)},
+         "2_0": {"ring_dcn": (0.5, 1.0)}}
+DP8 = SearchStrategy(pp=1, tp=1, dp=8)
+
+
+def _ctx(**kw):
+    base = dict(parameter_size=48.0, layer_num=4, mixed_precision=True,
+                hier_dp=True, dcn_slices=1, alpha_beta_algos=ALGOS)
+    base.update(kw)
+    return CostContext(**base)
+
+
+def test_rankings_price_the_whole_space():
+    ranks = dp_schedule_rankings(DP8, _ctx(), 8.0)
+    assert set(ranks) >= {"ring", "tree_hd", "tree_bcast", "torus2d"}
+    assert all(v > 0 for v in ranks.values())
+
+
+def test_choice_flips_with_gradient_size():
+    """The pinned plan flip: ONLY at small gradient payloads does a
+    latency-optimal tree win; at bulk the bandwidth-optimal ring/torus
+    must take it back."""
+    ctx = _ctx()
+    small, _ = dp_schedule_choice(DP8, ctx, 0.0005)
+    bulk, ranks = dp_schedule_choice(DP8, ctx, 64.0)
+    assert small in ("tree_hd", "tree_bcast")
+    assert bulk in ("ring", "torus2d")
+    # the rankings carry every priced family for the plan record
+    assert set(ranks) >= {"ring", "tree_hd", "tree_bcast", "torus2d"}
+
+
+def test_legacy_profile_prices_nothing():
+    """No per-algorithm curves (legacy profile) -> no rankings, no
+    choice, no plan-JSON key — the golden searches stay byte-identical."""
+    assert dp_schedule_rankings(DP8, _ctx(alpha_beta_algos={}), 8.0) == {}
+    assert dp_schedule_choice(DP8, _ctx(alpha_beta_algos={}), 8.0) is None
+
+
+def test_ineligible_strategies_price_nothing():
+    ctx = _ctx()
+    assert dp_schedule_rankings(
+        SearchStrategy(pp=1, tp=8, dp=1), ctx, 8.0) == {}
+    assert dp_schedule_rankings(
+        DP8, _ctx(hier_dp=False), 8.0) == {}
+
+
+def test_hier_split_uses_dcn_curves():
+    """With a 2-slice seam the space includes hier_rings and prices over
+    both link classes (the dcn ring fit at the cross size)."""
+    ranks = dp_schedule_rankings(DP8, _ctx(dcn_slices=2), 8.0)
+    assert "hier_rings" in ranks and "ring" in ranks
+
+
+# ---------------------------------------------------------------------------
+# plan JSON round-trip
+# ---------------------------------------------------------------------------
+
+
+def _layers(dp=8, n=2):
+    return [LayerStrategy(pp_deg=1, tp_size=1, dp_size=dp, cp_size=1,
+                          dp_type=DPType.from_name("ddp"))
+            for _ in range(n)]
+
+
+def test_dp_schedule_round_trips_through_plan_json():
+    cfg = strategy_list2config(
+        _layers(), global_bsz=16, chunks=2,
+        vocab=EmbeddingLMHeadStrategy(vtp=1), pp_division=[2],
+        hier_dp=True, dp_schedule="tree_hd")
+    assert cfg["dp_schedule"] == "tree_hd"
+    _, _, extras = config2strategy(cfg, world_size=8)
+    assert extras["dp_schedule"] == "tree_hd"
+
+
+def test_dp_schedule_absent_without_hier_dp():
+    """A schedule name without the hierarchical path is meaningless —
+    the serializer must not write one."""
+    cfg = strategy_list2config(
+        _layers(), global_bsz=16, chunks=2,
+        vocab=EmbeddingLMHeadStrategy(vtp=1), pp_division=[2],
+        dp_schedule="tree_hd")
+    assert "dp_schedule" not in cfg
+    _, _, extras = config2strategy(cfg, world_size=8)
+    assert extras.get("dp_schedule") is None

@@ -78,6 +78,12 @@ LEGS: Tuple[Tuple[str, str, bool], ...] = (
     # the ratio prices the bucketing overhead (<= ~1.0 — the pipelined
     # program must not cost more than it hides); regresses UP.
     ("hier_dp_bucketed", "hier_dp_bucketed_vs_mono", False),
+    # synthesized-schedule emitter vs the hand-built reference bodies
+    # (tools/synth_collectives_bench.py): emitted ring/halving-doubling
+    # program wall-clock over the canonical bodies, bit-parity asserted
+    # before timing. A ratio pricing the emitter's table-driven
+    # bookkeeping; regresses UP.
+    ("synth_collectives", "synth_collectives_vs_handbuilt", False),
 )
 
 

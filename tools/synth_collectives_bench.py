@@ -1,0 +1,143 @@
+"""Synthesized-vs-hand-built collective A/B: the emitter's lowering of
+the verified ring / halving-doubling all-reduce schedules
+(collectives/synthesize.py -> verify.py -> emit.py) against the
+canonical hand-built bodies (collectives/reference.py) the profiler has
+timed since PR 13 — same 8-rank group, same payload, full-manual
+shard_map on both sides.
+
+The contract under test is twofold:
+
+* **bit-parity** — the emitted program must produce the hand-built
+  body's output bit-for-bit (same hop order, same add association);
+  the bench ASSERTS it before timing — a wall-clock win on a wrong
+  answer is not a win,
+* **zero abstraction tax** — the emitted program is a table-driven
+  take/ppermute/where unrolling of the same data movement, so its
+  wall-clock must track the hand-built loop. ``synth_collectives_vs_
+  handbuilt`` is the pooled median of per-iteration emitted/hand-built
+  ratios across both algorithms; tools/bench_gate.py pins it (a ratio,
+  regresses UP — the pad/index bookkeeping starting to cost real time).
+
+On the CPU mesh the links are host memory, so the ratio prices pure
+program overhead — exactly the quantity the gate should watch; the
+schedule CHOICE itself is priced offline (collectives/pricing.py,
+``check --schedules``), not here.
+
+Prints one JSON line. Run (virtual CPU mesh):
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python tools/synth_collectives_bench.py
+On a real slice: add ``--tpu``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+_FLAG = "--xla_force_host_platform_device_count=8"
+if __name__ == "__main__" and "--tpu" not in sys.argv:
+    if "xla_force_host_platform_device_count" not in os.environ.get(
+            "XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                                   + " " + _FLAG).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+# emitted schedule family -> the hand-built reference body it must match
+PAIRS = (("ring", "ring"), ("tree_hd", "tree"))
+
+
+def run(iters: int = 16, on_tpu: bool = False, n: int = 8,
+        payload_mb: float = 4.0) -> dict:
+    import jax
+    if not on_tpu:
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from hetu_galvatron_tpu.collectives.emit import emit_allreduce_body
+    from hetu_galvatron_tpu.collectives.reference import (
+        handbuilt_allreduce_body,
+    )
+    from hetu_galvatron_tpu.collectives.synthesize import (
+        synthesize_dp_schedule,
+    )
+    from hetu_galvatron_tpu.collectives.verify import verify
+
+    devices = jax.devices()[:n] if on_tpu else jax.devices("cpu")[:n]
+    if len(devices) < n:
+        return {"metric": "synth_collectives", "skipped":
+                f"need {n} devices for the group, have {len(devices)}"}
+    mesh = Mesh(np.asarray(devices), ("dp",))
+
+    def jit_body(body):
+        return jax.jit(shard_map(body, mesh=mesh, in_specs=P("dp"),
+                                 out_specs=P("dp"), check_rep=False))
+
+    # per-device f32 vector sized to the payload; divisible by n so the
+    # ring chunks and the tree halvings both split it evenly
+    local = int(payload_mb * (1 << 20) // 4) // n * n
+    x = jnp.asarray(np.random.RandomState(0)
+                    .standard_normal(n * local), jnp.float32)
+
+    legs = {}
+    pooled = []
+    recompiles = 0
+    for fam, ref in PAIRS:
+        sched = verify(synthesize_dp_schedule(fam, n, 1))
+        e_fn = jit_body(emit_allreduce_body(sched, "dp",
+                                            verify_first=False))
+        h_fn = jit_body(handbuilt_allreduce_body(ref, n, "dp"))
+        e_out = jax.block_until_ready(e_fn(x))
+        h_out = jax.block_until_ready(h_fn(x))
+        bitexact = bool(jnp.array_equal(e_out, h_out))
+        if not bitexact:
+            raise AssertionError(
+                f"emitted {fam} diverged from the hand-built {ref} body "
+                f"(max |diff| "
+                f"{float(jnp.max(jnp.abs(e_out - h_out)))})")
+        n_compiles = e_fn._cache_size() + h_fn._cache_size()
+        e_times, h_times = [], []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            jax.block_until_ready(e_fn(x))
+            e_times.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            jax.block_until_ready(h_fn(x))
+            h_times.append(time.perf_counter() - t0)
+        leg_recompiles = (e_fn._cache_size() + h_fn._cache_size()
+                          - n_compiles)
+        recompiles += leg_recompiles
+        pooled += [e / h for e, h in zip(e_times, h_times)]
+        e_ms = float(np.median(e_times)) * 1e3
+        h_ms = float(np.median(h_times)) * 1e3
+        legs[fam] = {
+            "handbuilt_ms": round(h_ms, 3),
+            "emitted_ms": round(e_ms, 3),
+            "emitted_vs_handbuilt": round(e_ms / max(h_ms, 1e-9), 3),
+            "bitexact": bitexact,
+            "recompiles": int(leg_recompiles),
+        }
+
+    return {
+        "metric": "synth_collectives",
+        "platform": "tpu" if on_tpu else "cpu",
+        "iters": iters,
+        "payload_mb": payload_mb,
+        "group": n,
+        "legs": legs,
+        "synth_collectives_vs_handbuilt":
+            round(float(np.median(pooled)), 3),
+        "synth_collectives_recompiles": int(recompiles),
+    }
+
+
+if __name__ == "__main__":
+    print(json.dumps(run(on_tpu="--tpu" in sys.argv)))
